@@ -1,0 +1,34 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+Brand-new implementation of the capabilities of Horovod v0.15.1
+(reference: steve-engineml/horovod, surveyed in ``SURVEY.md``), designed for
+TPU hardware: topology from the pod runtime instead of ``mpirun``, XLA
+collectives over the ICI mesh instead of MPI/NCCL, trace-time gradient
+fusion instead of runtime fusion-buffer memcpys, and a jit/shard_map-first
+SPMD API with an eager negotiated path for dynamic use.
+
+Quick start (mirrors the reference's 4-step usage, ``README.md``)::
+
+    import horovod_tpu as hvd
+    hvd.init()                                # 1. topology from the pod
+    mesh = hvd.ranks_mesh()                   # 2. the world mesh
+    # 3. wrap your optimizer  (see horovod_tpu.jax.DistributedOptimizer)
+    # 4. broadcast initial parameters from rank 0
+"""
+
+from horovod_tpu.basics import (           # noqa: F401
+    init, shutdown, is_initialized, size, local_size, rank, local_rank,
+    process_index, process_count, devices, local_devices, ranks_mesh,
+    mpi_threads_supported, NotInitializedError,
+)
+from horovod_tpu.ops.eager import (        # noqa: F401
+    allreduce, allreduce_async, allgather, allgather_async, broadcast,
+    broadcast_async, poll, synchronize, PerRank, scatter_ranks,
+    CollectiveError,
+)
+from horovod_tpu.ops import injit          # noqa: F401
+from horovod_tpu.ops.injit import (        # noqa: F401
+    SUM, AVERAGE, MIN, MAX,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,157 @@
+"""Process-global framework state: init / shutdown / rank queries.
+
+API parity with the reference's ``HorovodBasics`` ctypes bridge
+(``horovod/common/__init__.py:51-154``): every query raises if called before
+``init()``, ``shutdown()`` is registered with ``atexit``, and ``init()`` may
+restrict the job to a subset of ranks.
+
+Unlike the reference there is no ``mpirun``: topology comes from the TPU pod
+runtime via JAX (see :mod:`horovod_tpu.topology`).  The background controller
+(C++ core, :mod:`horovod_tpu.core`) is started here, mirroring
+``InitializeHorovodOnce`` (``horovod/common/operations.cc:1907-1925``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Sequence
+
+from horovod_tpu import topology as _topology_mod
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when a query runs before ``init()``.
+
+    Mirrors ``'Horovod has not been initialized; use hvd.init().'``
+    (reference ``horovod/common/__init__.py:92-96``).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; use hvd.init().")
+
+
+class _GlobalState:
+    """Singleton framework state (mirrors ``HorovodGlobalState``,
+    reference ``horovod/common/operations.cc:112-247``)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.shut_down = False
+        self.topology: Optional[_topology_mod.Topology] = None
+        self.controller = None          # horovod_tpu.core.Controller
+        self.mesh = None                # default 1-D 'ranks' mesh
+        self.atexit_registered = False
+
+
+_state = _GlobalState()
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def init(ranks: Optional[Sequence[int]] = None) -> None:
+    """Initialize the framework.
+
+    ``ranks``: optional subset of global device ranks to participate,
+    mirroring ``hvd.init(comm=[...])`` (reference
+    ``horovod/common/__init__.py:58-68``).  Safe to call more than once
+    (subsequent calls are no-ops, as in ``InitializeHorovodOnce``).
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        _state.topology = _topology_mod.resolve(ranks)
+        from horovod_tpu.parallel import mesh as _mesh_mod
+        _state.mesh = _mesh_mod.build_ranks_mesh(_state.topology)
+        from horovod_tpu import core as _core_mod
+        _state.controller = _core_mod.Controller(_state.topology, _state.mesh)
+        _state.controller.start()
+        if not _state.atexit_registered:
+            atexit.register(shutdown)
+            _state.atexit_registered = True
+        _state.shut_down = False
+        _state.initialized = True
+
+
+def shutdown() -> None:
+    """Shut the framework down (idempotent; registered with atexit, mirroring
+    reference ``horovod/common/__init__.py:69``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        try:
+            if _state.controller is not None:
+                _state.controller.stop()
+        finally:
+            _state.controller = None
+            _state.topology = None
+            _state.mesh = None
+            _state.initialized = False
+            _state.shut_down = True
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def size() -> int:
+    """Total number of ranks (= participating TPU chips)."""
+    return _require_init().topology.size
+
+
+def local_size() -> int:
+    """Number of ranks (chips) owned by this process."""
+    return _require_init().topology.local_size
+
+
+def rank() -> int:
+    """Global rank of this process's first chip; rank 0 is the coordinator."""
+    return _require_init().topology.rank
+
+
+def local_rank() -> int:
+    """Index of this process among processes on the same host."""
+    return _require_init().topology.local_rank
+
+
+def process_index() -> int:
+    return _require_init().topology.process_index
+
+
+def process_count() -> int:
+    return _require_init().topology.process_count
+
+
+def local_devices():
+    return _require_init().topology.local_devices
+
+
+def devices():
+    return _require_init().topology.devices
+
+
+def ranks_mesh():
+    """The default 1-D ``('ranks',)`` mesh over all participating chips."""
+    return _require_init().mesh
+
+
+def controller():
+    return _require_init().controller
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim for ``hvd.mpi_threads_supported()``
+    (reference ``horovod/common/__init__.py:140-154``).
+
+    There is no MPI on the TPU path; the control plane (gRPC/TCP) is always
+    thread-safe, so this reports True once initialized.
+    """
+    _require_init()
+    return True

@@ -1,0 +1,584 @@
+"""Background controller: negotiation, fusion planning, handle management.
+
+This is the TPU-native re-design of the reference's C++ core
+(``horovod/common/operations.cc``):
+
+* A per-process **background thread** owns all control-plane state; framework
+  threads only enqueue work and receive callbacks — the reference's key
+  architectural invariant (``operations.cc:106-111, 1414-1433``).
+* **Negotiation**: a message table counts per-tensor readiness across ranks;
+  when every rank has submitted a tensor, a response is constructed with full
+  cross-rank validation (mismatched dtype / op / shape / root-rank errors,
+  message text matching ``ConstructMPIResponse``,
+  ``operations.cc:315-517``).
+* **Fusion planner**: consecutive same-dtype allreduce responses are merged
+  while their payload stays under the fusion threshold
+  (``operations.cc:1807-1842``; default 64 MB, ``operations.cc:151``).
+* **Data plane**: instead of MPI/NCCL calls, ready responses are executed as
+  jitted XLA programs over the device mesh (:mod:`horovod_tpu.ops.executor`).
+
+The control-plane state machine also exists as a C++ library
+(``cpp/``, loaded via ctypes in :mod:`horovod_tpu.cpp_core`); when the shared
+library is available it replaces the pure-Python message table / fusion /
+timeline / stall-check logic below.  Behaviour is identical; the Python path
+is the fallback and the executable specification.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# Status (mirrors horovod/common/common.h:37-53)
+# --------------------------------------------------------------------------
+
+class StatusType(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Status:
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status()
+
+    @staticmethod
+    def precondition_error(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def invalid_argument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+
+SHUT_DOWN_ERROR = Status.aborted(
+    "Horovod has been shut down. This has been caused by an exception on one "
+    "of the ranks or an attempt to allreduce, allgather or broadcast a tensor "
+    "after one of the ranks has finished execution.")
+# (error text parity: reference operations.cc:258-263)
+
+
+# --------------------------------------------------------------------------
+# Wire message equivalents (reference horovod/common/mpi_message.{h,cc})
+# --------------------------------------------------------------------------
+
+class RequestType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    ERROR = 3
+
+
+_REQUEST_TYPE_NAME = {
+    RequestType.ALLREDUCE: "ALLREDUCE",
+    RequestType.ALLGATHER: "ALLGATHER",
+    RequestType.BROADCAST: "BROADCAST",
+}
+
+
+def request_type_name(t: RequestType) -> str:
+    return _REQUEST_TYPE_NAME.get(t, "<unknown>")
+
+
+def dtype_name(dtype) -> str:
+    """numpy-style dtype names match the reference's MPIDataType_Name
+    (``mpi_message.cc:24-60``): uint8, int8, ..., float32, float64, bool."""
+    return np.dtype(dtype).name
+
+
+def shape_debug_string(shape: Sequence[int]) -> str:
+    """Format parity with ``TensorShape::DebugString`` (common.cc)."""
+    return "[" + ", ".join(str(d) for d in shape) + "]"
+
+
+@dataclasses.dataclass
+class Request:
+    """One rank's announcement that a named tensor is ready
+    (reference ``MPIRequest``, ``mpi_message.h``)."""
+    request_rank: int
+    request_type: RequestType
+    tensor_name: str
+    tensor_type: str                       # numpy dtype name
+    tensor_shape: Tuple[int, ...]
+    root_rank: int = -1
+    device: int = -1                       # global device rank (or -1 host)
+
+
+@dataclasses.dataclass
+class Response:
+    """Coordinator's instruction to execute (possibly fused) collectives
+    (reference ``MPIResponse``)."""
+    response_type: ResponseType
+    tensor_names: List[str]
+    error_message: str = ""
+    devices: List[int] = dataclasses.field(default_factory=list)
+    # For allgather: dim0 size contributed by each rank, indexed by rank
+    # (reference mpi_message.h tensor_sizes).
+    tensor_sizes: List[int] = dataclasses.field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# Message table: negotiation + cross-rank validation
+# --------------------------------------------------------------------------
+
+class MessageTable:
+    """Tracks per-tensor readiness across ranks (coordinator side).
+
+    Mirrors ``IncrementTensorCount`` / ``ConstructMPIResponse``
+    (``operations.cc:282-517``) including error-message text.
+    """
+
+    def __init__(self, size: int, timeline=None):
+        self._size = size
+        self._table: Dict[str, Tuple[List[Request], float]] = {}
+        self._timeline = timeline
+
+    def __len__(self):
+        return len(self._table)
+
+    def increment(self, msg: Request) -> bool:
+        """Record one rank's request; True when all ranks have reported."""
+        name = msg.tensor_name
+        entry = self._table.get(name)
+        if entry is None:
+            self._table[name] = ([msg], time.monotonic())
+            if self._timeline:
+                self._timeline.negotiate_start(name, msg.request_type)
+        else:
+            entry[0].append(msg)
+        if self._timeline:
+            self._timeline.negotiate_rank_ready(name, msg.request_rank)
+        ready = len(self._table[name][0]) == self._size
+        if ready and self._timeline:
+            self._timeline.negotiate_end(name)
+        return ready
+
+    def pending_names_older_than(self, age_s: float) -> List[Tuple[str, List[int]]]:
+        """(name, missing_ranks) for entries older than ``age_s`` — the stall
+        detector's input (``CheckForStalledTensors``,
+        ``operations.cc:1366-1412``)."""
+        now = time.monotonic()
+        out = []
+        for name, (reqs, t0) in self._table.items():
+            if now - t0 > age_s:
+                have = {r.request_rank for r in reqs}
+                missing = [r for r in range(self._size) if r not in have]
+                out.append((name, missing))
+        return out
+
+    def construct_response(self, name: str) -> Response:
+        """Validate all ranks' requests for ``name`` and build the response.
+
+        Validation order and error text mirror ``ConstructMPIResponse``
+        (``operations.cc:315-517``): dtype, op, shape (allreduce/broadcast),
+        allgather rank/ dims, broadcast root rank.
+        """
+        requests, _ = self._table[name]
+        assert requests
+        error = None
+
+        data_type = requests[0].tensor_type
+        for r in requests[1:]:
+            if r.tensor_type != data_type:
+                error = (f"Mismatched data types: One rank had type {data_type}, "
+                         f"but another rank had type {r.tensor_type}.")
+                break
+
+        message_type = requests[0].request_type
+        if error is None:
+            for r in requests[1:]:
+                if r.request_type != message_type:
+                    error = ("Mismatched MPI operations: One rank did an "
+                             f"{request_type_name(message_type)}, but another "
+                             f"rank did an {request_type_name(r.request_type)}.")
+                    break
+
+        if error is None and message_type in (RequestType.ALLREDUCE,
+                                              RequestType.BROADCAST):
+            shape0 = requests[0].tensor_shape
+            for r in requests[1:]:
+                if r.tensor_shape != shape0:
+                    error = (f"Mismatched {request_type_name(message_type)} "
+                             "tensor shapes: One rank sent a tensor of shape "
+                             f"{shape_debug_string(shape0)}, but another rank "
+                             "sent a tensor of shape "
+                             f"{shape_debug_string(r.tensor_shape)}.")
+                    break
+
+        tensor_sizes = [0] * len(requests)
+        if error is None and message_type == RequestType.ALLGATHER:
+            shape0 = requests[0].tensor_shape
+            if len(shape0) == 0:
+                error = (f"Rank zero tried to {request_type_name(message_type)} "
+                         "a rank-zero tensor.")
+            else:
+                tensor_sizes[requests[0].request_rank] = shape0[0]
+                for r in requests[1:]:
+                    shp = r.tensor_shape
+                    if len(shp) != len(shape0):
+                        error = (f"Mismatched {request_type_name(message_type)} "
+                                 "tensor shapes: One rank sent a tensor of rank "
+                                 f"{len(shape0)}, but another rank sent a tensor "
+                                 f"of rank {len(shp)}.")
+                        break
+                    dim_mismatch = False
+                    for dim in range(1, len(shape0)):
+                        if shape0[dim] != shp[dim]:
+                            error = (
+                                f"Mismatched {request_type_name(message_type)} "
+                                f"tensor shapes: One rank sent a tensor with "
+                                f"dimension {dim} equal to {shape0[dim]}, but "
+                                f"another rank sent a tensor with dimension "
+                                f"{dim} equal to {shp[dim]}.")
+                            dim_mismatch = True
+                            break
+                    if dim_mismatch:
+                        break
+                    tensor_sizes[r.request_rank] = shp[0]
+
+        if error is None and message_type == RequestType.BROADCAST:
+            root0 = requests[0].root_rank
+            for r in requests[1:]:
+                if r.root_rank != root0:
+                    error = (f"Mismatched {request_type_name(message_type)} "
+                             f"root ranks: One rank specified root rank "
+                             f"{root0}, but another rank specified root rank "
+                             f"{r.root_rank}.")
+                    break
+
+        devices = [0] * len(requests)
+        for r in requests:
+            devices[r.request_rank] = r.device
+
+        del self._table[name]
+
+        if error is not None:
+            return Response(ResponseType.ERROR, [name], error_message=error,
+                            devices=devices)
+        if message_type == RequestType.ALLGATHER:
+            return Response(ResponseType.ALLGATHER, [name],
+                            tensor_sizes=tensor_sizes, devices=devices)
+        if message_type == RequestType.ALLREDUCE:
+            return Response(ResponseType.ALLREDUCE, [name], devices=devices)
+        return Response(ResponseType.BROADCAST, [name], devices=devices)
+
+
+# --------------------------------------------------------------------------
+# Fusion planner (reference operations.cc:1807-1842)
+# --------------------------------------------------------------------------
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024   # bytes (operations.cc:151)
+FUSION_BUFFER_ATOMIC_UNIT = 64                # bytes (operations.h:48-50)
+
+
+def plan_fusion(responses: List[Response],
+                entry_bytes: Callable[[str], int],
+                entry_dtype: Callable[[str], str],
+                threshold: int) -> List[Response]:
+    """Greedily merge consecutive ALLREDUCE responses of the same dtype while
+    the combined payload stays ≤ ``threshold`` bytes.
+
+    Mirrors the coordinator's fusion loop (``operations.cc:1807-1842``):
+    only allreduces fuse; a threshold of 0 disables fusion.
+    """
+    fused: List[Response] = []
+    i = 0
+    while i < len(responses):
+        r = responses[i]
+        if r.response_type != ResponseType.ALLREDUCE or threshold <= 0:
+            fused.append(r)
+            i += 1
+            continue
+        names = list(r.tensor_names)
+        total = sum(entry_bytes(n) for n in names)
+        dtype = entry_dtype(names[0])
+        j = i + 1
+        while j < len(responses):
+            nxt = responses[j]
+            if nxt.response_type != ResponseType.ALLREDUCE:
+                break
+            nbytes = sum(entry_bytes(n) for n in nxt.tensor_names)
+            if entry_dtype(nxt.tensor_names[0]) != dtype:
+                break
+            if total + nbytes > threshold:
+                break
+            names.extend(nxt.tensor_names)
+            total += nbytes
+            j += 1
+        fused.append(Response(ResponseType.ALLREDUCE, names,
+                              devices=r.devices))
+        i = j
+    return fused
+
+
+# --------------------------------------------------------------------------
+# Handle manager (reference horovod/torch/handle_manager.{h,cc})
+# --------------------------------------------------------------------------
+
+class HandleManager:
+    """Thread-safe int-handle → Status map for async ops."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._next = 0
+        self._results: Dict[int, Optional[Tuple[Status, object]]] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = None
+            return h
+
+    def mark_done(self, handle: int, status: Status, result=None) -> None:
+        with self._cv:
+            if handle in self._results:
+                self._results[handle] = (status, result)
+                self._cv.notify_all()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            self._check_known(handle)
+            return self._results[handle] is not None
+
+    def wait(self, handle: int, timeout: Optional[float] = None):
+        with self._cv:
+            self._check_known(handle)
+            if not self._cv.wait_for(
+                    lambda: self._results[handle] is not None, timeout):
+                raise TimeoutError(f"handle {handle} did not complete")
+            return self._results[handle]
+
+    def release(self, handle: int):
+        with self._lock:
+            self._results.pop(handle, None)
+
+    def _check_known(self, handle: int):
+        if handle not in self._results:
+            raise ValueError(f"unknown handle: {handle}")
+
+
+# --------------------------------------------------------------------------
+# Tensor table entry + controller
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TensorTableEntry:
+    """Tensor data + callback held while a collective is in flight
+    (reference ``TensorTableEntry``, ``operations.cc:60-100``)."""
+    name: str
+    request_type: RequestType
+    # One contribution per participating rank this process controls.  In the
+    # single-controller SPMD model a process enqueues on behalf of all its
+    # local ranks at once: either a replicated array (same value per rank) or
+    # an explicit per-rank list.
+    per_rank: List[np.ndarray]
+    dtype: str
+    root_rank: int
+    average: bool
+    callback: Callable[[Status, object], None]
+
+
+class Controller:
+    """Per-process background controller.
+
+    Owns: message queue (framework threads push), tensor table, message
+    table (negotiation), fusion planner, stall checker, timeline, handle
+    manager, and the data-plane executor.  One daemon thread runs
+    ``_run_loop_once`` every ``cycle_time`` — the reference's
+    ``RunLoopOnce`` tick (``operations.cc:1694-1903``).
+    """
+
+    def __init__(self, topology, mesh):
+        self.topology = topology
+        self.mesh = mesh
+        self.size = topology.size
+        self.cycle_time_s = float(
+            os.environ.get("HOROVOD_TPU_CYCLE_TIME_MS", "1.0")) / 1e3
+        self.fusion_threshold = int(
+            os.environ.get("HOROVOD_TPU_FUSION_THRESHOLD",
+                           str(DEFAULT_FUSION_THRESHOLD)))
+        self.stall_warning_time_s = 60.0
+        self.stall_check_disabled = bool(
+            os.environ.get("HOROVOD_TPU_STALL_CHECK_DISABLE", ""))
+
+        self.timeline = None
+        timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")
+        if timeline_path and topology.rank == 0:
+            from horovod_tpu.timeline import Timeline
+            self.timeline = Timeline(timeline_path)
+
+        self.handle_manager = HandleManager()
+        self._message_table = MessageTable(self.size, self.timeline)
+        self._tensor_table: Dict[str, TensorTableEntry] = {}
+        self._message_queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_stall_check = time.monotonic()
+
+        from horovod_tpu.ops.executor import Executor
+        self._executor = Executor(topology, mesh, self.timeline)
+
+    # ------------------------------------------------------------------ API
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._background_loop, name="horovod_tpu-controller",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        """Coordinated shutdown: outstanding entries get SHUT_DOWN_ERROR
+        (reference ``operations.cc:1647-1662``)."""
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            entries = list(self._tensor_table.values())
+            self._tensor_table.clear()
+            self._message_queue.clear()
+        for e in entries:
+            e.callback(SHUT_DOWN_ERROR, None)
+        if self.timeline:
+            self.timeline.close()
+
+    def enqueue(self, entry: TensorTableEntry) -> Status:
+        """Framework-thread side: register tensor data and queue one request
+        per controlled rank (reference ``EnqueueTensorAllreduce`` et al.,
+        ``operations.cc:2025-2141``)."""
+        if self._shutdown.is_set():
+            return SHUT_DOWN_ERROR
+        first_rank = self.topology.rank
+        requests = []
+        for i, contrib in enumerate(entry.per_rank):
+            requests.append(Request(
+                request_rank=first_rank + i,
+                request_type=entry.request_type,
+                tensor_name=entry.name,
+                tensor_type=np.dtype(contrib.dtype).name,
+                tensor_shape=tuple(contrib.shape),
+                root_rank=entry.root_rank,
+                device=first_rank + i,
+            ))
+        with self._lock:
+            if entry.name in self._tensor_table:
+                return Status.invalid_argument(
+                    f"Duplicate tensor name in queue: {entry.name}. "
+                    "A collective for this tensor is already in progress.")
+            self._tensor_table[entry.name] = entry
+            self._message_queue.extend(requests)
+        return Status.OK()
+
+    # ------------------------------------------------------- background loop
+
+    def _background_loop(self):
+        while not self._shutdown.is_set():
+            t0 = time.monotonic()
+            try:
+                self._run_loop_once()
+            except Exception as exc:   # noqa: BLE001 — fail entries, not thread
+                self._fail_all(Status(StatusType.UNKNOWN_ERROR, repr(exc)))
+            elapsed = time.monotonic() - t0
+            remaining = self.cycle_time_s - elapsed
+            if remaining > 0:
+                self._shutdown.wait(remaining)
+
+    def _run_loop_once(self):
+        with self._lock:
+            pending = list(self._message_queue)
+            self._message_queue.clear()
+
+        # Negotiation.  Single-process: this process speaks for every rank, so
+        # readiness resolves locally.  Multi-process: local requests are
+        # forwarded to the rank-0 coordinator over the control plane (C++
+        # core), which gathers/validates and broadcasts responses.
+        responses: List[Response] = []
+        for req in pending:
+            if self._message_table.increment(req):
+                responses.append(
+                    self._message_table.construct_response(req.tensor_name))
+
+        if not responses:
+            self._maybe_check_stalls()
+            return
+
+        def entry_bytes(name: str) -> int:
+            e = self._tensor_table[name]
+            return int(np.prod(e.per_rank[0].shape)) * np.dtype(e.dtype).itemsize
+
+        def entry_dtype(name: str) -> str:
+            return self._tensor_table[name].dtype
+
+        fused = plan_fusion(responses, entry_bytes, entry_dtype,
+                            self.fusion_threshold)
+
+        for resp in fused:
+            with self._lock:
+                entries = [self._tensor_table.pop(n) for n in resp.tensor_names]
+            self._executor.execute(resp, entries)
+
+        self._maybe_check_stalls()
+
+    def _maybe_check_stalls(self):
+        """Warn (once per minute) about tensors some ranks never submitted
+        (reference ``CheckForStalledTensors``, ``operations.cc:1366-1412``)."""
+        if self.stall_check_disabled:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_check < self.stall_warning_time_s:
+            return
+        self._last_stall_check = now
+        stalled = self._message_table.pending_names_older_than(
+            self.stall_warning_time_s)
+        if stalled:
+            import sys
+            msg = ["WARNING: One or more tensors were submitted to be "
+                   "reduced, gathered or broadcasted by subset of ranks and "
+                   "are waiting for remainder of ranks for more than "
+                   f"{int(self.stall_warning_time_s)} seconds. This may "
+                   "indicate that different ranks are trying to submit "
+                   "different tensors or that only subset of ranks is "
+                   "submitting tensors, which will cause deadlock."]
+            for name, missing in stalled:
+                msg.append(f"Stalled op: {name} [missing ranks: "
+                           f"{', '.join(map(str, missing))}]")
+            print("\n".join(msg), file=sys.stderr)
+
+    def _fail_all(self, status: Status):
+        with self._lock:
+            entries = list(self._tensor_table.values())
+            self._tensor_table.clear()
+        for e in entries:
+            e.callback(status, None)

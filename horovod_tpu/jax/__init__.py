@@ -1,0 +1,169 @@
+"""JAX-first framework surface — the TPU-native ``hvd.DistributedOptimizer``.
+
+The reference wraps TF/torch optimizers so every gradient is allreduced
+before the update (``horovod/tensorflow/__init__.py:135-225``,
+``horovod/torch/__init__.py:42-135``).  The idiomatic JAX equivalent is an
+:mod:`optax` ``GradientTransformation`` wrapper: gradients are averaged
+across the ``ranks`` mesh axis inside the jitted update (compiling to one
+fused XLA AllReduce over ICI — fusion for free, no 64 MB buffer memcpys),
+with an eager fallback when called outside an SPMD context.
+
+Also here, mirroring the reference's startup-sync utilities:
+``broadcast_parameters`` (``horovod/torch/__init__.py:138-167``) and
+``broadcast_optimizer_state`` (``:170-263``) for pytrees, and
+``allreduce_`` / ``allgather`` / ``broadcast`` over pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from horovod_tpu import basics
+from horovod_tpu.compression import Compression, Compressor, NoneCompressor
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+
+
+def _in_spmd_context(axis_name) -> bool:
+    """True when ``axis_name`` is bound (we are under shard_map/pmap)."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except (NameError, KeyError, TypeError):
+        return False
+
+
+def _tree_eager_allreduce(tree, average: bool, name_prefix: str):
+    leaves, treedef = jax.tree.flatten(tree)
+    handles = [
+        _eager.allreduce_async(np.asarray(leaf), average=average,
+                               name=f"{name_prefix}.{i}")
+        for i, leaf in enumerate(leaves)]
+    outs = [_eager.synchronize(h) for h in handles]
+    return jax.tree.unflatten(treedef, outs)
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    axis_name=RANKS_AXIS,
+    average: bool = True,
+    compression: Compressor = NoneCompressor,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates consume rank-averaged gradients.
+
+    Inside jit/shard_map (``axis_name`` in scope) the average compiles to a
+    single XLA AllReduce; outside, gradients take the eager negotiated path.
+    ``compression`` casts to a narrow wire dtype around the reduction
+    (reference ``DistributedOptimizer(compression=...)``).
+    """
+
+    def init(params):
+        return optimizer.init(params)
+
+    def update(grads, state, params=None, **kw):
+        grads = allreduce_gradients(grads, axis_name=axis_name,
+                                    average=average, compression=compression)
+        return optimizer.update(grads, state, params, **kw)
+
+    return optax.GradientTransformation(init, update)
+
+
+def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
+                        compression: Compressor = NoneCompressor,
+                        name_prefix: str = "DistributedOptimizer.grads"):
+    """Average a gradient pytree across ranks (the allreduce-before-step
+    core of every reference DistributedOptimizer)."""
+    if _in_spmd_context(axis_name):
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+        def one(g):
+            c, ctx = compression.compress(g)
+            # Inside shard_map, jax.grad w.r.t. *replicated* params already
+            # inserts the cross-rank psum (the value's vma set is empty), so
+            # the gradient arrives pre-summed; reducing again would be wrong.
+            # Gradients w.r.t. per-rank (varying) values still need the
+            # explicit collective.
+            vma = getattr(jax.typeof(c), "vma", None)
+            already_summed = vma is not None and not any(
+                a in vma for a in axes)
+            if already_summed:
+                red = c / lax.axis_size(axis_name) if average else c
+            else:
+                red = (lax.pmean(c, axis_name) if average
+                       else lax.psum(c, axis_name))
+            return compression.decompress(red, ctx)
+        return jax.tree.map(one, grads)
+    # Eager path: compression is applied per-leaf around the negotiated op.
+    leaves, treedef = jax.tree.flatten(grads)
+    handles, ctxs = [], []
+    for i, leaf in enumerate(leaves):
+        c, ctx = compression.compress(jnp.asarray(leaf))
+        ctxs.append(ctx)
+        handles.append(_eager.allreduce_async(
+            np.asarray(c), average=average, name=f"{name_prefix}.{i}"))
+    outs = [compression.decompress(jnp.asarray(_eager.synchronize(h)), ctx)
+            for h, ctx in zip(handles, ctxs)]
+    return jax.tree.unflatten(treedef, outs)
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         name_prefix: str = "broadcast.params"):
+    """Broadcast a parameter pytree from ``root_rank`` to all ranks —
+    startup state sync (reference ``horovod/torch/__init__.py:138-167``,
+    ``BroadcastGlobalVariablesHook``)."""
+    leaves, treedef = jax.tree.flatten(params)
+    handles = [
+        _eager.broadcast_async(np.asarray(leaf), root_rank,
+                               name=f"{name_prefix}.{i}")
+        for i, leaf in enumerate(leaves)]
+    outs = []
+    for leaf, h in zip(leaves, handles):
+        out = _eager.synchronize(h)
+        out = jnp.asarray(out, dtype=jnp.result_type(leaf))
+        outs.append(out)
+    return jax.tree.unflatten(treedef, outs)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              name_prefix: str = "broadcast.opt"):
+    """Broadcast optimizer state from ``root_rank``.
+
+    The reference walks torch's state_dict, wrapping python scalars as
+    tensors and restoring their types after the broadcast
+    (``horovod/torch/__init__.py:170-263``).  An optax state is already a
+    pytree; python-int leaves (e.g. step counters) get the same
+    wrap-as-array / restore-type treatment.
+    """
+    leaves, treedef = jax.tree.flatten(opt_state)
+    out_leaves = []
+    for i, leaf in enumerate(leaves):
+        was_int = isinstance(leaf, int) and not isinstance(leaf, bool)
+        was_float = isinstance(leaf, float)
+        arr = np.asarray(leaf)
+        res = _eager.broadcast(arr, root_rank, name=f"{name_prefix}.{i}")
+        res = np.asarray(res)
+        if was_int:
+            out_leaves.append(int(res))
+        elif was_float:
+            out_leaves.append(float(res))
+        else:
+            out_leaves.append(jnp.asarray(res, dtype=arr.dtype))
+    return jax.tree.unflatten(treedef, out_leaves)
+
+
+def allreduce_(tree, *, average: bool = True, name_prefix: str = "allreduce"):
+    """Eager allreduce of an arbitrary pytree (metric averaging etc.)."""
+    return _tree_eager_allreduce(tree, average, name_prefix)
+
+
+__all__ = [
+    "DistributedOptimizer", "allreduce_gradients", "broadcast_parameters",
+    "broadcast_optimizer_state", "allreduce_", "Compression",
+]

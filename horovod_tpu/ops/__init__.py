@@ -1,0 +1,1 @@
+from horovod_tpu.ops import injit, eager  # noqa: F401

@@ -1,0 +1,157 @@
+"""Eager named-tensor collectives with async handles.
+
+This is the dynamic half of the dual-mode design (SURVEY §7.4): the
+reference's contract is that any rank may submit named tensors in any order
+and negotiation reconciles them.  These functions mirror the torch op layer
+(``horovod/torch/mpi_ops.py:86-438``): sync (``allreduce``), async
+(``allreduce_async`` → handle), plus ``poll``/``synchronize``.
+
+Per-rank contributions: a process drives all of its local chips (ranks), so
+an input is either
+
+* a single array — the same contribution from every controlled rank (how the
+  reference tests seed identical tensors on each rank), or
+* :class:`PerRank` — an explicit list with one array per controlled rank
+  (possibly ragged dim0 for allgather, mirroring ``MPI_Allgatherv``).
+
+Results are replicated ``jax.Array``s over the rank mesh.  Inside ``jit``
+use :mod:`horovod_tpu.ops.injit` instead — it compiles to bare XLA
+collectives with no negotiation at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.core import (Request, RequestType, Status, TensorTableEntry,
+                              dtype_name)
+
+
+@dataclasses.dataclass
+class PerRank:
+    """Explicit per-rank contributions (one per rank this process controls)."""
+    values: Sequence
+
+
+class CollectiveError(RuntimeError):
+    """A negotiated collective failed validation or was aborted; carries the
+    coordinator's error message (reference raises framework-level
+    errors with the same text, e.g. ``tf.errors.FailedPreconditionError``)."""
+
+
+_name_counter = [0]
+
+
+def _auto_name(prefix: str) -> str:
+    _name_counter[0] += 1
+    return f"{prefix}.noname.{_name_counter[0]}"
+
+
+def _normalize(tensor, name_prefix: str, name: Optional[str]):
+    st = basics._require_init()
+    nlocal = st.topology.local_size
+    if isinstance(tensor, PerRank):
+        vals = [np.asarray(v) for v in tensor.values]
+        if len(vals) != nlocal and len(vals) != st.topology.size:
+            raise ValueError(
+                f"PerRank needs {nlocal} values (one per controlled rank), "
+                f"got {len(vals)}")
+    else:
+        arr = np.asarray(tensor)
+        vals = [arr] * nlocal
+    return vals, (name if name is not None else _auto_name(name_prefix))
+
+
+def _submit(request_type: RequestType, tensor, name: Optional[str],
+            name_prefix: str, *, average: bool = False,
+            root_rank: int = -1) -> int:
+    ctrl = basics.controller()
+    per_rank, resolved = _normalize(tensor, name_prefix, name)
+    handle = ctrl.handle_manager.allocate()
+
+    def callback(status: Status, result):
+        ctrl.handle_manager.mark_done(handle, status, result)
+
+    entry = TensorTableEntry(
+        name=resolved,
+        request_type=request_type,
+        per_rank=per_rank,
+        dtype=dtype_name(per_rank[0].dtype),
+        root_rank=root_rank,
+        average=average,
+        callback=callback,
+    )
+    status = ctrl.enqueue(entry)
+    if not status.ok():
+        ctrl.handle_manager.mark_done(handle, status, None)
+    return handle
+
+
+# ------------------------------------------------------------------- public
+
+def allreduce_async(tensor, *, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    """Start an allreduce; returns a handle for ``poll``/``synchronize``
+    (reference ``horovod/torch/mpi_ops.py:86-135``)."""
+    return _submit(RequestType.ALLREDUCE, tensor, name, "allreduce",
+                   average=average)
+
+
+def allreduce(tensor, *, average: bool = True,
+              name: Optional[str] = None):
+    return synchronize(allreduce_async(tensor, average=average, name=name))
+
+
+def allgather_async(tensor, *, name: Optional[str] = None) -> int:
+    """Start an allgather: concat across ranks on dim0; ranks may contribute
+    different dim0 sizes (reference ``mpi_ops.py:200-260``)."""
+    return _submit(RequestType.ALLGATHER, tensor, name, "allgather")
+
+
+def allgather(tensor, *, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor, root_rank: int, *,
+                    name: Optional[str] = None) -> int:
+    """Start a broadcast of rank ``root_rank``'s value to all ranks
+    (reference ``mpi_ops.py:284-360``)."""
+    return _submit(RequestType.BROADCAST, tensor, name, "broadcast",
+                   root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank: int, *, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def poll(handle: int) -> bool:
+    """True when the async op behind ``handle`` is complete — ``synchronize``
+    will not block (reference ``mpi_ops.py:400-412``)."""
+    return basics.controller().handle_manager.poll(handle)
+
+
+def synchronize(handle: int, timeout: Optional[float] = 300.0):
+    """Wait for an async op; returns its output array or raises
+    :class:`CollectiveError` with the coordinator's message
+    (reference ``mpi_ops.py:422-438``)."""
+    hm = basics.controller().handle_manager
+    status, result = hm.wait(handle, timeout)
+    hm.release(handle)
+    if not status.ok():
+        raise CollectiveError(status.reason)
+    return result
+
+
+def scatter_ranks(values) -> PerRank:
+    """Convenience: mark an array stacked on axis0 (or a list) as per-rank
+    contributions — the TPU-native way to express "each rank has a different
+    tensor" in a single-controller program."""
+    if isinstance(values, (list, tuple)):
+        return PerRank(list(values))
+    arr = np.asarray(values)
+    return PerRank([arr[i] for i in range(arr.shape[0])])

@@ -1,0 +1,197 @@
+"""Data-plane executor: runs negotiated responses as XLA programs on the mesh.
+
+The reference's ``PerformOperation`` (``horovod/common/operations.cc:714-1362``)
+copies tensors into a fusion buffer, calls MPI/NCCL, and copies back.  The
+TPU-native data plane instead *traces* the whole fused operation — flatten,
+concat, reduce, split — as one jitted XLA program over the rank mesh, so the
+"memcpy into the fusion buffer" becomes XLA-fused HBM moves and the collective
+rides the ICI links.
+
+Responses map to programs:
+
+* fused ALLREDUCE  → stack per-rank fusion buffers → ``sum``/mean over the
+  ``ranks`` axis (XLA AllReduce) → split back into tensors
+  (replaces ``operations.cc:1232-1327``).
+* ALLGATHER        → rank-ordered concat along dim0, sizes taken from the
+  negotiated ``tensor_sizes`` (replaces ``MPI_Allgatherv``,
+  ``operations.cc:796-856``).
+* BROADCAST        → root rank's value replicated (replaces ``MPI_Bcast``,
+  ``operations.cc:1333-1353``).
+* ERROR            → callbacks fired with PRECONDITION_ERROR carrying the
+  coordinator's message (``operations.cc:1354-1361``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.core import (Response, ResponseType, Status, StatusType,
+                              TensorTableEntry)
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_fn(mesh, length: int, dtype: str, average: bool, nranks: int):
+    """Jitted fused-buffer reduction: (nranks, length) sharded over ranks →
+    (length,) replicated.  Cached per (shape, dtype, op) like the reference's
+    reusable fusion buffers (``operations.cc:149-165``)."""
+    in_sharding = NamedSharding(mesh, P(RANKS_AXIS))
+    out_sharding = NamedSharding(mesh, P())
+
+    def fn(stacked):
+        # dtype-preserving sum: MPI_Allreduce keeps the element type
+        # (small ints wrap), unlike jnp.sum's default promotion.
+        total = jnp.sum(stacked, axis=0, dtype=stacked.dtype)
+        if average:
+            if jnp.issubdtype(stacked.dtype, jnp.floating):
+                total = total / nranks
+            else:
+                total = total // nranks
+        return total
+
+    return jax.jit(fn, in_shardings=in_sharding, out_shardings=out_sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _replicate_sharding(mesh):
+    return NamedSharding(mesh, P())
+
+
+def _needs_host_path(dtype) -> bool:
+    """64-bit element types cannot be represented on the accelerator unless
+    x64 is enabled — reduce them on the host instead.  This mirrors the
+    reference's split between the CPU/MPI data plane and the GPU/NCCL data
+    plane (``operations.cc:1232-1327`` vs ``:879-1229``): host-only dtypes
+    take the host plane, everything else rides the mesh."""
+    return np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64
+
+
+class Executor:
+    def __init__(self, topology, mesh, timeline=None):
+        self.topology = topology
+        self.mesh = mesh
+        self.timeline = timeline
+        self.nranks = topology.size
+
+    # ----------------------------------------------------------------- entry
+
+    def execute(self, response: Response, entries: List[TensorTableEntry]):
+        if self.timeline:
+            for e in entries:
+                self.timeline.start(e.name, response.response_type)
+        try:
+            if response.response_type == ResponseType.ERROR:
+                status = Status(StatusType.PRECONDITION_ERROR,
+                                response.error_message)
+                for e in entries:
+                    e.callback(status, None)
+                return
+            if response.response_type == ResponseType.ALLREDUCE:
+                self._allreduce(response, entries)
+            elif response.response_type == ResponseType.ALLGATHER:
+                self._allgather(response, entries)
+            elif response.response_type == ResponseType.BROADCAST:
+                self._broadcast(response, entries)
+            else:
+                raise ValueError(f"bad response type {response.response_type}")
+        except Exception as exc:   # noqa: BLE001 — propagate as status
+            status = Status(StatusType.UNKNOWN_ERROR, repr(exc))
+            for e in entries:
+                e.callback(status, None)
+        finally:
+            if self.timeline:
+                for e in entries:
+                    self.timeline.end(e.name)
+
+    # ------------------------------------------------------------- allreduce
+
+    def _allreduce(self, response: Response, entries: List[TensorTableEntry]):
+        """Fused allreduce of all entries in ``response.tensor_names``."""
+        nranks = self.nranks
+        average = entries[0].average
+        dtype = np.dtype(entries[0].dtype)
+
+        if self.timeline:
+            self.timeline.activity_start_all(entries, "MEMCPY_IN_FUSION_BUFFER")
+        # Per-rank fusion buffer: flatten + concat this rank's contributions.
+        per_rank_flat = []
+        for r in range(nranks):
+            flats = [np.asarray(e.per_rank[r]).reshape(-1) for e in entries]
+            per_rank_flat.append(
+                np.concatenate(flats) if len(flats) > 1 else flats[0])
+        stacked = np.stack(per_rank_flat)           # (nranks, L)
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+            self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
+
+        if _needs_host_path(dtype):
+            reduced = stacked.sum(axis=0, dtype=stacked.dtype)
+            if average:
+                if np.issubdtype(stacked.dtype, np.floating):
+                    reduced = (reduced / nranks).astype(stacked.dtype)
+                else:
+                    reduced = reduced // nranks
+        else:
+            fn = _reduce_fn(self.mesh, stacked.shape[1], str(dtype), average,
+                            nranks)
+            reduced = fn(jax.device_put(
+                stacked, NamedSharding(self.mesh, P(RANKS_AXIS))))
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+            self.timeline.activity_start_all(entries,
+                                             "MEMCPY_OUT_FUSION_BUFFER")
+        offset = 0
+        for e in entries:
+            n = int(np.prod(e.per_rank[0].shape))
+            out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
+            offset += n
+            e.callback(Status.OK(), out)
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+
+    # ------------------------------------------------------------- allgather
+
+    def _allgather(self, response: Response, entries: List[TensorTableEntry]):
+        """Rank-ordered concat along dim0; per-rank dim0 sizes come from the
+        negotiated response (ragged shapes are legal, unlike inside jit)."""
+        for e in entries:
+            if self.timeline:
+                self.timeline.activity_start_all([e], "XLA_ALLGATHER")
+            parts = [np.asarray(a) for a in e.per_rank]
+            gathered = np.concatenate(parts, axis=0)
+            if _needs_host_path(gathered.dtype):
+                out = gathered
+            else:
+                out = jax.device_put(gathered, _replicate_sharding(self.mesh))
+            if self.timeline:
+                self.timeline.activity_end_all([e])
+            e.callback(Status.OK(), out)
+
+    # ------------------------------------------------------------- broadcast
+
+    def _broadcast(self, response: Response, entries: List[TensorTableEntry]):
+        first_rank = self.topology.rank
+        for e in entries:
+            if self.timeline:
+                self.timeline.activity_start_all([e], "XLA_BROADCAST")
+            root_local = e.root_rank - first_rank
+            if not 0 <= root_local < len(e.per_rank):
+                # Multi-process: the root's data lives on another process and
+                # arrives via the mesh collective; single-process: root must
+                # be one of our ranks.
+                raise ValueError(
+                    f"root rank {e.root_rank} not controlled by this process")
+            data = np.asarray(e.per_rank[root_local])
+            if _needs_host_path(data.dtype):
+                out = data.copy()
+            else:
+                out = jax.device_put(data, _replicate_sharding(self.mesh))
+            if self.timeline:
+                self.timeline.activity_end_all([e])
+            e.callback(Status.OK(), out)

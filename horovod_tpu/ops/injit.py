@@ -1,0 +1,113 @@
+"""Collectives for use *inside* ``jit``/``shard_map`` — the static SPMD path.
+
+The reference executes every collective through a dynamic negotiation
+(enqueue → coordinator → MPI/NCCL call, ``horovod/common/operations.cc``).
+Inside an XLA program none of that is needed: program order is identical on
+every rank by construction, so a collective is just an op.  These wrappers
+lower straight to XLA's AllReduce / AllGather / CollectivePermute over the
+ICI mesh and exist to give the reference's op surface (names, averaging,
+gradient semantics) a TPU-native home:
+
+* ``allreduce``  ↔ ``MPI_Allreduce``/``ncclAllReduce`` paths
+  (``operations.cc:1268-1281, 1179-1187``); gradient of allreduce is
+  allreduce (reference ``horovod/tensorflow/mpi_ops.py:93-124``) — linearity
+  gives JAX that for free.
+* ``allgather``  ↔ ``MPI_Allgatherv`` (``operations.cc:796-856``); gradient
+  is reduce-scatter = "allreduce then slice by rank offset"
+  (``mpi_ops.py:126-164``), which is exactly the transpose XLA derives.
+* ``broadcast``  ↔ ``MPI_Bcast`` (``operations.cc:1333-1353``); implemented
+  as a masked psum so its JAX-derived gradient is "allreduce, zeroed on
+  non-root ranks" — matching the registered gradient at
+  ``mpi_ops.py:167-182``.
+
+All take ``axis_name`` (default ``'ranks'``, the world mesh axis) and work
+under ``shard_map``/``pmap`` with that axis in scope.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+
+AxisName = Union[str, Sequence[str]]
+
+# Reduction op names, mirroring hvd's average flag plus MPI-style ops.
+SUM = "sum"
+AVERAGE = "average"
+MIN = "min"
+MAX = "max"
+
+
+def num_ranks(axis_name: AxisName = RANKS_AXIS):
+    return lax.axis_size(axis_name)
+
+
+def rank_index(axis_name: AxisName = RANKS_AXIS):
+    return lax.axis_index(axis_name)
+
+
+def allreduce(x, *, average: bool = True, op: Optional[str] = None,
+              axis_name: AxisName = RANKS_AXIS):
+    """Sum (or average/min/max) ``x`` across ranks; every rank gets the result.
+
+    ``average=True`` matches the reference default where gradients are
+    averaged rather than summed (``horovod/tensorflow/__init__.py:45-66``).
+    """
+    if op is None:
+        op = AVERAGE if average else SUM
+    if op == AVERAGE:
+        return lax.pmean(x, axis_name)
+    if op == SUM:
+        return lax.psum(x, axis_name)
+    if op == MIN:
+        return lax.pmin(x, axis_name)
+    if op == MAX:
+        return lax.pmax(x, axis_name)
+    raise ValueError(f"unknown reduction op: {op!r}")
+
+
+def allgather(x, *, axis_name: AxisName = RANKS_AXIS, axis: int = 0):
+    """Concatenate ``x`` from all ranks along ``axis`` (default 0), like the
+    reference's allgather contract: same shape on all ranks except possibly
+    dim0 (ragged dim0 is an eager-path feature; inside jit shapes are static
+    and uniform)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def broadcast(x, root_rank: int, *, axis_name: AxisName = RANKS_AXIS):
+    """Every rank receives rank ``root_rank``'s value of ``x``.
+
+    Masked-psum formulation: its autodiff transpose is psum of the cotangent
+    with non-root ranks zeroed — the exact registered gradient of the
+    reference (``horovod/tensorflow/mpi_ops.py:167-182``).
+    """
+    idx = lax.axis_index(axis_name)
+    mask = (idx == root_rank).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def reducescatter(x, *, average: bool = False,
+                  axis_name: AxisName = RANKS_AXIS, axis: int = 0):
+    """Reduce across ranks and scatter equal chunks of ``axis`` to each rank.
+
+    Not in the reference's public op set but it is the building block of its
+    hierarchical allreduce (``ncclReduceScatter``, ``operations.cc:1090``);
+    exposed because it is also the ZeRO-style primitive users expect.
+    """
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    if average:
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+def alltoall(x, *, axis_name: AxisName = RANKS_AXIS,
+             split_axis: int = 0, concat_axis: int = 0):
+    """All-to-all over the mesh axis (sequence/expert parallel building
+    block; beyond the reference's three ops but first-class here)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)

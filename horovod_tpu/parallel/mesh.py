@@ -1,0 +1,79 @@
+"""Device-mesh construction — the TPU-native replacement for MPI communicators.
+
+The reference forms three MPI communicators: world (dup), node-local
+(``MPI_Comm_split_type(SHARED)``) and cross-node (split by local_rank)
+(``horovod/common/operations.cc:1487-1532``).  On TPU the analogous structure
+is a :class:`jax.sharding.Mesh`:
+
+* 1-D ``('ranks',)`` mesh over every chip — the world communicator.
+* 2-D ``('dcn', 'ici')`` mesh — the hierarchical split: ``ici`` spans chips
+  that share a slice (fast ICI links, like NCCL-intra-node) and ``dcn`` spans
+  slices/hosts (data-center network, like MPI-inter-node).  The hierarchical
+  allreduce (:mod:`horovod_tpu.parallel.hierarchical`) reduces over these two
+  axes in sequence, mirroring ``operations.cc:1025-1177``.
+
+XLA inserts the actual collectives; laying the mesh out so that the minor
+axis follows physical ICI neighbours is what keeps them on ICI instead of DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from horovod_tpu.topology import Topology
+
+RANKS_AXIS = "ranks"
+ICI_AXIS = "ici"
+DCN_AXIS = "dcn"
+
+
+def build_ranks_mesh(topology: Topology) -> Mesh:
+    """World communicator: 1-D mesh over all participating chips."""
+    devs = np.asarray(topology.devices, dtype=object)
+    return Mesh(devs, axis_names=(RANKS_AXIS,))
+
+
+def build_hierarchical_mesh(
+    topology: Topology,
+    ici_size: Optional[int] = None,
+) -> Mesh:
+    """Two-level ``('dcn', 'ici')`` mesh.
+
+    ``ici_size`` defaults to the number of chips per process (one process per
+    host/slice), so ``ici`` groups chips with fast interconnect and ``dcn``
+    spans groups — the TPU analogue of the reference's
+    ``local_comm``/``cross_comm`` pair (``operations.cc:1499-1532``).
+    """
+    n = topology.size
+    if ici_size is None:
+        ici_size = topology.local_size
+    if n % ici_size != 0:
+        raise ValueError(
+            f"total ranks {n} not divisible by ici group size {ici_size}; "
+            "hierarchical collectives need a homogeneous topology "
+            "(reference operations.cc:1511-1525 makes the same check)")
+    devs = np.asarray(topology.devices, dtype=object).reshape(
+        n // ici_size, ici_size)
+    return Mesh(devs, axis_names=(DCN_AXIS, ICI_AXIS))
+
+
+def build_mesh(
+    topology: Topology,
+    shape: Sequence[int],
+    axis_names: Sequence[str],
+) -> Mesh:
+    """General mesh over the job's chips in rank order (for dp/tp/pp/sp/ep
+    layouts of model code built on this framework)."""
+    if int(np.prod(shape)) != topology.size:
+        raise ValueError(
+            f"mesh shape {tuple(shape)} does not cover {topology.size} chips")
+    devs = np.asarray(topology.devices, dtype=object).reshape(tuple(shape))
+    return Mesh(devs, axis_names=tuple(axis_names))
+
+
+def abstract_mesh_like(mesh: Mesh) -> jax.sharding.AbstractMesh:
+    return jax.sharding.AbstractMesh(mesh.shape_tuple, mesh.axis_names)

@@ -1,0 +1,115 @@
+"""Chrome-tracing timeline — the reference's Horovod Timeline on TPU.
+
+Mirrors ``horovod/common/timeline.{h,cc}``: each named tensor is modelled as
+a trace "process" (metadata event naming it); spans cover the negotiation
+phase (NEGOTIATE_ALLREDUCE etc. with per-rank instant events), the top-level
+operation, and nested activities (QUEUE, MEMCPY_IN_FUSION_BUFFER,
+XLA_ALLREDUCE, ...).  Opened on rank 0 only, when ``HOROVOD_TPU_TIMELINE``
+is set (reference ``operations.cc:1556-1560``).  Output loads in
+``chrome://tracing`` / Perfetto.
+
+This complements (does not replace) the XLA profiler: it shows the
+control-plane life cycle of every named tensor, which device-side profiles
+cannot see.
+
+A C++ implementation with identical output lives in ``cpp/timeline.{h,cc}``
+and is used when the native core is loaded; this module is the fallback and
+the format specification.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Timeline:
+    FLUSH_EVERY_S = 1.0   # reference timeline.h:32
+
+    def __init__(self, path: str):
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._tensor_pids: Dict[str, int] = {}
+        self._next_pid = 1
+        self._last_flush = time.monotonic()
+        self._closed = False
+
+    # ----------------------------------------------------------- primitives
+
+    def _ts_us(self) -> int:
+        return int((time.monotonic() - self._t0) * 1e6)
+
+    def _emit(self, ev: dict):
+        with self._lock:
+            if self._closed:
+                return
+            self._file.write(json.dumps(ev) + ",\n")
+            now = time.monotonic()
+            if now - self._last_flush > self.FLUSH_EVERY_S:
+                self._file.flush()
+                self._last_flush = now
+
+    def _pid(self, tensor_name: str) -> int:
+        with self._lock:
+            pid = self._tensor_pids.get(tensor_name)
+            if pid is None:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._tensor_pids[tensor_name] = pid
+        if pid == self._next_pid - 1:
+            # Metadata event registering the tensor as a trace process
+            # (reference timeline.cc:51-68).
+            self._emit({"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": tensor_name}})
+            self._emit({"name": "process_sort_index", "ph": "M", "pid": pid,
+                        "args": {"sort_index": pid}})
+        return pid
+
+    # ---------------------------------------------------------- negotiation
+
+    def negotiate_start(self, tensor_name: str, request_type) -> None:
+        from horovod_tpu.core import request_type_name
+        self._emit({"ph": "B", "pid": self._pid(tensor_name),
+                    "ts": self._ts_us(),
+                    "name": f"NEGOTIATE_{request_type_name(request_type)}"})
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        self._emit({"ph": "i", "pid": self._pid(tensor_name),
+                    "ts": self._ts_us(), "s": "p", "name": str(rank)})
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit({"ph": "E", "pid": self._pid(tensor_name),
+                    "ts": self._ts_us()})
+
+    # ------------------------------------------------------------ operation
+
+    def start(self, tensor_name: str, response_type) -> None:
+        name = {0: "ALLREDUCE", 1: "ALLGATHER", 2: "BROADCAST",
+                3: "ERROR"}.get(int(response_type), "UNKNOWN")
+        self._emit({"ph": "B", "pid": self._pid(tensor_name),
+                    "ts": self._ts_us(), "name": name})
+
+    def end(self, tensor_name: str) -> None:
+        self._emit({"ph": "E", "pid": self._pid(tensor_name),
+                    "ts": self._ts_us()})
+
+    def activity_start_all(self, entries, activity: str) -> None:
+        for e in entries:
+            self._emit({"ph": "B", "pid": self._pid(e.name),
+                        "ts": self._ts_us(), "name": activity})
+
+    def activity_end_all(self, entries) -> None:
+        for e in entries:
+            self._emit({"ph": "E", "pid": self._pid(e.name),
+                        "ts": self._ts_us()})
+
+    def close(self):
+        with self._lock:
+            if not self._closed:
+                self._file.write("{}]\n")
+                self._file.close()
+                self._closed = True

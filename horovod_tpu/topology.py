@@ -1,0 +1,132 @@
+"""Topology resolution for the TPU pod — replaces mpirun/MPI_COMM_WORLD.
+
+The reference (Horovod v0.15.1) derives rank/size from MPI launched by
+``mpirun`` and discovers node-locality via ``MPI_Comm_split_type(SHARED)``
+(reference ``horovod/common/operations.cc:1469-1532``).  On TPU the topology
+is a property of the pod runtime itself: JAX already knows how many chips
+exist, which process owns which chips, and how processes map onto hosts.
+
+TPU-native rank model (SPMD, one rank per chip):
+
+* ``size``        — total number of participating devices (chips) in the job.
+* ``local_size``  — number of chips attached to this process.
+* ``rank``        — global index of this process's first chip.  With one
+                    process per host this is the conventional "am I the
+                    checkpointing process" identity (rank 0 == coordinator),
+                    mirroring Horovod's ``hvd.rank()`` usage.
+* ``local_rank``  — this process's index among processes on the same host
+                    (0 for the single-process-per-host norm on TPU).
+
+A single Python process drives all of its local chips (single-controller or
+multi-controller SPMD); collectives therefore reduce over *devices*, and the
+control plane (negotiation) runs per *process* with process 0 as coordinator,
+mirroring Horovod's rank-0 coordinator (``operations.cc:1665-1693``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable snapshot of the job topology at init time."""
+
+    devices: Tuple[jax.Device, ...]          # all participating devices, rank order
+    local_devices: Tuple[jax.Device, ...]    # devices owned by this process
+    process_index: int
+    process_count: int
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def rank(self) -> int:
+        """Global rank of this process's first device."""
+        first = self.local_devices[0]
+        for i, d in enumerate(self.devices):
+            if d.id == first.id:
+                return i
+        raise RuntimeError("local device not found in global device list")
+
+    @property
+    def local_rank(self) -> int:
+        """Index of this process among processes on the same host.
+
+        On TPU pods there is one process per host, so this is almost always 0;
+        kept for API parity with the reference
+        (``horovod/common/__init__.py:103-117``).
+        """
+        # Processes are numbered contiguously per host by the TPU runtime.
+        host_procs = self._processes_on_my_host()
+        return host_procs.index(self.process_index)
+
+    def _processes_on_my_host(self) -> list:
+        # JAX does not expose host grouping directly; processes sharing a host
+        # share device.host_id/process_index on TPU.  Best effort: group
+        # processes by the host of their devices.
+        by_proc = {}
+        for d in self.devices:
+            by_proc.setdefault(d.process_index, d)
+        # Treat processes with consecutive indices and the same platform as
+        # host-local only when the runtime says so; default: each process its
+        # own host slot.
+        return [self.process_index]
+
+    @property
+    def local_rank_device_ids(self) -> Tuple[int, ...]:
+        return tuple(d.id for d in self.local_devices)
+
+    def device_rank(self, device: jax.Device) -> int:
+        for i, d in enumerate(self.devices):
+            if d.id == device.id:
+                return i
+        raise KeyError(f"device {device} not in topology")
+
+
+def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
+    """Resolve the job topology from the JAX runtime.
+
+    ``ranks`` optionally restricts participation to a subset of global device
+    ranks, mirroring ``hvd.init(comm=[0, 1, ...])``'s subset-communicator
+    support (reference ``horovod/common/__init__.py:58-68``,
+    ``operations.cc:1469-1483``).
+    """
+    all_devices = tuple(jax.devices())
+    if ranks is not None:
+        ranks = list(ranks)
+        if sorted(set(ranks)) != sorted(ranks):
+            raise ValueError("duplicate ranks in subset")
+        if any(r < 0 or r >= len(all_devices) for r in ranks):
+            raise ValueError(
+                f"rank subset {ranks} out of range for {len(all_devices)} devices")
+        devices = tuple(all_devices[r] for r in ranks)
+    else:
+        devices = all_devices
+    local = tuple(d for d in devices if d.process_index == jax.process_index())
+    if not local:
+        raise RuntimeError(
+            "this process owns no devices in the requested rank subset")
+    return Topology(
+        devices=devices,
+        local_devices=local,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
+def mesh_devices(topology: Topology, shape: Tuple[int, ...]) -> np.ndarray:
+    """Reshape the rank-ordered device list into a mesh array."""
+    n = int(np.prod(shape))
+    if n != topology.size:
+        raise ValueError(f"mesh shape {shape} does not cover {topology.size} devices")
+    return np.asarray(topology.devices, dtype=object).reshape(shape)

@@ -1,0 +1,35 @@
+"""Test fixture: 8 virtual CPU devices stand in for an 8-chip TPU slice.
+
+The reference runs every test both single-process and under ``mpirun -np 2``
+(SURVEY §4).  The TPU-native equivalent: force the host platform to expose 8
+XLA CPU devices so the rank mesh, shardings, and collectives execute exactly
+as they would across chips; separate multi-process tests (test_multiprocess*)
+launch real extra processes over the distributed control plane.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize imports jax at interpreter startup (before
+# this conftest), so JAX_PLATFORMS from the environment was already captured;
+# override through the config API as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    # State is process-global; leave initialized across tests for speed.

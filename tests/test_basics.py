@@ -1,0 +1,40 @@
+"""Basics API: init/rank/size semantics.
+
+Mirrors the reference's rank/size tests (``test/test_tensorflow.py:42-54``)
+and the uninitialized-raise contract (``horovod/common/__init__.py:90-154``).
+"""
+
+import pytest
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd
+    if hvd.is_initialized():
+        pytest.skip("already initialized by another test")
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.size()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.rank()
+
+
+def test_rank_and_size(hvd):
+    assert hvd.size() == 8          # forced host platform device count
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.process_count() == 1
+
+
+def test_mesh(hvd):
+    mesh = hvd.ranks_mesh()
+    assert mesh.axis_names == ("ranks",)
+    assert mesh.devices.size == 8
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_mpi_threads_supported(hvd):
+    assert hvd.mpi_threads_supported() is True
