@@ -74,6 +74,12 @@ class Status:
         return Status(StatusType.INVALID_ARGUMENT, msg)
 
 
+def env_flag(name: str) -> bool:
+    """0/1-convention env flag (the reference treats any set value as true
+    but documents 0/1; '0'/'false'/'' stay false here to avoid surprises)."""
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
+
+
 SHUT_DOWN_ERROR = Status.aborted(
     "Horovod has been shut down. This has been caused by an exception on one "
     "of the ranks or an attempt to allreduce, allgather or broadcast a tensor "
@@ -164,6 +170,9 @@ class MessageTable:
 
     def __len__(self):
         return len(self._table)
+
+    def clear(self):
+        self._table.clear()
 
     def increment(self, msg: Request) -> bool:
         """Record one rank's request; True when all ranks have reported."""
@@ -429,8 +438,8 @@ class Controller:
             os.environ.get("HOROVOD_TPU_FUSION_THRESHOLD",
                            str(DEFAULT_FUSION_THRESHOLD)))
         self.stall_warning_time_s = 60.0
-        self.stall_check_disabled = bool(
-            os.environ.get("HOROVOD_TPU_STALL_CHECK_DISABLE", ""))
+        self.stall_check_disabled = env_flag(
+            "HOROVOD_TPU_STALL_CHECK_DISABLE")
 
         self.timeline = None
         timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")
@@ -461,7 +470,8 @@ class Controller:
     def stop(self):
         """Coordinated shutdown: outstanding entries get SHUT_DOWN_ERROR
         (reference ``operations.cc:1647-1662``)."""
-        self._shutdown.set()
+        with self._lock:
+            self._shutdown.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -478,8 +488,6 @@ class Controller:
         """Framework-thread side: register tensor data and queue one request
         per controlled rank (reference ``EnqueueTensorAllreduce`` et al.,
         ``operations.cc:2025-2141``)."""
-        if self._shutdown.is_set():
-            return SHUT_DOWN_ERROR
         first_rank = self.topology.rank
         requests = []
         for i, contrib in enumerate(entry.per_rank):
@@ -493,6 +501,10 @@ class Controller:
                 device=first_rank + i,
             ))
         with self._lock:
+            # Shutdown is checked under the same lock stop() takes while
+            # draining, so an entry can never land in a dead controller.
+            if self._shutdown.is_set():
+                return SHUT_DOWN_ERROR
             if entry.name in self._tensor_table:
                 return Status.invalid_argument(
                     f"Duplicate tensor name in queue: {entry.name}. "
@@ -580,5 +592,9 @@ class Controller:
         with self._lock:
             entries = list(self._tensor_table.values())
             self._tensor_table.clear()
+            self._message_queue.clear()
+            # Stale negotiation state would poison later reuse of the same
+            # tensor names (the readiness count could overshoot `size`).
+            self._message_table.clear()
         for e in entries:
             e.callback(status, None)

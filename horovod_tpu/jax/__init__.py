@@ -39,16 +39,6 @@ def _in_spmd_context(axis_name) -> bool:
         return False
 
 
-def _tree_eager_allreduce(tree, average: bool, name_prefix: str):
-    leaves, treedef = jax.tree.flatten(tree)
-    handles = [
-        _eager.allreduce_async(np.asarray(leaf), average=average,
-                               name=f"{name_prefix}.{i}")
-        for i, leaf in enumerate(leaves)]
-    outs = [_eager.synchronize(h) for h in handles]
-    return jax.tree.unflatten(treedef, outs)
-
-
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
@@ -160,7 +150,8 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
 
 def allreduce_(tree, *, average: bool = True, name_prefix: str = "allreduce"):
     """Eager allreduce of an arbitrary pytree (metric averaging etc.)."""
-    return _tree_eager_allreduce(tree, average, name_prefix)
+    return allreduce_gradients(tree, average=average,
+                               name_prefix=name_prefix)
 
 
 __all__ = [

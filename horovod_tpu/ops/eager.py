@@ -140,8 +140,10 @@ def synchronize(handle: int, timeout: Optional[float] = 300.0):
     :class:`CollectiveError` with the coordinator's message
     (reference ``mpi_ops.py:422-438``)."""
     hm = basics.controller().handle_manager
-    status, result = hm.wait(handle, timeout)
-    hm.release(handle)
+    try:
+        status, result = hm.wait(handle, timeout)
+    finally:
+        hm.release(handle)
     if not status.ok():
         raise CollectiveError(status.reason)
     return result

@@ -37,25 +37,29 @@ from horovod_tpu.parallel.mesh import RANKS_AXIS
 
 
 @functools.lru_cache(maxsize=None)
-def _reduce_fn(mesh, length: int, dtype: str, average: bool, nranks: int):
+def _reduce_fn(mesh, length: int, dtype: str):
     """Jitted fused-buffer reduction: (nranks, length) sharded over ranks →
-    (length,) replicated.  Cached per (shape, dtype, op) like the reference's
-    reusable fusion buffers (``operations.cc:149-165``)."""
+    (length,) replicated.  Cached per (shape, dtype) like the reference's
+    reusable fusion buffers (``operations.cc:149-165``).  Always sums:
+    averaging is applied per tensor in the completion layer, exactly like
+    the reference (``mpi_ops_v2.cc:65-71`` divides in the callback) — which
+    is also what lets tensors with different ``average`` flags share a
+    fusion buffer."""
     in_sharding = NamedSharding(mesh, P(RANKS_AXIS))
     out_sharding = NamedSharding(mesh, P())
 
     def fn(stacked):
         # dtype-preserving sum: MPI_Allreduce keeps the element type
         # (small ints wrap), unlike jnp.sum's default promotion.
-        total = jnp.sum(stacked, axis=0, dtype=stacked.dtype)
-        if average:
-            if jnp.issubdtype(stacked.dtype, jnp.floating):
-                total = total / nranks
-            else:
-                total = total // nranks
-        return total
+        return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
 
     return jax.jit(fn, in_shardings=in_sharding, out_shardings=out_sharding)
+
+
+def _apply_average(out, nranks: int):
+    if jnp.issubdtype(out.dtype, jnp.floating):
+        return out / nranks
+    return out // nranks
 
 
 @functools.lru_cache(maxsize=None)
@@ -114,7 +118,6 @@ class Executor:
     def _allreduce(self, response: Response, entries: List[TensorTableEntry]):
         """Fused allreduce of all entries in ``response.tensor_names``."""
         nranks = self.nranks
-        average = entries[0].average
         dtype = np.dtype(entries[0].dtype)
 
         if self.timeline:
@@ -132,14 +135,8 @@ class Executor:
 
         if _needs_host_path(dtype):
             reduced = stacked.sum(axis=0, dtype=stacked.dtype)
-            if average:
-                if np.issubdtype(stacked.dtype, np.floating):
-                    reduced = (reduced / nranks).astype(stacked.dtype)
-                else:
-                    reduced = reduced // nranks
         else:
-            fn = _reduce_fn(self.mesh, stacked.shape[1], str(dtype), average,
-                            nranks)
+            fn = _reduce_fn(self.mesh, stacked.shape[1], str(dtype))
             reduced = fn(jax.device_put(
                 stacked, NamedSharding(self.mesh, P(RANKS_AXIS))))
         if self.timeline:
@@ -151,6 +148,15 @@ class Executor:
             n = int(np.prod(e.per_rank[0].shape))
             out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
             offset += n
+            if e.average:
+                # Per-tensor division in the completion layer, like the
+                # reference's callback (mpi_ops_v2.cc:65-71); float divides,
+                # ints floor-divide (torch div_ semantics on old int types).
+                if np.issubdtype(np.dtype(e.dtype), np.floating):
+                    out = (out / nranks).astype(e.dtype) \
+                        if isinstance(out, np.ndarray) else out / nranks
+                else:
+                    out = out // nranks
             e.callback(Status.OK(), out)
         if self.timeline:
             self.timeline.activity_end_all(entries)

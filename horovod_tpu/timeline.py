@@ -56,13 +56,14 @@ class Timeline:
     def _pid(self, tensor_name: str) -> int:
         with self._lock:
             pid = self._tensor_pids.get(tensor_name)
-            if pid is None:
+            created = pid is None
+            if created:
                 pid = self._next_pid
                 self._next_pid += 1
                 self._tensor_pids[tensor_name] = pid
-        if pid == self._next_pid - 1:
+        if created:
             # Metadata event registering the tensor as a trace process
-            # (reference timeline.cc:51-68).
+            # (reference timeline.cc:51-68); emitted exactly once per tensor.
             self._emit({"name": "process_name", "ph": "M", "pid": pid,
                         "args": {"name": tensor_name}})
             self._emit({"name": "process_sort_index", "ph": "M", "pid": pid,

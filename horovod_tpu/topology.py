@@ -62,25 +62,14 @@ class Topology:
     def local_rank(self) -> int:
         """Index of this process among processes on the same host.
 
-        On TPU pods there is one process per host, so this is almost always 0;
-        kept for API parity with the reference
+        TPU pods run one process per host, so this is 0 unless a launcher
+        that packs several processes per host sets
+        ``HOROVOD_TPU_LOCAL_RANK`` explicitly (JAX does not expose host
+        grouping).  Kept for API parity with the reference
         (``horovod/common/__init__.py:103-117``).
         """
-        # Processes are numbered contiguously per host by the TPU runtime.
-        host_procs = self._processes_on_my_host()
-        return host_procs.index(self.process_index)
-
-    def _processes_on_my_host(self) -> list:
-        # JAX does not expose host grouping directly; processes sharing a host
-        # share device.host_id/process_index on TPU.  Best effort: group
-        # processes by the host of their devices.
-        by_proc = {}
-        for d in self.devices:
-            by_proc.setdefault(d.process_index, d)
-        # Treat processes with consecutive indices and the same platform as
-        # host-local only when the runtime says so; default: each process its
-        # own host slot.
-        return [self.process_index]
+        import os
+        return int(os.environ.get("HOROVOD_TPU_LOCAL_RANK", "0"))
 
     @property
     def local_rank_device_ids(self) -> Tuple[int, ...]:

@@ -64,6 +64,21 @@ def test_allreduce_async_fused(hvd):
         np.testing.assert_allclose(np.asarray(out), tensors[i] * n)
 
 
+def test_mixed_average_flags_fuse_correctly(hvd):
+    """Tensors with different average flags may share a fusion buffer; the
+    division happens per tensor in the completion layer (reference
+    ``mpi_ops_v2.cc:65-71``)."""
+    n = hvd.size()
+    ha = hvd.allreduce_async(np.full((4,), 2.0, np.float32), average=True,
+                             name="mix.avg")
+    hb = hvd.allreduce_async(np.full((4,), 2.0, np.float32), average=False,
+                             name="mix.sum")
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(ha)),
+                               np.full((4,), 2.0))
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(hb)),
+                               np.full((4,), 2.0 * n))
+
+
 def test_poll_then_synchronize(hvd):
     import time
     h = hvd.allreduce_async(np.ones(5, np.float32), average=False,
