@@ -1,0 +1,103 @@
+"""Synthetic ResNet-50 benchmark — prints ONE JSON line for the driver.
+
+TPU-native counterpart of the reference's benchmark harness
+(``examples/pytorch_synthetic_benchmark.py:93-110``): synthetic data, full
+training step (forward + backward + gradient allreduce + SGD update),
+img/sec measured over timed iterations after warmup.
+
+Baseline anchor: the reference publishes 1656.82 images/sec total for
+ResNet-101 on 16 Pascal GPUs = 103.55 img/sec/device
+(``docs/benchmarks.md:22-39``); per BASELINE.json the judged metric is
+images/sec/chip on ResNet-50, so ``vs_baseline`` is img/sec/chip divided by
+that per-device anchor.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_PER_DEVICE = 1656.82 / 16.0   # reference docs/benchmarks.md:22-39
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.jax.spmd import make_train_step
+    from horovod_tpu.models import ResNet50
+
+    hvd.init()
+    mesh = hvd.ranks_mesh()
+    nchips = hvd.size()
+
+    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    warmup_iters = int(os.environ.get("BENCH_WARMUP", "5"))
+    timed_batches = int(os.environ.get("BENCH_ITERS", "30"))
+    batch = batch_per_chip * nchips
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(42)
+    # Generate the global batch already sharded over the mesh so no single
+    # chip ever holds it (the reference generates per-rank data locally,
+    # examples/pytorch_synthetic_benchmark.py:60-63).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    batch_sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+    @functools.partial(jax.jit, out_shardings=(batch_sharding, batch_sharding))
+    def make_batch(rng):
+        images = jax.random.normal(
+            rng, (batch, image_size, image_size, 3), jnp.bfloat16)
+        labels = jnp.zeros((batch,), jnp.int32)
+        return images, labels
+
+    images, labels = make_batch(rng)
+    variables = model.init(rng, images[:1], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch_stats, batch):
+        imgs, lbls = batch
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": batch_stats}, imgs,
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, lbls).mean()
+        return loss, mut["batch_stats"]
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    step = make_train_step(loss_fn, tx, mesh, sync_aux_state=(nchips > 1))
+
+    data = (images, labels)   # already mesh-sharded
+    for _ in range(warmup_iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, data)
+    # A host read is the only sync that provably waits for execution
+    # (block_until_ready alone can return early on tunneled platforms).
+    np.asarray(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_batches):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, data)
+    np.asarray(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * timed_batches / dt
+    per_chip = img_per_sec / nchips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

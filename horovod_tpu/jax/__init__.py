@@ -67,24 +67,30 @@ def DistributedOptimizer(
 
 def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
                         compression: Compressor = NoneCompressor,
-                        name_prefix: str = "DistributedOptimizer.grads"):
+                        name_prefix: str = "DistributedOptimizer.grads",
+                        grads_hint: bool = True):
     """Average a gradient pytree across ranks (the allreduce-before-step
-    core of every reference DistributedOptimizer)."""
+    core of every reference DistributedOptimizer).
+
+    ``grads_hint`` tells the SPMD path how to treat values that are
+    *unvaried* over the mesh axes: gradients of replicated params arrive
+    pre-summed (jax.grad inserted the psum), so the allreduce-sum is the
+    value itself; a generic replicated value (metric averaging via
+    :func:`allreduce_`) instead has allreduce-sum = value × n.
+    """
     if _in_spmd_context(axis_name):
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
 
         def one(g):
             c, ctx = compression.compress(g)
-            # Inside shard_map, jax.grad w.r.t. *replicated* params already
-            # inserts the cross-rank psum (the value's vma set is empty), so
-            # the gradient arrives pre-summed; reducing again would be wrong.
-            # Gradients w.r.t. per-rank (varying) values still need the
-            # explicit collective.
             vma = getattr(jax.typeof(c), "vma", None)
-            already_summed = vma is not None and not any(
-                a in vma for a in axes)
-            if already_summed:
+            unvaried = vma is not None and not any(a in vma for a in axes)
+            if unvaried and grads_hint:
+                # Pre-summed gradient: dividing gives the mean; sum is c.
                 red = c / lax.axis_size(axis_name) if average else c
+            elif unvaried:
+                # Replicated value: allreduce is identity (avg) or ×n (sum).
+                red = c if average else c * lax.axis_size(axis_name)
             else:
                 red = (lax.pmean(c, axis_name) if average
                        else lax.psum(c, axis_name))
@@ -149,9 +155,9 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
 
 
 def allreduce_(tree, *, average: bool = True, name_prefix: str = "allreduce"):
-    """Eager allreduce of an arbitrary pytree (metric averaging etc.)."""
+    """Allreduce of an arbitrary pytree (metric averaging etc.)."""
     return allreduce_gradients(tree, average=average,
-                               name_prefix=name_prefix)
+                               name_prefix=name_prefix, grads_hint=False)
 
 
 __all__ = [

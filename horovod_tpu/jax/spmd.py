@@ -1,0 +1,135 @@
+"""SPMD training-step builder — the in-jit hot path of the framework.
+
+The reference's hot path is: backward pass fires per-gradient hooks →
+``allreduce_async_`` → background negotiation → fused MPI/NCCL allreduce →
+``optimizer.step()`` (SURVEY §3.2/3.3).  The TPU-native equivalent compiles
+all of that into ONE XLA program: ``shard_map`` over the rank mesh, gradients
+averaged with in-program collectives (fusion and latency-hiding done by XLA),
+optimizer update fused into the same program, buffers donated so params
+update in place in HBM.
+
+Two mesh layouts are supported, mirroring the reference's flat vs.
+hierarchical allreduce (``operations.cc:879-1029`` vs ``:1025-1177``):
+
+* 1-D ``('ranks',)`` mesh → flat ``pmean`` (XLA AllReduce over ICI).
+* 2-D ``('dcn', 'ici')`` mesh → :func:`hierarchical_allreduce`
+  (reduce-scatter on ICI, allreduce shards over DCN, allgather on ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from horovod_tpu.compression import Compressor, NoneCompressor
+from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+from horovod_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS, RANKS_AXIS
+
+
+def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
+                     average: bool = True,
+                     compression: Compressor = NoneCompressor):
+    """Cross-rank gradient reduction inside a shard_map body.
+
+    Uses the hierarchical two-tier path when the mesh is ('dcn', 'ici'),
+    else a flat psum/pmean.  ``compression`` casts to the wire dtype around
+    the collective (reference ``Compression.fp16``).
+    """
+    hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
+
+    def one(g):
+        c, ctx = compression.compress(g)
+        if hierarchical:
+            red = hierarchical_allreduce(c, average=average)
+        elif average:
+            red = lax.pmean(c, axis_names)
+        else:
+            red = lax.psum(c, axis_names)
+        return compression.decompress(red, ctx)
+
+    return jax.tree.map(one, grads)
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    average: bool = True,
+    compression: Compressor = NoneCompressor,
+    sync_aux_state: bool = True,
+    donate: bool = True,
+):
+    """Build a jitted data-parallel training step over ``mesh``.
+
+    ``loss_fn(params, aux_state, batch) -> (loss, new_aux_state)`` where
+    ``params`` is the differentiable pytree, ``aux_state`` carries
+    non-differentiable model state (e.g. flax ``batch_stats``; pass ``{}``
+    if none), and ``batch`` is the *global* batch — it is split across every
+    mesh axis on its leading dimension.
+
+    Returns ``step(params, aux_state, opt_state, batch) ->
+    (params, aux_state, opt_state, loss)`` — one XLA program containing
+    forward, backward, gradient allreduce, and the optimizer update (the
+    whole of SURVEY §3.2's multi-thread hot path, statically scheduled).
+    """
+    axes = tuple(mesh.axis_names)
+
+    def spmd_body(params, aux_state, opt_state, batch):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, aux_state, batch)
+        grads = reduce_gradients(grads, axes, average=average,
+                                 compression=compression)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if sync_aux_state:
+            # Cross-replica sync of running statistics (each shard saw a
+            # different micro-batch); float leaves only.
+            new_aux = jax.tree.map(
+                lambda a: lax.pmean(a, axes)
+                if jnp.issubdtype(jnp.result_type(a), jnp.floating) else a,
+                new_aux)
+        loss = lax.pmean(loss, axes)
+        return params, new_aux, opt_state, loss
+
+    replicated = P()
+    batch_spec = P(axes)   # leading dim split over every mesh axis
+    step = shard_map(
+        spmd_body, mesh=mesh,
+        in_specs=(replicated, replicated, replicated, batch_spec),
+        out_specs=(replicated, replicated, replicated, replicated),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1, 2) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(apply_fn: Callable, mesh: Mesh):
+    """Jitted eval step: ``apply_fn(params, aux_state, batch) -> metrics``
+    with the batch sharded and metrics averaged across ranks."""
+    axes = tuple(mesh.axis_names)
+
+    def spmd_body(params, aux_state, batch):
+        metrics = apply_fn(params, aux_state, batch)
+        return jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
+
+    step = shard_map(
+        spmd_body, mesh=mesh,
+        in_specs=(P(), P(), P(axes)), out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Device-put a host batch with its leading dim sharded over all mesh
+    axes (the input-pipeline side of the data-parallel contract)."""
+    spec = P(tuple(mesh.axis_names))
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), batch)

@@ -1,0 +1,55 @@
+"""MNIST-scale models — the framework's smoke-test model family.
+
+The reference exercises its optimizer path with small MNIST networks
+(``examples/tensorflow_mnist.py:39-68`` conv net,
+``examples/pytorch_mnist.py:44-63``, ``examples/keras_mnist.py:41-54``).
+These are their TPU-native counterparts: NHWC, static shapes, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Plain MLP classifier (for flattened inputs)."""
+
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+class ConvNet(nn.Module):
+    """The reference MNIST conv net shape: 2 convs + pool + 2 dense
+    (reference ``examples/tensorflow_mnist.py:39-68``,
+    ``examples/pytorch_mnist.py:44-63``), NHWC for the MXU."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,98 @@
+"""ResNet family (v1.5) — the framework's flagship benchmark model.
+
+The reference benchmarks ResNet-50/101 through tf_cnn_benchmarks and ships
+``examples/keras_imagenet_resnet50.py`` / ``examples/pytorch_imagenet_resnet50.py``
+(reference ``docs/benchmarks.md:8-39``).  This is a TPU-first re-design, not a
+port of either:
+
+* **NHWC layout** (channels-last) — the layout XLA:TPU expects; convolutions
+  tile straight onto the MXU.
+* **bfloat16 compute, float32 params** — matmul/conv FLOPs run in bf16 on the
+  MXU; batch-norm statistics and the final logits stay in f32 for stability.
+* Static shapes and no Python control flow in the forward pass, so the whole
+  step compiles to one fused XLA program.
+
+ResNet-50 = Bottleneck × [3, 4, 6, 3] (the standard v1.5 definition with the
+stride-2 in the 3×3 conv, matching what keras.applications.ResNet50 gives the
+reference example).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut (ResNet v1.5:
+    stride lives on the 3×3)."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale: identity-at-init residual branches,
+        # the standard large-batch trick (Goyal et al.) the reference's
+        # warmup callback cites (horovod/keras/callbacks.py:114-134).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 for NHWC images."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=None)
+        act = nn.relu
+
+        x = jnp.asarray(x, self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
+                                    conv=conv, norm=norm, act=act)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])

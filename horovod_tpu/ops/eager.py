@@ -142,7 +142,11 @@ def synchronize(handle: int, timeout: Optional[float] = 300.0):
     hm = basics.controller().handle_manager
     try:
         status, result = hm.wait(handle, timeout)
-    finally:
+    except TimeoutError:
+        # Keep the handle alive so the caller can retry synchronize() and the
+        # eventual completion isn't dropped.
+        raise
+    else:
         hm.release(handle)
     if not status.ok():
         raise CollectiveError(status.reason)

@@ -56,12 +56,6 @@ def _reduce_fn(mesh, length: int, dtype: str):
     return jax.jit(fn, in_shardings=in_sharding, out_shardings=out_sharding)
 
 
-def _apply_average(out, nranks: int):
-    if jnp.issubdtype(out.dtype, jnp.floating):
-        return out / nranks
-    return out // nranks
-
-
 @functools.lru_cache(maxsize=None)
 def _replicate_sharding(mesh):
     return NamedSharding(mesh, P())

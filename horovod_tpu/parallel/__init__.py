@@ -2,3 +2,6 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     RANKS_AXIS, ICI_AXIS, DCN_AXIS, build_ranks_mesh,
     build_hierarchical_mesh, build_mesh,
 )
+from horovod_tpu.parallel.hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+)
