@@ -96,6 +96,10 @@ def main():
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+        "baseline": "resnet101 103.55 img/s/device (16x Pascal, "
+                    "docs/benchmarks.md:22-39 — the reference's only "
+                    "published absolute throughput; no resnet50 number "
+                    "exists)",
     }))
 
 

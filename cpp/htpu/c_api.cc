@@ -1,0 +1,182 @@
+// extern "C" surface of the native core, consumed from Python via ctypes.
+//
+// Equivalent role to the reference's C API + symbol-controlled .so
+// (horovod/common/operations.h:66-118, horovod.lds): a narrow, stable
+// boundary between the Python layer and the native runtime. Byte payloads
+// use the htpu wire format (wire.h), mirrored in horovod_tpu/wire.py.
+//
+// Memory contract: every function returning a buffer allocates it with
+// malloc and the caller releases it with htpu_free().
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+#include "htpu/fusion.h"
+#include "htpu/message_table.h"
+#include "htpu/timeline.h"
+#include "htpu/wire.h"
+
+namespace {
+
+// Copy a std::string into a malloc'd buffer, returning its length.
+int CopyOut(const std::string& s, void** out) {
+  void* buf = malloc(s.size());
+  if (!buf && !s.empty()) return -1;
+  memcpy(buf, s.data(), s.size());
+  *out = buf;
+  return int(s.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* htpu_version() { return "0.1.0"; }
+
+void htpu_free(void* p) { free(p); }
+
+// ------------------------------------------------------------ message table
+
+void* htpu_table_create(int size) {
+  return new htpu::MessageTable(size);
+}
+
+void htpu_table_destroy(void* t) {
+  delete static_cast<htpu::MessageTable*>(t);
+}
+
+// Returns 1 when all ranks have reported for this tensor, 0 otherwise,
+// -1 on parse error.
+int htpu_table_increment(void* t, const void* req_bytes, int len) {
+  htpu::Request req;
+  size_t pos = 0;
+  if (!htpu::ParseRequest(static_cast<const uint8_t*>(req_bytes), size_t(len),
+                          &pos, &req) ||
+      pos != size_t(len)) {
+    return -1;
+  }
+  return static_cast<htpu::MessageTable*>(t)->Increment(req) ? 1 : 0;
+}
+
+// Serialized Response into *out; returns its length (>=0) or -1.
+int htpu_table_construct_response(void* t, const char* name, void** out) {
+  htpu::Response resp =
+      static_cast<htpu::MessageTable*>(t)->ConstructResponse(name);
+  std::string buf;
+  htpu::SerializeResponse(resp, &buf);
+  return CopyOut(buf, out);
+}
+
+int htpu_table_num_pending(void* t) {
+  return int(static_cast<htpu::MessageTable*>(t)->NumPending());
+}
+
+void htpu_table_clear(void* t) {
+  static_cast<htpu::MessageTable*>(t)->Clear();
+}
+
+// Stalled entries as text lines "name\trank,rank,...\n"; returns length.
+int htpu_table_stalled(void* t, double age_s, void** out) {
+  auto stalled = static_cast<htpu::MessageTable*>(t)->Stalled(age_s);
+  std::string buf;
+  for (const auto& kv : stalled) {
+    buf += kv.first;
+    buf += '\t';
+    for (size_t i = 0; i < kv.second.size(); ++i) {
+      if (i) buf += ',';
+      buf += std::to_string(kv.second[i]);
+    }
+    buf += '\n';
+  }
+  return CopyOut(buf, out);
+}
+
+// ------------------------------------------------------------------- fusion
+
+// responses: serialized ResponseList. names/bytes/dtypes: parallel arrays
+// describing each tensor's payload. Result: serialized ResponseList.
+int htpu_plan_fusion(const void* responses_bytes, int len,
+                     const char** names, const int64_t* nbytes,
+                     const char** dtypes, int n_entries, int64_t threshold,
+                     void** out) {
+  htpu::ResponseList in;
+  if (!htpu::ParseResponseList(static_cast<const uint8_t*>(responses_bytes),
+                               size_t(len), &in)) {
+    return -1;
+  }
+  std::unordered_map<std::string, int64_t> size_map;
+  std::unordered_map<std::string, std::string> dtype_map;
+  for (int i = 0; i < n_entries; ++i) {
+    size_map[names[i]] = nbytes[i];
+    dtype_map[names[i]] = dtypes[i];
+  }
+  htpu::ResponseList result;
+  result.shutdown = in.shutdown;
+  result.responses = htpu::PlanFusion(
+      in.responses,
+      [&](const std::string& n) {
+        auto it = size_map.find(n);
+        return it == size_map.end() ? int64_t{0} : it->second;
+      },
+      [&](const std::string& n) {
+        auto it = dtype_map.find(n);
+        return it == dtype_map.end() ? std::string() : it->second;
+      },
+      threshold);
+  std::string buf;
+  htpu::SerializeResponseList(result, &buf);
+  return CopyOut(buf, out);
+}
+
+// ----------------------------------------------------------------- timeline
+
+void* htpu_timeline_create(const char* path) {
+  auto* tl = new htpu::Timeline(path);
+  if (!tl->ok()) {
+    delete tl;
+    return nullptr;
+  }
+  return tl;
+}
+
+void htpu_timeline_destroy(void* tl) {
+  delete static_cast<htpu::Timeline*>(tl);
+}
+
+void htpu_timeline_negotiate_start(void* tl, const char* name, int req_type) {
+  static_cast<htpu::Timeline*>(tl)->NegotiateStart(
+      name, htpu::RequestType(req_type));
+}
+
+void htpu_timeline_negotiate_rank_ready(void* tl, const char* name, int rank) {
+  static_cast<htpu::Timeline*>(tl)->NegotiateRankReady(name, rank);
+}
+
+void htpu_timeline_negotiate_end(void* tl, const char* name) {
+  static_cast<htpu::Timeline*>(tl)->NegotiateEnd(name);
+}
+
+void htpu_timeline_start(void* tl, const char* name, int resp_type) {
+  static_cast<htpu::Timeline*>(tl)->Start(name, htpu::ResponseType(resp_type));
+}
+
+void htpu_timeline_end(void* tl, const char* name) {
+  static_cast<htpu::Timeline*>(tl)->End(name);
+}
+
+void htpu_timeline_activity_start(void* tl, const char* name,
+                                  const char* activity) {
+  static_cast<htpu::Timeline*>(tl)->ActivityStart(name, activity);
+}
+
+void htpu_timeline_activity_end(void* tl, const char* name) {
+  static_cast<htpu::Timeline*>(tl)->ActivityEnd(name);
+}
+
+void htpu_timeline_close(void* tl) {
+  static_cast<htpu::Timeline*>(tl)->Close();
+}
+
+}  // extern "C"

@@ -1,0 +1,44 @@
+#include "htpu/fusion.h"
+
+namespace htpu {
+
+std::vector<Response> PlanFusion(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold) {
+  std::vector<Response> fused;
+  size_t i = 0;
+  while (i < responses.size()) {
+    const Response& r = responses[i];
+    if (r.response_type != ResponseType::ALLREDUCE || threshold <= 0) {
+      fused.push_back(r);
+      ++i;
+      continue;
+    }
+    Response merged;
+    merged.response_type = ResponseType::ALLREDUCE;
+    merged.tensor_names = r.tensor_names;
+    merged.devices = r.devices;
+    int64_t total = 0;
+    for (const auto& n : merged.tensor_names) total += entry_bytes(n);
+    std::string dtype = entry_dtype(merged.tensor_names[0]);
+    size_t j = i + 1;
+    while (j < responses.size()) {
+      const Response& nxt = responses[j];
+      if (nxt.response_type != ResponseType::ALLREDUCE) break;
+      if (entry_dtype(nxt.tensor_names[0]) != dtype) break;
+      int64_t nbytes = 0;
+      for (const auto& n : nxt.tensor_names) nbytes += entry_bytes(n);
+      if (total + nbytes > threshold) break;
+      for (const auto& n : nxt.tensor_names) merged.tensor_names.push_back(n);
+      total += nbytes;
+      ++j;
+    }
+    fused.push_back(std::move(merged));
+    i = j;
+  }
+  return fused;
+}
+
+}  // namespace htpu

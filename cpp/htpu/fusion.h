@@ -1,0 +1,33 @@
+// Tensor Fusion planner.
+//
+// Native equivalent of the coordinator's fusion loop (reference
+// horovod/common/operations.cc:1807-1842): greedily merge consecutive
+// ALLREDUCE responses with the same dtype while the combined payload stays
+// within the fusion threshold (default 64 MB, operations.cc:151).
+// On TPU the "fusion buffer" is a traced concat executed by XLA, so the
+// planner only decides grouping — there is no buffer to manage here.
+#ifndef HTPU_FUSION_H_
+#define HTPU_FUSION_H_
+
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "htpu/wire.h"
+
+namespace htpu {
+
+constexpr int64_t kDefaultFusionThreshold = 64 * 1024 * 1024;
+constexpr int64_t kFusionBufferAtomicUnit = 64;  // operations.h:48-50
+
+// entry_bytes/entry_dtype look up the payload size / dtype for a tensor name.
+std::vector<Response> PlanFusion(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold);
+
+}  // namespace htpu
+
+#endif  // HTPU_FUSION_H_

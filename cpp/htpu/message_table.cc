@@ -1,0 +1,172 @@
+#include "htpu/message_table.h"
+
+#include <sstream>
+
+namespace htpu {
+
+namespace {
+
+std::string ShapeDebugString(const std::vector<int64_t>& shape) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) os << ", ";
+    os << shape[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+}  // namespace
+
+bool MessageTable::Increment(const Request& msg) {
+  auto it = table_.find(msg.tensor_name);
+  if (it == table_.end()) {
+    Entry e;
+    e.requests.push_back(msg);
+    e.first_seen = std::chrono::steady_clock::now();
+    table_.emplace(msg.tensor_name, std::move(e));
+    return size_ == 1;
+  }
+  it->second.requests.push_back(msg);
+  return it->second.requests.size() == size_t(size_);
+}
+
+Response MessageTable::ConstructResponse(const std::string& name) {
+  auto it = table_.find(name);
+  Response resp;
+  if (it == table_.end()) {
+    resp.response_type = ResponseType::ERROR;
+    resp.tensor_names = {name};
+    resp.error_message = "Internal error: tensor not in message table.";
+    return resp;
+  }
+  const std::vector<Request>& requests = it->second.requests;
+  std::string error;
+
+  // Validation order and error text mirror ConstructMPIResponse
+  // (reference operations.cc:315-517): dtype, op, shape, allgather dims,
+  // broadcast root rank.
+  const std::string& data_type = requests[0].tensor_type;
+  for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+    if (requests[i].tensor_type != data_type) {
+      error = "Mismatched data types: One rank had type " + data_type +
+              ", but another rank had type " + requests[i].tensor_type + ".";
+    }
+  }
+
+  RequestType message_type = requests[0].request_type;
+  if (error.empty()) {
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      if (requests[i].request_type != message_type) {
+        error = std::string("Mismatched MPI operations: One rank did an ") +
+                RequestTypeName(message_type) + ", but another rank did an " +
+                RequestTypeName(requests[i].request_type) + ".";
+      }
+    }
+  }
+
+  if (error.empty() && (message_type == RequestType::ALLREDUCE ||
+                        message_type == RequestType::BROADCAST)) {
+    const auto& shape0 = requests[0].tensor_shape;
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      if (requests[i].tensor_shape != shape0) {
+        error = std::string("Mismatched ") + RequestTypeName(message_type) +
+                " tensor shapes: One rank sent a tensor of shape " +
+                ShapeDebugString(shape0) +
+                ", but another rank sent a tensor of shape " +
+                ShapeDebugString(requests[i].tensor_shape) + ".";
+      }
+    }
+  }
+
+  std::vector<int64_t> tensor_sizes(requests.size(), 0);
+  if (error.empty() && message_type == RequestType::ALLGATHER) {
+    const auto& shape0 = requests[0].tensor_shape;
+    if (shape0.empty()) {
+      error = std::string("Rank zero tried to ") +
+              RequestTypeName(message_type) + " a rank-zero tensor.";
+    } else {
+      tensor_sizes[size_t(requests[0].request_rank)] = shape0[0];
+      for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+        const auto& shp = requests[i].tensor_shape;
+        if (shp.size() != shape0.size()) {
+          error = std::string("Mismatched ") + RequestTypeName(message_type) +
+                  " tensor shapes: One rank sent a tensor of rank " +
+                  std::to_string(shape0.size()) +
+                  ", but another rank sent a tensor of rank " +
+                  std::to_string(shp.size()) + ".";
+          break;
+        }
+        for (size_t dim = 1; dim < shape0.size(); ++dim) {
+          if (shape0[dim] != shp[dim]) {
+            error = std::string("Mismatched ") + RequestTypeName(message_type) +
+                    " tensor shapes: One rank sent a tensor with dimension " +
+                    std::to_string(dim) + " equal to " +
+                    std::to_string(shape0[dim]) +
+                    ", but another rank sent a tensor with dimension " +
+                    std::to_string(dim) + " equal to " +
+                    std::to_string(shp[dim]) + ".";
+            break;
+          }
+        }
+        if (error.empty())
+          tensor_sizes[size_t(requests[i].request_rank)] = shp[0];
+      }
+    }
+  }
+
+  if (error.empty() && message_type == RequestType::BROADCAST) {
+    int32_t root0 = requests[0].root_rank;
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      if (requests[i].root_rank != root0) {
+        error = std::string("Mismatched ") + RequestTypeName(message_type) +
+                " root ranks: One rank specified root rank " +
+                std::to_string(root0) +
+                ", but another rank specified root rank " +
+                std::to_string(requests[i].root_rank) + ".";
+      }
+    }
+  }
+
+  std::vector<int32_t> devices(requests.size(), 0);
+  for (const auto& r : requests) devices[size_t(r.request_rank)] = r.device;
+
+  table_.erase(it);
+
+  resp.tensor_names = {name};
+  resp.devices = std::move(devices);
+  if (!error.empty()) {
+    resp.response_type = ResponseType::ERROR;
+    resp.error_message = std::move(error);
+  } else if (message_type == RequestType::ALLGATHER) {
+    resp.response_type = ResponseType::ALLGATHER;
+    resp.tensor_sizes = std::move(tensor_sizes);
+  } else if (message_type == RequestType::ALLREDUCE) {
+    resp.response_type = ResponseType::ALLREDUCE;
+  } else {
+    resp.response_type = ResponseType::BROADCAST;
+  }
+  return resp;
+}
+
+std::vector<std::pair<std::string, std::vector<int>>> MessageTable::Stalled(
+    double age_s) const {
+  std::vector<std::pair<std::string, std::vector<int>>> out;
+  auto now = std::chrono::steady_clock::now();
+  for (const auto& kv : table_) {
+    double age = std::chrono::duration<double>(now - kv.second.first_seen)
+                     .count();
+    if (age <= age_s) continue;
+    std::vector<bool> have(size_t(size_), false);
+    for (const auto& r : kv.second.requests)
+      have[size_t(r.request_rank)] = true;
+    std::vector<int> missing;
+    for (int r = 0; r < size_; ++r)
+      if (!have[size_t(r)]) missing.push_back(r);
+    out.emplace_back(kv.first, std::move(missing));
+  }
+  return out;
+}
+
+}  // namespace htpu

@@ -1,0 +1,49 @@
+// Negotiation state machine: per-tensor readiness + cross-rank validation.
+//
+// Native equivalent of the reference coordinator's MessageTable
+// (IncrementTensorCount / ConstructMPIResponse,
+// horovod/common/operations.cc:282-517) including its error-message text,
+// plus the stall scan (CheckForStalledTensors, operations.cc:1366-1412).
+#ifndef HTPU_MESSAGE_TABLE_H_
+#define HTPU_MESSAGE_TABLE_H_
+
+#include <chrono>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "htpu/wire.h"
+
+namespace htpu {
+
+class MessageTable {
+ public:
+  explicit MessageTable(int size) : size_(size) {}
+
+  // Record one rank's request; returns true when all ranks have reported
+  // for this tensor name.
+  bool Increment(const Request& msg);
+
+  // Validate all ranks' requests for `name` and build the response,
+  // removing the entry. Preconditions: Increment returned true for `name`.
+  Response ConstructResponse(const std::string& name);
+
+  // Names pending longer than age_s, with the ranks still missing.
+  std::vector<std::pair<std::string, std::vector<int>>> Stalled(
+      double age_s) const;
+
+  size_t NumPending() const { return table_.size(); }
+  void Clear() { table_.clear(); }
+
+ private:
+  struct Entry {
+    std::vector<Request> requests;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  int size_;
+  std::unordered_map<std::string, Entry> table_;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_MESSAGE_TABLE_H_

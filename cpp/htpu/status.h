@@ -1,0 +1,41 @@
+// Status codes shared across the native core.
+//
+// TPU-native re-design of the reference's Status abstraction
+// (horovod/common/common.h:37-53): same five outcome classes, carried as a
+// plain code + reason string so they cross the C API unchanged.
+#ifndef HTPU_STATUS_H_
+#define HTPU_STATUS_H_
+
+#include <string>
+
+namespace htpu {
+
+enum class StatusType : int {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+};
+
+struct Status {
+  StatusType type = StatusType::OK;
+  std::string reason;
+
+  bool ok() const { return type == StatusType::OK; }
+
+  static Status OK() { return {}; }
+  static Status PreconditionError(std::string msg) {
+    return {StatusType::PRECONDITION_ERROR, std::move(msg)};
+  }
+  static Status Aborted(std::string msg) {
+    return {StatusType::ABORTED, std::move(msg)};
+  }
+  static Status InvalidArgument(std::string msg) {
+    return {StatusType::INVALID_ARGUMENT, std::move(msg)};
+  }
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_STATUS_H_

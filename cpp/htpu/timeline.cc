@@ -1,0 +1,161 @@
+#include "htpu/timeline.h"
+
+#include <sstream>
+
+namespace htpu {
+
+namespace {
+
+constexpr double kFlushEverySeconds = 1.0;  // reference timeline.h:32
+
+// Minimal JSON string escaping for tensor names.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+const char* ResponseTypeTraceName(ResponseType t) {
+  switch (t) {
+    case ResponseType::ALLREDUCE: return "ALLREDUCE";
+    case ResponseType::ALLGATHER: return "ALLGATHER";
+    case ResponseType::BROADCAST: return "BROADCAST";
+    case ResponseType::ERROR: return "ERROR";
+  }
+  return "UNKNOWN";
+}
+
+}  // namespace
+
+Timeline::Timeline(const std::string& path) {
+  file_ = fopen(path.c_str(), "w");
+  if (file_) fputs("[\n", file_);
+  t0_ = std::chrono::steady_clock::now();
+  last_flush_ = t0_;
+}
+
+Timeline::~Timeline() { Close(); }
+
+int64_t Timeline::TsUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - t0_)
+      .count();
+}
+
+void Timeline::Emit(const std::string& json_line) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (closed_ || !file_) return;
+  fputs(json_line.c_str(), file_);
+  fputs(",\n", file_);
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_flush_).count() >
+      kFlushEverySeconds) {
+    fflush(file_);
+    last_flush_ = now;
+  }
+}
+
+int Timeline::Pid(const std::string& tensor_name) {
+  int pid;
+  bool created = false;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = tensor_pids_.find(tensor_name);
+    if (it == tensor_pids_.end()) {
+      pid = next_pid_++;
+      tensor_pids_.emplace(tensor_name, pid);
+      created = true;
+    } else {
+      pid = it->second;
+    }
+  }
+  if (created) {
+    // Metadata event registering the tensor as a trace process
+    // (reference timeline.cc:51-68).
+    std::ostringstream os;
+    os << "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": " << pid
+       << ", \"args\": {\"name\": \"" << JsonEscape(tensor_name) << "\"}}";
+    Emit(os.str());
+    std::ostringstream os2;
+    os2 << "{\"name\": \"process_sort_index\", \"ph\": \"M\", \"pid\": " << pid
+        << ", \"args\": {\"sort_index\": " << pid << "}}";
+    Emit(os2.str());
+  }
+  return pid;
+}
+
+void Timeline::NegotiateStart(const std::string& tensor_name,
+                              RequestType type) {
+  std::ostringstream os;
+  os << "{\"ph\": \"B\", \"pid\": " << Pid(tensor_name)
+     << ", \"ts\": " << TsUs() << ", \"name\": \"NEGOTIATE_"
+     << RequestTypeName(type) << "\"}";
+  Emit(os.str());
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor_name, int rank) {
+  std::ostringstream os;
+  os << "{\"ph\": \"i\", \"pid\": " << Pid(tensor_name)
+     << ", \"ts\": " << TsUs() << ", \"s\": \"p\", \"name\": \"" << rank
+     << "\"}";
+  Emit(os.str());
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor_name) {
+  std::ostringstream os;
+  os << "{\"ph\": \"E\", \"pid\": " << Pid(tensor_name)
+     << ", \"ts\": " << TsUs() << "}";
+  Emit(os.str());
+}
+
+void Timeline::Start(const std::string& tensor_name, ResponseType type) {
+  std::ostringstream os;
+  os << "{\"ph\": \"B\", \"pid\": " << Pid(tensor_name)
+     << ", \"ts\": " << TsUs() << ", \"name\": \""
+     << ResponseTypeTraceName(type) << "\"}";
+  Emit(os.str());
+}
+
+void Timeline::End(const std::string& tensor_name) { NegotiateEnd(tensor_name); }
+
+void Timeline::ActivityStart(const std::string& tensor_name,
+                             const std::string& activity) {
+  std::ostringstream os;
+  os << "{\"ph\": \"B\", \"pid\": " << Pid(tensor_name)
+     << ", \"ts\": " << TsUs() << ", \"name\": \"" << JsonEscape(activity)
+     << "\"}";
+  Emit(os.str());
+}
+
+void Timeline::ActivityEnd(const std::string& tensor_name) {
+  NegotiateEnd(tensor_name);
+}
+
+void Timeline::Close() {
+  std::lock_guard<std::mutex> l(mu_);
+  if (!closed_ && file_) {
+    fputs("{}]\n", file_);
+    fclose(file_);
+    file_ = nullptr;
+    closed_ = true;
+  }
+}
+
+}  // namespace htpu

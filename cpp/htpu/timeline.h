@@ -1,0 +1,55 @@
+// Chrome-tracing timeline writer.
+//
+// Native equivalent of the reference's Timeline
+// (horovod/common/timeline.{h,cc}): each named tensor is a trace "process"
+// (metadata event), with spans for negotiation (begin/instant-per-rank/end),
+// the top-level operation, and nested activities. Output format matches the
+// Python fallback in horovod_tpu/timeline.py byte-for-byte in structure so
+// either can be loaded in chrome://tracing / Perfetto.
+#ifndef HTPU_TIMELINE_H_
+#define HTPU_TIMELINE_H_
+
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "htpu/wire.h"
+
+namespace htpu {
+
+class Timeline {
+ public:
+  explicit Timeline(const std::string& path);
+  ~Timeline();
+
+  bool ok() const { return file_ != nullptr; }
+
+  void NegotiateStart(const std::string& tensor_name, RequestType type);
+  void NegotiateRankReady(const std::string& tensor_name, int rank);
+  void NegotiateEnd(const std::string& tensor_name);
+  void Start(const std::string& tensor_name, ResponseType type);
+  void End(const std::string& tensor_name);
+  void ActivityStart(const std::string& tensor_name,
+                     const std::string& activity);
+  void ActivityEnd(const std::string& tensor_name);
+  void Close();
+
+ private:
+  int64_t TsUs() const;
+  int Pid(const std::string& tensor_name);  // registers metadata on first use
+  void Emit(const std::string& json_line);
+
+  FILE* file_ = nullptr;
+  std::mutex mu_;
+  std::chrono::steady_clock::time_point t0_;
+  std::chrono::steady_clock::time_point last_flush_;
+  std::unordered_map<std::string, int> tensor_pids_;
+  int next_pid_ = 1;
+  bool closed_ = false;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_TIMELINE_H_

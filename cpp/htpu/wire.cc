@@ -1,0 +1,165 @@
+#include "htpu/wire.h"
+
+#include <cstring>
+
+namespace htpu {
+
+const char* RequestTypeName(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "ALLREDUCE";
+    case RequestType::ALLGATHER: return "ALLGATHER";
+    case RequestType::BROADCAST: return "BROADCAST";
+  }
+  return "<unknown>";
+}
+
+namespace {
+
+void PutI8(std::string* out, uint8_t v) { out->push_back(char(v)); }
+
+void PutI32(std::string* out, int32_t v) {
+  for (int i = 0; i < 4; ++i) out->push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+}
+
+void PutI64(std::string* out, int64_t v) {
+  for (int i = 0; i < 8; ++i) out->push_back(char((uint64_t(v) >> (8 * i)) & 0xff));
+}
+
+void PutStr(std::string* out, const std::string& s) {
+  PutI32(out, int32_t(s.size()));
+  out->append(s);
+}
+
+bool GetI8(const uint8_t* d, size_t len, size_t* pos, uint8_t* v) {
+  if (*pos + 1 > len) return false;
+  *v = d[*pos];
+  *pos += 1;
+  return true;
+}
+
+bool GetI32(const uint8_t* d, size_t len, size_t* pos, int32_t* v) {
+  if (*pos + 4 > len) return false;
+  uint32_t u = 0;
+  for (int i = 0; i < 4; ++i) u |= uint32_t(d[*pos + i]) << (8 * i);
+  *v = int32_t(u);
+  *pos += 4;
+  return true;
+}
+
+bool GetI64(const uint8_t* d, size_t len, size_t* pos, int64_t* v) {
+  if (*pos + 8 > len) return false;
+  uint64_t u = 0;
+  for (int i = 0; i < 8; ++i) u |= uint64_t(d[*pos + i]) << (8 * i);
+  *v = int64_t(u);
+  *pos += 8;
+  return true;
+}
+
+bool GetStr(const uint8_t* d, size_t len, size_t* pos, std::string* v) {
+  int32_t n;
+  if (!GetI32(d, len, pos, &n) || n < 0 || *pos + size_t(n) > len) return false;
+  v->assign(reinterpret_cast<const char*>(d + *pos), size_t(n));
+  *pos += size_t(n);
+  return true;
+}
+
+}  // namespace
+
+void SerializeRequest(const Request& r, std::string* out) {
+  PutI32(out, r.request_rank);
+  PutI32(out, int32_t(r.request_type));
+  PutStr(out, r.tensor_name);
+  PutStr(out, r.tensor_type);
+  PutI32(out, r.root_rank);
+  PutI32(out, r.device);
+  PutI32(out, int32_t(r.tensor_shape.size()));
+  for (int64_t d : r.tensor_shape) PutI64(out, d);
+}
+
+bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out) {
+  int32_t type, ndims;
+  if (!GetI32(data, len, pos, &out->request_rank)) return false;
+  if (!GetI32(data, len, pos, &type)) return false;
+  out->request_type = RequestType(type);
+  if (!GetStr(data, len, pos, &out->tensor_name)) return false;
+  if (!GetStr(data, len, pos, &out->tensor_type)) return false;
+  if (!GetI32(data, len, pos, &out->root_rank)) return false;
+  if (!GetI32(data, len, pos, &out->device)) return false;
+  if (!GetI32(data, len, pos, &ndims) || ndims < 0) return false;
+  out->tensor_shape.resize(size_t(ndims));
+  for (int i = 0; i < ndims; ++i)
+    if (!GetI64(data, len, pos, &out->tensor_shape[size_t(i)])) return false;
+  return true;
+}
+
+void SerializeResponse(const Response& r, std::string* out) {
+  PutI32(out, int32_t(r.response_type));
+  PutI32(out, int32_t(r.tensor_names.size()));
+  for (const auto& n : r.tensor_names) PutStr(out, n);
+  PutStr(out, r.error_message);
+  PutI32(out, int32_t(r.devices.size()));
+  for (int32_t d : r.devices) PutI32(out, d);
+  PutI32(out, int32_t(r.tensor_sizes.size()));
+  for (int64_t s : r.tensor_sizes) PutI64(out, s);
+}
+
+bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
+                   Response* out) {
+  int32_t type, n;
+  if (!GetI32(data, len, pos, &type)) return false;
+  out->response_type = ResponseType(type);
+  if (!GetI32(data, len, pos, &n) || n < 0) return false;
+  out->tensor_names.resize(size_t(n));
+  for (int32_t i = 0; i < n; ++i)
+    if (!GetStr(data, len, pos, &out->tensor_names[size_t(i)])) return false;
+  if (!GetStr(data, len, pos, &out->error_message)) return false;
+  if (!GetI32(data, len, pos, &n) || n < 0) return false;
+  out->devices.resize(size_t(n));
+  for (int32_t i = 0; i < n; ++i)
+    if (!GetI32(data, len, pos, &out->devices[size_t(i)])) return false;
+  if (!GetI32(data, len, pos, &n) || n < 0) return false;
+  out->tensor_sizes.resize(size_t(n));
+  for (int32_t i = 0; i < n; ++i)
+    if (!GetI64(data, len, pos, &out->tensor_sizes[size_t(i)])) return false;
+  return true;
+}
+
+void SerializeRequestList(const RequestList& l, std::string* out) {
+  PutI8(out, l.shutdown ? 1 : 0);
+  PutI32(out, int32_t(l.requests.size()));
+  for (const auto& r : l.requests) SerializeRequest(r, out);
+}
+
+bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
+  size_t pos = 0;
+  uint8_t shutdown;
+  int32_t n;
+  if (!GetI8(data, len, &pos, &shutdown)) return false;
+  out->shutdown = shutdown != 0;
+  if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+  out->requests.resize(size_t(n));
+  for (int32_t i = 0; i < n; ++i)
+    if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)])) return false;
+  return pos == len;
+}
+
+void SerializeResponseList(const ResponseList& l, std::string* out) {
+  PutI8(out, l.shutdown ? 1 : 0);
+  PutI32(out, int32_t(l.responses.size()));
+  for (const auto& r : l.responses) SerializeResponse(r, out);
+}
+
+bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
+  size_t pos = 0;
+  uint8_t shutdown;
+  int32_t n;
+  if (!GetI8(data, len, &pos, &shutdown)) return false;
+  out->shutdown = shutdown != 0;
+  if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+  out->responses.resize(size_t(n));
+  for (int32_t i = 0; i < n; ++i)
+    if (!ParseResponse(data, len, &pos, &out->responses[size_t(i)])) return false;
+  return pos == len;
+}
+
+}  // namespace htpu

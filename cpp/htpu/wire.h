@@ -1,0 +1,77 @@
+// Control-plane wire messages + binary serialization.
+//
+// Equivalent of the reference's MPIRequest/MPIResponse FlatBuffers wire
+// format (horovod/common/mpi_message.{h,cc}, wire/mpi_message.fbs) —
+// re-designed rather than vendored: a little-endian length-prefixed binary
+// encoding with explicit field order, small enough to audit and fast enough
+// for a per-tick control plane.  The Python side mirrors this format in
+// horovod_tpu/wire.py; the two are tested against each other.
+//
+// Encoding primitives: i32/i64 little-endian; str = i32 length + bytes;
+// vec<T> = i32 count + elements.
+//
+// Request  := rank:i32 type:i32 name:str dtype:str root:i32 device:i32
+//             shape:vec<i64>
+// Response := type:i32 names:vec<str> error:str devices:vec<i32>
+//             sizes:vec<i64>
+// RequestList  := shutdown:i8 requests:vec<Request>
+// ResponseList := shutdown:i8 responses:vec<Response>
+#ifndef HTPU_WIRE_H_
+#define HTPU_WIRE_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace htpu {
+
+enum class RequestType : int { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2 };
+enum class ResponseType : int {
+  ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, ERROR = 3
+};
+
+const char* RequestTypeName(RequestType t);
+
+struct Request {
+  int32_t request_rank = 0;
+  RequestType request_type = RequestType::ALLREDUCE;
+  std::string tensor_name;
+  std::string tensor_type;   // numpy-style dtype name, e.g. "float32"
+  int32_t root_rank = -1;
+  int32_t device = -1;
+  std::vector<int64_t> tensor_shape;
+};
+
+struct Response {
+  ResponseType response_type = ResponseType::ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  std::vector<int32_t> devices;
+  // Allgather: dim0 contribution per rank, indexed by rank.
+  std::vector<int64_t> tensor_sizes;
+};
+
+struct RequestList {
+  bool shutdown = false;
+  std::vector<Request> requests;
+};
+
+struct ResponseList {
+  bool shutdown = false;
+  std::vector<Response> responses;
+};
+
+// Serialization. Append to / read from a byte buffer.
+void SerializeRequest(const Request& r, std::string* out);
+bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out);
+void SerializeResponse(const Response& r, std::string* out);
+bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
+                   Response* out);
+void SerializeRequestList(const RequestList& l, std::string* out);
+bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out);
+void SerializeResponseList(const ResponseList& l, std::string* out);
+bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out);
+
+}  // namespace htpu
+
+#endif  // HTPU_WIRE_H_

@@ -361,6 +361,7 @@ class HandleManager:
         self._cv = threading.Condition(self._lock)
         self._next = 0
         self._results: Dict[int, Optional[Tuple[Status, object]]] = {}
+        self._abandoned: set = set()
 
     def allocate(self) -> int:
         with self._lock:
@@ -371,9 +372,24 @@ class HandleManager:
 
     def mark_done(self, handle: int, status: Status, result=None) -> None:
         with self._cv:
+            if handle in self._abandoned:
+                # Caller gave up (timeout); drop the result so it can't
+                # accumulate for a handle nobody will ever collect.
+                self._abandoned.discard(handle)
+                self._results.pop(handle, None)
+                return
             if handle in self._results:
                 self._results[handle] = (status, result)
                 self._cv.notify_all()
+
+    def abandon(self, handle: int) -> None:
+        """Give up on an incomplete handle: if its result already arrived,
+        release it now; otherwise drop it on arrival."""
+        with self._lock:
+            if self._results.get(handle) is not None:
+                self._results.pop(handle, None)
+            elif handle in self._results:
+                self._abandoned.add(handle)
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -391,6 +407,7 @@ class HandleManager:
     def release(self, handle: int):
         with self._lock:
             self._results.pop(handle, None)
+            self._abandoned.discard(handle)
 
     def _check_known(self, handle: int):
         if handle not in self._results:
@@ -441,14 +458,29 @@ class Controller:
         self.stall_check_disabled = env_flag(
             "HOROVOD_TPU_STALL_CHECK_DISABLE")
 
+        # Native core (cpp/htpu): message table, fusion planner and timeline
+        # run in C++ when the shared library is available; the Python classes
+        # below remain the executable specification and fallback.
+        from horovod_tpu import cpp_core
+        self._use_cpp = cpp_core.available()
+
         self.timeline = None
         timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")
         if timeline_path and topology.rank == 0:
-            from horovod_tpu.timeline import Timeline
-            self.timeline = Timeline(timeline_path)
+            if self._use_cpp:
+                self.timeline = cpp_core.CppTimeline(timeline_path)
+            else:
+                from horovod_tpu.timeline import Timeline
+                self.timeline = Timeline(timeline_path)
 
         self.handle_manager = HandleManager()
-        self._message_table = MessageTable(self.size, self.timeline)
+        if self._use_cpp:
+            self._message_table = cpp_core.CppMessageTable(
+                self.size, self.timeline)
+            self._plan_fusion = cpp_core.cpp_plan_fusion
+        else:
+            self._message_table = MessageTable(self.size, self.timeline)
+            self._plan_fusion = plan_fusion
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: collections.deque = collections.deque()
         self._lock = threading.Lock()
@@ -553,8 +585,8 @@ class Controller:
         def entry_dtype(name: str) -> str:
             return self._tensor_table[name].dtype
 
-        fused = plan_fusion(responses, entry_bytes, entry_dtype,
-                            self.fusion_threshold)
+        fused = self._plan_fusion(responses, entry_bytes, entry_dtype,
+                                  self.fusion_threshold)
 
         for resp in fused:
             with self._lock:

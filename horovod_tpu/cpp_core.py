@@ -1,0 +1,276 @@
+"""ctypes bridge to the native core (``cpp/htpu``, built as
+``horovod_tpu/lib/libhtpu_core.so``).
+
+Mirrors the reference's ctypes ``HorovodBasics`` pattern
+(``horovod/common/__init__.py:51-84``): a narrow ``extern "C"`` API, bytes
+in the htpu wire format (:mod:`horovod_tpu.wire`) as the interchange.
+
+Exposes drop-in replacements for the control-plane classes in
+:mod:`horovod_tpu.core`: :class:`CppMessageTable`, :func:`cpp_plan_fusion`,
+:class:`CppTimeline`.  ``load()`` builds the library with ``make`` on first
+use if it is missing (the toolchain is a build requirement, like the
+reference's ``mpicxx``); set ``HOROVOD_TPU_NO_CPP=1`` to force the
+pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+from horovod_tpu import wire
+from horovod_tpu.core import Request, Response, env_flag
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libhtpu_core.so")
+_CPP_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _configure(lib) -> None:
+    lib.htpu_version.restype = ctypes.c_char_p
+    lib.htpu_free.argtypes = [ctypes.c_void_p]
+    lib.htpu_table_create.restype = ctypes.c_void_p
+    lib.htpu_table_create.argtypes = [ctypes.c_int]
+    lib.htpu_table_destroy.argtypes = [ctypes.c_void_p]
+    lib.htpu_table_increment.restype = ctypes.c_int
+    lib.htpu_table_increment.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.htpu_table_construct_response.restype = ctypes.c_int
+    lib.htpu_table_construct_response.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_table_num_pending.restype = ctypes.c_int
+    lib.htpu_table_num_pending.argtypes = [ctypes.c_void_p]
+    lib.htpu_table_clear.argtypes = [ctypes.c_void_p]
+    lib.htpu_table_stalled.restype = ctypes.c_int
+    lib.htpu_table_stalled.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_plan_fusion.restype = ctypes.c_int
+    lib.htpu_plan_fusion.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_timeline_create.restype = ctypes.c_void_p
+    lib.htpu_timeline_create.argtypes = [ctypes.c_char_p]
+    lib.htpu_timeline_destroy.argtypes = [ctypes.c_void_p]
+    for fn in ("negotiate_start", "start"):
+        f = getattr(lib, f"htpu_timeline_{fn}")
+        f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.htpu_timeline_negotiate_rank_ready.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    for fn in ("negotiate_end", "end", "activity_end"):
+        f = getattr(lib, f"htpu_timeline_{fn}")
+        f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.htpu_timeline_activity_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.htpu_timeline_close.argtypes = [ctypes.c_void_p]
+
+
+def load():
+    """Load (building if necessary) the native core; None if unavailable."""
+    global _lib
+    if env_flag("HOROVOD_TPU_NO_CPP"):
+        return None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and os.path.isdir(_CPP_DIR):
+            try:
+                subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, OSError):
+                return None
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _configure(lib)
+        except OSError:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _take_buffer(lib, out_ptr: ctypes.c_void_p, length: int) -> bytes:
+    if length < 0:
+        raise RuntimeError("native core returned an error")
+    try:
+        if length == 0:
+            return b""
+        return ctypes.string_at(out_ptr, length)
+    finally:
+        lib.htpu_free(out_ptr)
+
+
+class CppMessageTable:
+    """Native MessageTable with the Python-class interface of
+    :class:`horovod_tpu.core.MessageTable`."""
+
+    def __init__(self, size: int, timeline=None):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._ptr = self._lib.htpu_table_create(size)
+        self._size = size
+        self._timeline = timeline
+        self._pending_names = set()   # for timeline negotiate_start hooks
+
+    def __del__(self):
+        lib, ptr = getattr(self, "_lib", None), getattr(self, "_ptr", None)
+        if lib is not None and ptr:
+            lib.htpu_table_destroy(ptr)
+            self._ptr = None
+
+    def __len__(self):
+        return self._lib.htpu_table_num_pending(self._ptr)
+
+    def clear(self):
+        self._lib.htpu_table_clear(self._ptr)
+        self._pending_names.clear()
+
+    def increment(self, msg: Request) -> bool:
+        data = wire.serialize_request(msg)
+        rc = self._lib.htpu_table_increment(self._ptr, data, len(data))
+        if rc < 0:
+            raise RuntimeError("native core failed to parse request")
+        if self._timeline:
+            # The native table doesn't call back into Python; replicate the
+            # negotiation hooks here, tracking first-appearance locally.
+            if msg.tensor_name not in self._pending_names:
+                self._pending_names.add(msg.tensor_name)
+                self._timeline.negotiate_start(msg.tensor_name,
+                                               msg.request_type)
+            self._timeline.negotiate_rank_ready(msg.tensor_name,
+                                                msg.request_rank)
+            if rc == 1:
+                self._timeline.negotiate_end(msg.tensor_name)
+        return rc == 1
+
+    def construct_response(self, name: str) -> Response:
+        self._pending_names.discard(name)
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_table_construct_response(
+            self._ptr, name.encode("utf-8"), ctypes.byref(out))
+        return wire.parse_single_response(_take_buffer(self._lib, out, n))
+
+    def pending_names_older_than(self, age_s: float):
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_table_stalled(self._ptr, age_s, ctypes.byref(out))
+        text = _take_buffer(self._lib, out, n).decode("utf-8")
+        result = []
+        for line in text.splitlines():
+            if not line:
+                continue
+            name, _, missing = line.partition("\t")
+            result.append(
+                (name, [int(r) for r in missing.split(",") if r != ""]))
+        return result
+
+
+def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
+                    threshold: int) -> List[Response]:
+    """Native fusion planner with the signature of
+    :func:`horovod_tpu.core.plan_fusion`."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core not available")
+    blob = wire.serialize_response_list(responses)
+    names = sorted({n for r in responses for n in r.tensor_names})
+    n = len(names)
+    name_arr = (ctypes.c_char_p * n)(*[s.encode("utf-8") for s in names])
+    bytes_arr = (ctypes.c_int64 * n)(*[entry_bytes(s) for s in names])
+    dtype_arr = (ctypes.c_char_p * n)(
+        *[entry_dtype(s).encode("utf-8") for s in names])
+    out = ctypes.c_void_p()
+    rc = lib.htpu_plan_fusion(blob, len(blob), name_arr, bytes_arr, dtype_arr,
+                              n, threshold, ctypes.byref(out))
+    fused, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
+    return fused
+
+
+class CppTimeline:
+    """Native Chrome-trace writer with the interface of
+    :class:`horovod_tpu.timeline.Timeline`.
+
+    Every method tolerates a closed timeline (no-op) — the executor may race
+    a late span against ``Controller.stop()``'s close, and calling into C++
+    with a destroyed object would crash the interpreter where the Python
+    fallback merely raises.
+    """
+
+    def __init__(self, path: str):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._ptr = self._lib.htpu_timeline_create(path.encode("utf-8"))
+        if not self._ptr:
+            raise OSError(f"cannot open timeline file: {path}")
+
+    def negotiate_start(self, tensor_name: str, request_type) -> None:
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_negotiate_start(
+            self._ptr, tensor_name.encode("utf-8"), int(request_type))
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_negotiate_rank_ready(
+            self._ptr, tensor_name.encode("utf-8"), rank)
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_negotiate_end(
+            self._ptr, tensor_name.encode("utf-8"))
+
+    def start(self, tensor_name: str, response_type) -> None:
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_start(
+            self._ptr, tensor_name.encode("utf-8"), int(response_type))
+
+    def end(self, tensor_name: str) -> None:
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_end(self._ptr, tensor_name.encode("utf-8"))
+
+    def activity_start_all(self, entries, activity: str) -> None:
+        if not self._ptr:
+            return
+        for e in entries:
+            self._lib.htpu_timeline_activity_start(
+                self._ptr, e.name.encode("utf-8"), activity.encode("utf-8"))
+
+    def activity_end_all(self, entries) -> None:
+        if not self._ptr:
+            return
+        for e in entries:
+            self._lib.htpu_timeline_activity_end(
+                self._ptr, e.name.encode("utf-8"))
+
+    def close(self):
+        # Close only finalizes the file; the C++ object stays alive (its
+        # methods no-op once closed, under its own mutex) so a racing span
+        # from the executor can never hit freed memory.  The object itself
+        # is destroyed when this wrapper is garbage collected.
+        if self._ptr:
+            self._lib.htpu_timeline_close(self._ptr)
+
+    def __del__(self):
+        try:
+            ptr, self._ptr = self._ptr, None
+            if ptr:
+                self._lib.htpu_timeline_close(ptr)
+                self._lib.htpu_timeline_destroy(ptr)
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass

@@ -135,16 +135,21 @@ def poll(handle: int) -> bool:
     return basics.controller().handle_manager.poll(handle)
 
 
-def synchronize(handle: int, timeout: Optional[float] = 300.0):
+def synchronize(handle: int, timeout: Optional[float] = 300.0,
+                abandon_on_timeout: bool = True):
     """Wait for an async op; returns its output array or raises
     :class:`CollectiveError` with the coordinator's message
-    (reference ``mpi_ops.py:422-438``)."""
+    (reference ``mpi_ops.py:422-438``).
+
+    On timeout the handle is *abandoned* by default — a late completion is
+    dropped rather than leaking in the handle table.  Pass
+    ``abandon_on_timeout=False`` to keep it alive for a retry."""
     hm = basics.controller().handle_manager
     try:
         status, result = hm.wait(handle, timeout)
     except TimeoutError:
-        # Keep the handle alive so the caller can retry synchronize() and the
-        # eventual completion isn't dropped.
+        if abandon_on_timeout:
+            hm.abandon(handle)
         raise
     else:
         hm.release(handle)

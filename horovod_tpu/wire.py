@@ -1,0 +1,147 @@
+"""Binary wire format for control-plane messages — Python mirror of
+``cpp/htpu/wire.{h,cc}``.
+
+Replaces the reference's FlatBuffers encoding
+(``horovod/common/wire/mpi_message.fbs``, ``mpi_message.cc:122-330``) with a
+little-endian length-prefixed format shared byte-for-byte between the C++
+core and this module (cross-tested in ``tests/test_cpp_core.py``).  Used for
+Python↔C++ interchange through the ctypes API and for the multi-process
+control plane.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from horovod_tpu.core import Request, RequestType, Response, ResponseType
+
+
+def _put_str(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    out += struct.pack("<i", len(b))
+    out += b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def i8(self) -> int:
+        v = self.data[self.pos]
+        self.pos += 1
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from("<i", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = struct.unpack_from("<q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def str_(self) -> str:
+        n = self.i32()
+        v = self.data[self.pos:self.pos + n].decode("utf-8")
+        self.pos += n
+        return v
+
+
+def serialize_request(r: Request) -> bytes:
+    out = bytearray()
+    out += struct.pack("<i", r.request_rank)
+    out += struct.pack("<i", int(r.request_type))
+    _put_str(out, r.tensor_name)
+    _put_str(out, r.tensor_type)
+    out += struct.pack("<i", r.root_rank)
+    out += struct.pack("<i", r.device)
+    out += struct.pack("<i", len(r.tensor_shape))
+    for d in r.tensor_shape:
+        out += struct.pack("<q", d)
+    return bytes(out)
+
+
+def parse_request(rd: _Reader) -> Request:
+    rank = rd.i32()
+    rtype = RequestType(rd.i32())
+    name = rd.str_()
+    dtype = rd.str_()
+    root = rd.i32()
+    device = rd.i32()
+    ndims = rd.i32()
+    shape = tuple(rd.i64() for _ in range(ndims))
+    return Request(request_rank=rank, request_type=rtype, tensor_name=name,
+                   tensor_type=dtype, tensor_shape=shape, root_rank=root,
+                   device=device)
+
+
+def serialize_response(r: Response) -> bytes:
+    out = bytearray()
+    out += struct.pack("<i", int(r.response_type))
+    out += struct.pack("<i", len(r.tensor_names))
+    for n in r.tensor_names:
+        _put_str(out, n)
+    _put_str(out, r.error_message)
+    out += struct.pack("<i", len(r.devices))
+    for d in r.devices:
+        out += struct.pack("<i", d)
+    out += struct.pack("<i", len(r.tensor_sizes))
+    for s in r.tensor_sizes:
+        out += struct.pack("<q", s)
+    return bytes(out)
+
+
+def parse_response(rd: _Reader) -> Response:
+    rtype = ResponseType(rd.i32())
+    names = [rd.str_() for _ in range(rd.i32())]
+    error = rd.str_()
+    devices = [rd.i32() for _ in range(rd.i32())]
+    sizes = [rd.i64() for _ in range(rd.i32())]
+    return Response(response_type=rtype, tensor_names=names,
+                    error_message=error, devices=devices, tensor_sizes=sizes)
+
+
+def serialize_request_list(requests: List[Request],
+                           shutdown: bool = False) -> bytes:
+    out = bytearray()
+    out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<i", len(requests))
+    for r in requests:
+        out += serialize_request(r)
+    return bytes(out)
+
+
+def parse_request_list(data: bytes) -> Tuple[List[Request], bool]:
+    rd = _Reader(data)
+    shutdown = rd.i8() != 0
+    reqs = [parse_request(rd) for _ in range(rd.i32())]
+    assert rd.pos == len(data), "trailing bytes in request list"
+    return reqs, shutdown
+
+
+def serialize_response_list(responses: List[Response],
+                            shutdown: bool = False) -> bytes:
+    out = bytearray()
+    out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<i", len(responses))
+    for r in responses:
+        out += serialize_response(r)
+    return bytes(out)
+
+
+def parse_response_list(data: bytes) -> Tuple[List[Response], bool]:
+    rd = _Reader(data)
+    shutdown = rd.i8() != 0
+    resps = [parse_response(rd) for _ in range(rd.i32())]
+    assert rd.pos == len(data), "trailing bytes in response list"
+    return resps, shutdown
+
+
+def parse_single_response(data: bytes) -> Response:
+    rd = _Reader(data)
+    resp = parse_response(rd)
+    assert rd.pos == len(data), "trailing bytes in response"
+    return resp
