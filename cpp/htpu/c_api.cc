@@ -48,7 +48,7 @@ void htpu_table_destroy(void* t) {
 }
 
 // Returns 1 when all ranks have reported for this tensor, 0 otherwise,
-// -1 on parse error.
+// -1 on parse error or an out-of-range rank.
 int htpu_table_increment(void* t, const void* req_bytes, int len) {
   htpu::Request req;
   size_t pos = 0;
@@ -57,7 +57,11 @@ int htpu_table_increment(void* t, const void* req_bytes, int len) {
       pos != size_t(len)) {
     return -1;
   }
-  return static_cast<htpu::MessageTable*>(t)->Increment(req) ? 1 : 0;
+  try {
+    return static_cast<htpu::MessageTable*>(t)->Increment(req) ? 1 : 0;
+  } catch (const std::out_of_range&) {
+    return -1;
+  }
 }
 
 // Serialized Response into *out; returns its length (>=0) or -1.
@@ -77,18 +81,20 @@ void htpu_table_clear(void* t) {
   static_cast<htpu::MessageTable*>(t)->Clear();
 }
 
-// Stalled entries as text lines "name\trank,rank,...\n"; returns length.
+// Stalled entries, length-prefixed (names may contain any byte):
+// repeated { name_len:i32 name:bytes n_missing:i32 ranks:i32[n_missing] }.
 int htpu_table_stalled(void* t, double age_s, void** out) {
   auto stalled = static_cast<htpu::MessageTable*>(t)->Stalled(age_s);
   std::string buf;
+  auto put_i32 = [&buf](int32_t v) {
+    for (int i = 0; i < 4; ++i)
+      buf.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+  };
   for (const auto& kv : stalled) {
+    put_i32(int32_t(kv.first.size()));
     buf += kv.first;
-    buf += '\t';
-    for (size_t i = 0; i < kv.second.size(); ++i) {
-      if (i) buf += ',';
-      buf += std::to_string(kv.second[i]);
-    }
-    buf += '\n';
+    put_i32(int32_t(kv.second.size()));
+    for (int r : kv.second) put_i32(r);
   }
   return CopyOut(buf, out);
 }

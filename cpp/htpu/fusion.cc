@@ -11,7 +11,8 @@ std::vector<Response> PlanFusion(
   size_t i = 0;
   while (i < responses.size()) {
     const Response& r = responses[i];
-    if (r.response_type != ResponseType::ALLREDUCE || threshold <= 0) {
+    if (r.response_type != ResponseType::ALLREDUCE || threshold <= 0 ||
+        r.tensor_names.empty()) {
       fused.push_back(r);
       ++i;
       continue;
@@ -27,6 +28,7 @@ std::vector<Response> PlanFusion(
     while (j < responses.size()) {
       const Response& nxt = responses[j];
       if (nxt.response_type != ResponseType::ALLREDUCE) break;
+      if (nxt.tensor_names.empty()) break;
       if (entry_dtype(nxt.tensor_names[0]) != dtype) break;
       int64_t nbytes = 0;
       for (const auto& n : nxt.tensor_names) nbytes += entry_bytes(n);

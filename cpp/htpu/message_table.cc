@@ -1,6 +1,7 @@
 #include "htpu/message_table.h"
 
 #include <sstream>
+#include <stdexcept>
 
 namespace htpu {
 
@@ -20,6 +21,13 @@ std::string ShapeDebugString(const std::vector<int64_t>& shape) {
 }  // namespace
 
 bool MessageTable::Increment(const Request& msg) {
+  // Ranks come off the wire (multi-process control plane); a corrupt or
+  // mis-ranked message must not become an out-of-bounds index later.
+  if (msg.request_rank < 0 || msg.request_rank >= size_) {
+    throw std::out_of_range(
+        "request rank " + std::to_string(msg.request_rank) +
+        " outside communicator of size " + std::to_string(size_));
+  }
   auto it = table_.find(msg.tensor_name);
   if (it == table_.end()) {
     Entry e;

@@ -30,5 +30,6 @@ from horovod_tpu.ops import injit          # noqa: F401
 from horovod_tpu.ops.injit import (        # noqa: F401
     SUM, AVERAGE, MIN, MAX,
 )
+from horovod_tpu.compression import Compression   # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,97 @@
+"""Checkpoint / resume utilities.
+
+The reference has no core checkpoint subsystem; it establishes three
+conventions the examples implement (SURVEY §5.4):
+
+1. **rank-0-only writing** (``README.md`` step 6,
+   ``examples/tensorflow_mnist_estimator.py:147``),
+2. **resume = rank-0 restore + broadcast to all ranks** including the resume
+   epoch (``examples/keras_imagenet_resnet50.py:64-103``), and
+3. **optimizer-state rewrapping on load** (``hvd.load_model``,
+   ``horovod/keras/__init__.py:115-148``; ``broadcast_optimizer_state`` for
+   torch).
+
+This module packages those conventions TPU-natively on orbax (the JAX
+checkpointing library): save is a no-op off rank 0; restore happens on rank
+0 and is broadcast through the framework's collective path so every rank
+resumes bit-identical state.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+from horovod_tpu import basics
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def checkpoint_path(directory: str, epoch: int) -> str:
+    return os.path.join(directory, f"checkpoint-{epoch}")
+
+
+def save(directory: str, state: Any, epoch: int) -> Optional[str]:
+    """Write a checkpoint on rank 0 only; other ranks no-op (convention 1).
+
+    ``state`` is any pytree (e.g. ``{"params": ..., "opt_state": ...}``).
+    """
+    if basics.rank() != 0:
+        return None
+    path = checkpoint_path(directory, epoch)
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def latest_epoch(directory: str) -> int:
+    """Highest epoch with a checkpoint in ``directory``, or -1.
+
+    Mirrors the reference's resume-epoch scan
+    (``examples/keras_imagenet_resnet50.py:64-70``: try epochs descending,
+    first existing file wins).
+    """
+    if not os.path.isdir(directory):
+        return -1
+    best = -1
+    for entry in os.listdir(directory):
+        m = re.fullmatch(r"checkpoint-(\d+)", entry)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best
+
+
+def restore(directory: str, epoch: int, like: Any) -> Any:
+    """Restore the checkpoint for ``epoch`` with the structure of ``like``."""
+    import orbax.checkpoint as ocp
+    path = checkpoint_path(directory, epoch)
+    return _checkpointer().restore(
+        path, restore_args=ocp.checkpoint_utils.construct_restore_args(like))
+
+
+def restore_and_broadcast(directory: str, like: Any,
+                          root_rank: int = 0) -> Tuple[Any, int]:
+    """Resume protocol (conventions 2+3): the resume epoch is agreed by
+    broadcasting rank 0's scan; rank 0 restores; state is broadcast so all
+    ranks start identical (reference ``keras_imagenet_resnet50.py:64-103``,
+    ``pytorch_imagenet_resnet50.py:71,134-142``).
+
+    Returns ``(state, resume_epoch)``; ``resume_epoch`` is -1 (and ``state``
+    is ``like``, broadcast from root) when no checkpoint exists.
+    """
+    import numpy as np
+    from horovod_tpu.jax import broadcast_parameters
+    from horovod_tpu.ops import eager
+
+    epoch = latest_epoch(directory) if basics.rank() == root_rank else -1
+    epoch = int(np.asarray(eager.broadcast(
+        np.asarray(epoch, np.int64), root_rank, name="ckpt.resume_epoch")))
+    state = like
+    if epoch >= 0 and basics.rank() == root_rank:
+        state = restore(directory, epoch, like)
+    state = broadcast_parameters(state, root_rank,
+                                 name_prefix="ckpt.broadcast")
+    return state, epoch

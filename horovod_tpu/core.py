@@ -361,7 +361,6 @@ class HandleManager:
         self._cv = threading.Condition(self._lock)
         self._next = 0
         self._results: Dict[int, Optional[Tuple[Status, object]]] = {}
-        self._abandoned: set = set()
 
     def allocate(self) -> int:
         with self._lock:
@@ -372,24 +371,17 @@ class HandleManager:
 
     def mark_done(self, handle: int, status: Status, result=None) -> None:
         with self._cv:
-            if handle in self._abandoned:
-                # Caller gave up (timeout); drop the result so it can't
-                # accumulate for a handle nobody will ever collect.
-                self._abandoned.discard(handle)
-                self._results.pop(handle, None)
-                return
+            # No-op for unknown handles — covers results arriving after the
+            # caller abandoned a timed-out handle.
             if handle in self._results:
                 self._results[handle] = (status, result)
                 self._cv.notify_all()
 
     def abandon(self, handle: int) -> None:
-        """Give up on an incomplete handle: if its result already arrived,
-        release it now; otherwise drop it on arrival."""
+        """Give up on a handle: drop it now; a completion arriving later
+        hits the unknown-handle no-op in ``mark_done`` and is discarded."""
         with self._lock:
-            if self._results.get(handle) is not None:
-                self._results.pop(handle, None)
-            elif handle in self._results:
-                self._abandoned.add(handle)
+            self._results.pop(handle, None)
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -407,7 +399,6 @@ class HandleManager:
     def release(self, handle: int):
         with self._lock:
             self._results.pop(handle, None)
-            self._abandoned.discard(handle)
 
     def _check_known(self, handle: int):
         if handle not in self._results:

@@ -165,14 +165,21 @@ class CppMessageTable:
     def pending_names_older_than(self, age_s: float):
         out = ctypes.c_void_p()
         n = self._lib.htpu_table_stalled(self._ptr, age_s, ctypes.byref(out))
-        text = _take_buffer(self._lib, out, n).decode("utf-8")
-        result = []
-        for line in text.splitlines():
-            if not line:
-                continue
-            name, _, missing = line.partition("\t")
-            result.append(
-                (name, [int(r) for r in missing.split(",") if r != ""]))
+        data = _take_buffer(self._lib, out, n)
+        # Length-prefixed records (names may contain any byte):
+        # { name_len:i32 name n_missing:i32 ranks:i32[] }*
+        import struct
+        result, pos = [], 0
+        while pos < len(data):
+            (nlen,) = struct.unpack_from("<i", data, pos)
+            pos += 4
+            name = data[pos:pos + nlen].decode("utf-8")
+            pos += nlen
+            (nmiss,) = struct.unpack_from("<i", data, pos)
+            pos += 4
+            ranks = list(struct.unpack_from(f"<{nmiss}i", data, pos))
+            pos += 4 * nmiss
+            result.append((name, ranks))
         return result
 
 

@@ -1,0 +1,195 @@
+"""Callback tests — mirrors the reference's Keras callback coverage
+(test_keras.py broadcast/metric behaviour; warmup/schedule math from
+horovod/keras/callbacks.py:114-134)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.callbacks import (BroadcastGlobalVariablesCallback,
+                                   CallbackList, LearningRateScheduleCallback,
+                                   LearningRateWarmupCallback,
+                                   MetricAverageCallback, TrainingState,
+                                   find_hyperparams)
+
+
+def make_state(lr=0.1, momentum=0.9):
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=lr,
+                                             momentum=momentum)
+    params = {"w": jnp.ones((3,))}
+    return TrainingState(params=params, opt_state=tx.init(params)), tx
+
+
+class TestHyperparams:
+    def test_find(self):
+        state, _ = make_state()
+        hp = find_hyperparams(state.opt_state)
+        assert float(hp["learning_rate"]) == pytest.approx(0.1)
+
+    def test_find_in_chain(self):
+        tx = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.inject_hyperparams(optax.sgd)(learning_rate=0.5))
+        hp = find_hyperparams(tx.init({"w": jnp.ones(2)}))
+        assert float(hp["learning_rate"]) == pytest.approx(0.5)
+
+    def test_missing_raises(self):
+        tx = optax.sgd(0.1)
+        with pytest.raises(ValueError, match="inject_hyperparams"):
+            find_hyperparams(tx.init({"w": jnp.ones(2)}))
+
+
+class TestSchedule:
+    def test_staircase_multiplier(self, hvd):
+        state, _ = make_state(lr=0.1)
+        cb = LearningRateScheduleCallback(
+            multiplier=lambda e: 0.1 ** e, start_epoch=0,
+            momentum_correction=False)
+        cb.on_train_begin(state)
+        for epoch, expect in [(0, 0.1), (1, 0.01), (2, 0.001)]:
+            cb.on_epoch_begin(epoch, state)
+            cb.on_batch_begin(0, state)
+            assert cb._get_lr(state) == pytest.approx(expect)
+
+    def test_constant_multiplier_and_window(self, hvd):
+        state, _ = make_state(lr=1.0)
+        cb = LearningRateScheduleCallback(
+            multiplier=0.5, start_epoch=2, end_epoch=4,
+            momentum_correction=False)
+        cb.on_train_begin(state)
+        cb.on_epoch_begin(0, state)
+        cb.on_batch_begin(0, state)
+        assert cb._get_lr(state) == pytest.approx(1.0)   # before window
+        cb.on_epoch_begin(2, state)
+        cb.on_batch_begin(0, state)
+        assert cb._get_lr(state) == pytest.approx(0.5)   # inside
+        state2, _ = make_state(lr=1.0)
+        cb2 = LearningRateScheduleCallback(
+            multiplier=0.5, start_epoch=2, end_epoch=4,
+            momentum_correction=False)
+        cb2.on_train_begin(state2)
+        cb2.on_epoch_begin(5, state2)
+        cb2.on_batch_begin(0, state2)
+        assert cb2._get_lr(state2) == pytest.approx(1.0)  # after window
+
+    def test_momentum_correction_applied_and_restored(self, hvd):
+        state, _ = make_state(lr=0.1, momentum=0.9)
+        cb = LearningRateScheduleCallback(multiplier=2.0,
+                                          momentum_correction=True)
+        cb.on_train_begin(state)
+        cb.on_epoch_begin(0, state)
+        cb.on_batch_begin(0, state)
+        hp = find_hyperparams(state.opt_state)
+        # m' = m * new_lr / old_lr = 0.9 * 0.2/0.1
+        assert float(hp["momentum"]) == pytest.approx(1.8)
+        cb.on_batch_end(0, state)
+        assert float(hp["momentum"]) == pytest.approx(0.9)
+
+    def test_smooth_interpolation(self, hvd):
+        state, _ = make_state(lr=1.0)
+        cb = LearningRateScheduleCallback(
+            multiplier=lambda e: 1.0 + e, staircase=False,
+            steps_per_epoch=10, momentum_correction=False)
+        cb.on_train_begin(state)
+        cb.on_epoch_begin(1, state)
+        cb.on_batch_begin(5, state)
+        assert cb._get_lr(state) == pytest.approx(1.0 + 1.5)
+
+    def test_lr_logged_at_epoch_end(self, hvd):
+        state, _ = make_state(lr=0.1)
+        cb = LearningRateScheduleCallback(multiplier=1.0,
+                                          momentum_correction=False)
+        cb.on_train_begin(state)
+        logs = {}
+        cb.on_epoch_end(0, state, logs=logs)
+        assert logs["lr"] == pytest.approx(0.1)
+
+    def test_update_uses_injected_lr(self, hvd):
+        """The jitted optax update must read the callback-set LR."""
+        state, tx = make_state(lr=0.0, momentum=0.0)
+        cb = LearningRateScheduleCallback(multiplier=1.0,
+                                          momentum_correction=False)
+        cb.on_train_begin(state)
+        cb.initial_lr = 1.0   # base for the multiplier
+        cb.on_epoch_begin(0, state)
+        cb.on_batch_begin(0, state)
+        grads = {"w": jnp.ones((3,))}
+        updates, _ = jax.jit(tx.update)(grads, state.opt_state, state.params)
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   -np.ones(3), rtol=1e-6)
+
+
+class TestWarmup:
+    def test_goyal_formula_reaches_size(self, hvd):
+        """After warmup_epochs the multiplier reaches 1 (i.e. lr returns to
+        base; with the reference's convention base lr is already scaled by
+        size, so ramp goes 1/size -> 1)."""
+        n = hvd.size()
+        state, _ = make_state(lr=float(n))
+        cb = LearningRateWarmupCallback(warmup_epochs=5, steps_per_epoch=10,
+                                        momentum_correction=False)
+        cb.params = {}
+        cb.on_train_begin(state)
+        # First batch of epoch 0: lr ≈ base/size
+        cb.on_epoch_begin(0, state)
+        cb.on_batch_begin(0, state)
+        first = cb._get_lr(state)
+        assert first == pytest.approx(
+            n * (1.0 / n) * ((0.1 / 5) * (n - 1) + 1), rel=1e-5)
+        # Last batch of the last warmup epoch: lr == base exactly
+        cb.on_epoch_begin(4, state)
+        cb.on_batch_begin(9, state)
+        assert cb._get_lr(state) == pytest.approx(float(n), rel=1e-6)
+
+    def test_monotonic_ramp(self, hvd):
+        state, _ = make_state(lr=8.0)
+        cb = LearningRateWarmupCallback(warmup_epochs=3, steps_per_epoch=4,
+                                        momentum_correction=False)
+        cb.on_train_begin(state)
+        lrs = []
+        for epoch in range(3):
+            cb.on_epoch_begin(epoch, state)
+            for b in range(4):
+                cb.on_batch_begin(b, state)
+                lrs.append(cb._get_lr(state))
+        assert all(b >= a for a, b in zip(lrs, lrs[1:])), lrs
+
+
+class TestMetricAverage:
+    def test_scalars_averaged(self, hvd):
+        logs = {"loss": 2.0, "acc": 0.5, "note": "skipme"}
+        MetricAverageCallback().on_epoch_end(0, TrainingState(), logs=logs)
+        # Replicated input: average across ranks is the value itself.
+        assert logs["loss"] == pytest.approx(2.0)
+        assert logs["acc"] == pytest.approx(0.5)
+        assert logs["note"] == "skipme"
+
+
+class TestBroadcastCallback:
+    def test_state_broadcast(self, hvd):
+        state, tx = make_state()
+        cb = BroadcastGlobalVariablesCallback(0)
+        cb.on_train_begin(state)
+        np.testing.assert_allclose(np.asarray(state.params["w"]), np.ones(3))
+        assert float(
+            find_hyperparams(state.opt_state)["learning_rate"]) == \
+            pytest.approx(0.1)
+
+
+class TestCallbackList:
+    def test_dispatch(self, hvd):
+        state, _ = make_state()
+        calls = []
+
+        class Probe(LearningRateScheduleCallback):
+            def on_epoch_begin(self, epoch, state, logs=None):
+                calls.append(epoch)
+                super().on_epoch_begin(epoch, state, logs)
+
+        cl = CallbackList([Probe(multiplier=1.0)], state,
+                          params={"steps": 10})
+        cl.on_train_begin()
+        cl.on_epoch_begin(3)
+        assert calls == [3]

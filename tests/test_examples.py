@@ -1,0 +1,44 @@
+"""Example smoke tests — the reference CI runs shortened versions of its
+examples as integration tests (.travis.yml:112-130, e.g. tensorflow_mnist
+with steps 20000→100); same idea here with tiny configs."""
+
+import runpy
+import sys
+
+import pytest
+
+
+def run_example(monkeypatch, path, argv):
+    monkeypatch.setattr(sys, "argv", ["x"] + argv)
+    return runpy.run_path(path, run_name="__main__")
+
+
+def test_mnist_example(hvd, monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["x", "--epochs", "1",
+                                      "--batch-size", "16"])
+    ns = runpy.run_path("examples/jax_mnist.py")
+    acc = ns["main"]()
+    assert acc > 0.9, f"synthetic MNIST should be learnable, got acc={acc}"
+
+
+def test_word2vec_example(hvd, monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", [
+        "x", "--steps", "30", "--vocab", "300", "--dim", "16",
+        "--batch-size", "16"])
+    runpy.run_path("examples/jax_word2vec.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "pairs/sec" in out
+
+
+def test_imagenet_example_resume(hvd, monkeypatch, tmp_path, capsys):
+    args = ["--batch-size", "2", "--steps-per-epoch", "2",
+            "--image-size", "32", "--warmup-epochs", "1",
+            "--checkpoint-dir", str(tmp_path)]
+    monkeypatch.setattr(sys, "argv", ["x", "--epochs", "1"] + args)
+    runpy.run_path("examples/jax_imagenet_resnet50.py", run_name="__main__")
+    monkeypatch.setattr(sys, "argv", ["x", "--epochs", "2"] + args)
+    runpy.run_path("examples/jax_imagenet_resnet50.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "epoch 0" in out and "epoch 1" in out
+    # The resume run must not retrain epoch 0.
+    assert out.count("epoch 0:") == 1
