@@ -31,5 +31,9 @@ from horovod_tpu.ops.injit import (        # noqa: F401
     SUM, AVERAGE, MIN, MAX,
 )
 from horovod_tpu.compression import Compression   # noqa: F401
+# Submodule surfaces (imported last — they depend on the names above):
+from horovod_tpu import jax                # noqa: F401, E402
+from horovod_tpu import callbacks          # noqa: F401, E402
+from horovod_tpu import sparse             # noqa: F401, E402
 
 __version__ = "0.1.0"

@@ -32,7 +32,8 @@ def _checkpointer():
 
 
 def checkpoint_path(directory: str, epoch: int) -> str:
-    return os.path.join(directory, f"checkpoint-{epoch}")
+    # orbax requires absolute paths; accept relative ones at this API.
+    return os.path.join(os.path.abspath(directory), f"checkpoint-{epoch}")
 
 
 def save(directory: str, state: Any, epoch: int) -> Optional[str]:

@@ -65,14 +65,20 @@ def make_train_step(
     compression: Compressor = NoneCompressor,
     sync_aux_state: bool = True,
     donate: bool = True,
+    batch_spec=None,
 ):
     """Build a jitted data-parallel training step over ``mesh``.
 
     ``loss_fn(params, aux_state, batch) -> (loss, new_aux_state)`` where
     ``params`` is the differentiable pytree, ``aux_state`` carries
     non-differentiable model state (e.g. flax ``batch_stats``; pass ``{}``
-    if none), and ``batch`` is the *global* batch — it is split across every
-    mesh axis on its leading dimension.
+    if none), and ``batch`` is the *global* batch.
+
+    ``batch_spec`` controls how batch leaves shard over the mesh; the
+    default splits the leading dimension across every mesh axis (pure data
+    parallel).  Pass e.g. ``P("dp", "sp")`` for a 2-D data × sequence
+    layout (batch dim on ``dp``, sequence dim on ``sp`` — the loss_fn's
+    model must then use the matching ``sp_axis``).
 
     Returns ``step(params, aux_state, opt_state, batch) ->
     (params, aux_state, opt_state, loss)`` — one XLA program containing
@@ -99,7 +105,8 @@ def make_train_step(
         return params, new_aux, opt_state, loss
 
     replicated = P()
-    batch_spec = P(axes)   # leading dim split over every mesh axis
+    if batch_spec is None:
+        batch_spec = P(axes)   # leading dim split over every mesh axis
     step = shard_map(
         spmd_body, mesh=mesh,
         in_specs=(replicated, replicated, replicated, batch_spec),

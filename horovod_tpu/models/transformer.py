@@ -1,0 +1,119 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+Not in the reference (it predates transformers' dominance and is DP-only);
+included because long-context sequence parallelism is first-class in this
+framework.  TPU-first choices: bf16 compute / f32 params, static shapes,
+pre-norm blocks, and a pluggable attention implementation:
+
+* ``attn="full"``    — single-shard full attention (no SP),
+* ``attn="ring"``    — :func:`horovod_tpu.parallel.ring_attention` (K/V ring
+  over the mesh axis; sequence length scales with chips),
+* ``attn="ulysses"`` — :func:`horovod_tpu.parallel.ulysses` (all-to-all
+  head/sequence re-shard).
+
+With ``attn != "full"`` the module must run inside shard_map with the
+sequence dimension sharded on ``sp_axis`` and tokens laid out rank-major;
+position embeddings are computed from the global position (rank offset).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+from horovod_tpu.parallel.ring_attention import full_attention, ring_attention
+from horovod_tpu.parallel.ulysses import ulysses_attention
+
+
+class Attention(nn.Module):
+    num_heads: int
+    attn: str = "full"
+    sp_axis: Any = RANKS_AXIS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        D = C // self.num_heads
+        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, self.num_heads, D)
+        k = k.reshape(B, T, self.num_heads, D)
+        v = v.reshape(B, T, self.num_heads, D)
+        if self.attn == "ring":
+            out = ring_attention(q, k, v, axis_name=self.sp_axis,
+                                 causal=True)
+        elif self.attn == "ulysses":
+            out = ulysses_attention(q, k, v, axis_name=self.sp_axis,
+                                    causal=True)
+        elif self.attn == "full":
+            out = full_attention(q, k, v, causal=True)
+        else:
+            raise ValueError(f"unknown attention impl: {self.attn!r}")
+        out = out.reshape(B, T, C)
+        return nn.Dense(C, use_bias=False, dtype=self.dtype,
+                        param_dtype=jnp.float32, name="proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attn: str = "full"
+    sp_axis: Any = RANKS_AXIS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(self.num_heads, self.attn, self.sp_axis,
+                          self.dtype, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(C, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc2")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over token ids.
+
+    Input: (B, T_local) int32 token ids — the full sequence when
+    ``attn="full"``, this rank's shard otherwise.
+    """
+    vocab: int
+    dim: int = 256
+    depth: int = 4
+    num_heads: int = 8
+    max_len: int = 2048
+    attn: str = "full"
+    sp_axis: Any = RANKS_AXIS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        B, T = tokens.shape
+        if self.attn == "full":
+            offset = 0
+        else:
+            offset = lax.axis_index(self.sp_axis) * T
+        pos = offset + jnp.arange(T)
+        tok_emb = nn.Embed(self.vocab, self.dim, param_dtype=jnp.float32,
+                           dtype=self.dtype, name="tok_emb")(tokens)
+        pos_emb = nn.Embed(self.max_len, self.dim, param_dtype=jnp.float32,
+                           dtype=self.dtype, name="pos_emb")(pos)
+        x = tok_emb + pos_emb[None]
+        for i in range(self.depth):
+            x = Block(self.num_heads, attn=self.attn, sp_axis=self.sp_axis,
+                      dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)

@@ -1,0 +1,128 @@
+"""Ring attention — sequence parallelism for long contexts.
+
+Beyond the reference's scope (it is data-parallel only, SURVEY §5.7) but
+first-class here: the sequence dimension is sharded across the rank mesh and
+attention runs blockwise while K/V shards rotate around the ICI ring via
+``lax.ppermute`` (Liu et al., "Ring Attention with Blockwise Transformers";
+the public pattern — this is an independent implementation).
+
+TPU mapping:
+
+* each hop moves one K/V block to the ICI neighbour — bandwidth-optimal on
+  the torus, and XLA overlaps the ``ppermute`` with the current block's
+  attention math (communication hides behind the MXU);
+* the online-softmax accumulators keep everything in f32 while Q/K/V stay
+  bf16 — the numerics of flash attention, streamed over ranks instead of
+  SRAM tiles;
+* memory per chip is O(T_local²·…/T) — context length scales linearly with
+  the number of chips.
+
+Known wall-clock limitation: with ``causal=True`` and the rank-major shard
+layout, later hops are fully masked for low ranks, but every hop's latency
+is set by the ranks that do attend — the classic imbalance that a
+striped/zigzag block layout removes.  Rank-major is kept here because it
+matches the framework's data layout contract; a zigzag variant is a
+planned optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_attend(q, k, v, pos_q, pos_k, causal, scale):
+    """One (Q-local × K-block) attention contribution with explicit
+    allowed-mask (never relies on exp(-inf))."""
+    # q: (B, Tq, H, D), k/v: (B, Tk, H, D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        allowed = pos_k[None, :] <= pos_q[:, None]        # (Tq, Tk)
+        logits = jnp.where(allowed[None, None, :, :], logits, _NEG_BIG)
+        p_mask = allowed[None, None, :, :]
+    else:
+        p_mask = None
+    block_max = jnp.max(logits, axis=-1)                  # (B, H, Tq)
+    p = jnp.exp(logits - block_max[..., None])
+    if p_mask is not None:
+        p = jnp.where(p_mask, p, 0.0)
+    block_sum = jnp.sum(p, axis=-1)                       # (B, H, Tq)
+    block_out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                           v.astype(jnp.float32))
+    return block_max, block_sum, block_out
+
+
+def ring_attention(q, k, v, *, axis_name=RANKS_AXIS, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Blockwise self-attention over a sequence sharded on ``axis_name``.
+
+    ``q``/``k``/``v``: (batch, seq_local, heads, head_dim) — this rank's
+    sequence shard; shards are laid out rank-major (rank r holds positions
+    ``[r*T_local, (r+1)*T_local)``).  Returns the attention output in the
+    same layout.  Must run under shard_map/pmap with ``axis_name`` bound.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    pos_q = my * T + jnp.arange(T)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        o, m, l, kv = carry
+        k_blk, v_blk = kv
+        src = (my - s) % n
+        pos_k = src * T + jnp.arange(T)
+        bm, bs, bo = _block_attend(q, k_blk, v_blk, pos_q, pos_k, causal,
+                                   scale)
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)            # rescale old accumulators
+        beta = jnp.exp(bm - new_m)            # rescale this block
+        l = l * alpha + bs * beta
+        o = o * alpha.transpose(0, 2, 1)[..., None] + \
+            bo * beta.transpose(0, 2, 1)[..., None]
+        # Rotate K/V to the next ring position; overlaps with next block's
+        # math under XLA's async collective scheduling.
+        kv = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm=perm), kv)
+        return o, new_m, l, kv
+
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, H, T), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o, m, l, _ = lax.fori_loop(0, n, body, (o0, m0, l0, (k, v)))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal: bool = True,
+                   scale: Optional[float] = None,
+                   q_offset: int = 0, k_offset: int = 0):
+    """Single-device reference attention (same math, no ring) — used by the
+    tests as the oracle and by the transformer when sequence parallelism is
+    off."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    pos_q = q_offset + jnp.arange(Tq)
+    pos_k = k_offset + jnp.arange(Tk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        allowed = pos_k[None, :] <= pos_q[:, None]
+        logits = jnp.where(allowed[None, None, :, :], logits, _NEG_BIG)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

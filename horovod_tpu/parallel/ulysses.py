@@ -1,0 +1,54 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second standard long-context strategy (DeepSpeed-Ulysses pattern;
+independent implementation): instead of rotating K/V blocks around a ring,
+one ``all_to_all`` re-shards activations from sequence-sharded to
+head-sharded, attention runs locally with the FULL sequence for this rank's
+subset of heads, and a second ``all_to_all`` restores sequence sharding.
+
+Trade-off vs ring attention: 2 all-to-alls of the activations per layer
+(cheap on an ICI torus) and full-sequence memory for 1/n of the heads —
+better when heads ≥ ranks and T_local is small; ring attention wins when
+the sequence is huge and heads are few.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+from horovod_tpu.parallel.mesh import RANKS_AXIS
+from horovod_tpu.parallel.ring_attention import full_attention
+
+
+def seq_to_heads(x, *, axis_name=RANKS_AXIS):
+    """(B, T_local, H, D) → (B, T_global, H/n, D): gather sequence, split
+    heads across ranks."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, *, axis_name=RANKS_AXIS):
+    """(B, T_global, H/n, D) → (B, T_local, H, D): inverse re-shard."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name=RANKS_AXIS, causal: bool = True,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """Self-attention over a sequence sharded on ``axis_name`` via the
+    all-to-all strategy.  Heads must be divisible by the axis size.
+
+    ``attn_fn(q, k, v, causal=..., scale=...)`` may override the local
+    attention kernel (e.g. a Pallas flash-attention); defaults to the
+    reference full attention.
+    """
+    if attn_fn is None:
+        attn_fn = full_attention
+    q = seq_to_heads(q, axis_name=axis_name)
+    k = seq_to_heads(k, axis_name=axis_name)
+    v = seq_to_heads(v, axis_name=axis_name)
+    out = attn_fn(q, k, v, causal=causal, scale=scale)
+    return heads_to_seq(out, axis_name=axis_name)

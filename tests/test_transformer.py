@@ -1,0 +1,116 @@
+"""Transformer LM + dp×sp (data × sequence parallel) training tests.
+
+Checks that the sequence-parallel transformer computes the same loss as the
+single-shard full-attention model with identical params, and that a 2-D
+(dp, sp) mesh training step runs and learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import basics
+from horovod_tpu.models import TransformerLM
+from horovod_tpu.parallel.mesh import build_mesh
+
+
+VOCAB, DIM, DEPTH, HEADS = 64, 32, 2, 4
+
+
+def data(batch, seqlen, seed=0):
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, VOCAB, (batch, seqlen + 1)).astype(np.int32)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def loss_of(model, params, tokens, labels):
+    logits = model.apply({"params": params}, tokens)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_sp_loss_matches_full(hvd, attn):
+    """Same params, same tokens: sequence-parallel loss == full loss."""
+    n = hvd.size()
+    # Ulysses shards heads across ranks, so it needs heads % ranks == 0.
+    heads = HEADS if attn == "ring" else n
+    model_full = TransformerLM(vocab=VOCAB, dim=DIM * 2, depth=DEPTH,
+                               num_heads=heads, attn="full",
+                               dtype=jnp.float32)
+    params = model_full.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    T = 4 * n
+    tokens, labels = data(2, T)
+    want = float(loss_of(model_full, params, tokens, labels))
+
+    model_sp = TransformerLM(vocab=VOCAB, dim=DIM * 2, depth=DEPTH,
+                             num_heads=heads, attn=attn, sp_axis="ranks",
+                             dtype=jnp.float32)
+    mesh = hvd.ranks_mesh()
+
+    def body(params, tokens, labels):
+        logits = model_sp.apply({"params": params}, tokens)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return lax.pmean(loss, "ranks")
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(None, "ranks"), P(None, "ranks")),
+                   out_specs=P(), check_vma=False)
+    got = float(jax.jit(fn)(params, tokens, labels))
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_dp_sp_train_step(hvd):
+    """Full training step over a 2-D (dp, sp) mesh with ring attention:
+    batch sharded on dp, sequence sharded on sp, grads reduced over both."""
+    n = hvd.size()
+    if n % 2 != 0:
+        pytest.skip("needs an even device count")
+    dp, sp = 2, n // 2
+    mesh = build_mesh(basics._require_init().topology, (dp, sp),
+                      ("dp", "sp"))
+    T = 4 * sp
+    model = TransformerLM(vocab=VOCAB, dim=DIM, depth=DEPTH,
+                          num_heads=HEADS, attn="ring", sp_axis="sp",
+                          dtype=jnp.float32)
+    # Init with attn="full" semantics is wrong under sp; init params by
+    # tracing the sp model inside an abstract shard_map is complex — the
+    # param shapes do not depend on attention impl, so init the full twin.
+    twin = TransformerLM(vocab=VOCAB, dim=DIM, depth=DEPTH,
+                         num_heads=HEADS, attn="full", dtype=jnp.float32)
+    params = twin.init(jax.random.PRNGKey(1),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, tokens, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "sp")), grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, lax.pmean(loss, ("dp", "sp"))
+
+    fn = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    tokens, labels = data(2 * dp, T, seed=3)
+    tok_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+    lab_sh = jax.device_put(labels, NamedSharding(mesh, P("dp", "sp")))
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = fn(params, opt_state, tok_sh, lab_sh)
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
