@@ -13,6 +13,7 @@
 #include <string>
 #include <unordered_map>
 
+#include "htpu/control.h"
 #include "htpu/fusion.h"
 #include "htpu/message_table.h"
 #include "htpu/timeline.h"
@@ -183,6 +184,83 @@ void htpu_timeline_activity_end(void* tl, const char* name) {
 
 void htpu_timeline_close(void* tl) {
   static_cast<htpu::Timeline*>(tl)->Close();
+}
+
+// ------------------------------------------------- multi-process control
+
+void* htpu_control_create(int process_index, int process_count,
+                          const char* coord_host, int coord_port,
+                          int first_rank, int nranks_total, int timeout_ms) {
+  auto cp = htpu::ControlPlane::Create(process_index, process_count,
+                                       coord_host, coord_port, first_rank,
+                                       nranks_total, timeout_ms);
+  return cp.release();
+}
+
+void htpu_control_destroy(void* cp) {
+  delete static_cast<htpu::ControlPlane*>(cp);
+}
+
+// Serialized ResponseList into *out; length or -1.
+int htpu_control_tick(void* cp, const void* req_blob, int len,
+                      long long fusion_threshold, void** out) {
+  std::string blob(static_cast<const char*>(req_blob), size_t(len));
+  std::string result;
+  if (!static_cast<htpu::ControlPlane*>(cp)->Tick(blob, fusion_threshold,
+                                                  &result)) {
+    return -1;
+  }
+  return CopyOut(result, out);
+}
+
+int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
+                           long long len, void** out) {
+  std::string contrib(static_cast<const char*>(in), size_t(len));
+  std::string result;
+  if (!static_cast<htpu::ControlPlane*>(cp)->Allreduce(dtype, contrib,
+                                                       &result)) {
+    return -1;
+  }
+  return CopyOut(result, out);
+}
+
+int htpu_control_allgather(void* cp, const void* in, long long len,
+                           void** out) {
+  std::string contrib(static_cast<const char*>(in), size_t(len));
+  std::string result;
+  if (!static_cast<htpu::ControlPlane*>(cp)->Allgather(contrib, &result)) {
+    return -1;
+  }
+  return CopyOut(result, out);
+}
+
+int htpu_control_broadcast(void* cp, int root_process, const void* in,
+                           long long len, void** out) {
+  std::string contrib(static_cast<const char*>(in), size_t(len));
+  std::string result;
+  if (!static_cast<htpu::ControlPlane*>(cp)->Broadcast(root_process, contrib,
+                                                       &result)) {
+    return -1;
+  }
+  return CopyOut(result, out);
+}
+
+// Coordinator-side stall scan; same length-prefixed record format as
+// htpu_table_stalled.
+int htpu_control_stalled(void* cp, double age_s, void** out) {
+  auto stalled = static_cast<htpu::ControlPlane*>(cp)->Stalled(age_s);
+  std::string buf;
+  auto put_i32 = [&buf](int32_t v) {
+    for (int i = 0; i < 4; ++i)
+      buf.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+  };
+  for (const auto& kv : stalled) {
+    put_i32(int32_t(kv.first.size()));
+    buf += kv.first;
+    put_i32(int32_t(kv.second.size()));
+    for (int r : kv.second) put_i32(r);
+  }
+  return CopyOut(buf, out);
 }
 
 }  // extern "C"

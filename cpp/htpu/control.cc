@@ -1,0 +1,243 @@
+#include "htpu/control.h"
+
+#include <algorithm>
+#include <cstring>
+#include <numeric>
+#include <stdexcept>
+
+#include "htpu/fusion.h"
+#include "htpu/reduce.h"
+#include "htpu/transport.h"
+
+namespace htpu {
+
+namespace {
+
+// Handshake payload: process_index:i32 first_rank:i32 (little-endian).
+std::string HandshakeBlob(int process_index, int first_rank) {
+  std::string s;
+  for (int v : {process_index, first_rank}) {
+    for (int i = 0; i < 4; ++i)
+      s.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+  }
+  return s;
+}
+
+bool ParseHandshake(const std::string& s, int* process_index,
+                    int* first_rank) {
+  if (s.size() != 8) return false;
+  auto rd = [&s](int off) {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i)
+      v |= uint32_t(uint8_t(s[size_t(off + i)])) << (8 * i);
+    return int(v);
+  };
+  *process_index = rd(0);
+  *first_rank = rd(4);
+  return true;
+}
+
+}  // namespace
+
+std::unique_ptr<ControlPlane> ControlPlane::Create(
+    int process_index, int process_count, const std::string& coord_host,
+    int coord_port, int first_rank, int nranks_total, int timeout_ms) {
+  std::unique_ptr<ControlPlane> cp(new ControlPlane());
+  cp->process_index_ = process_index;
+  cp->process_count_ = process_count;
+  cp->first_rank_ = first_rank;
+  cp->timeout_ms_ = timeout_ms;
+
+  if (process_index == 0) {
+    cp->table_.reset(new MessageTable(nranks_total));
+    if (process_count > 1) {
+      cp->listen_fd_ = Listen(coord_port, nullptr);
+      if (cp->listen_fd_ < 0) return nullptr;
+      cp->worker_fds_.assign(size_t(process_count), -1);
+      cp->worker_first_rank_.assign(size_t(process_count), -1);
+      cp->worker_first_rank_[0] = first_rank;
+      for (int i = 1; i < process_count; ++i) {
+        int fd = AcceptOne(cp->listen_fd_, timeout_ms);
+        if (fd < 0) return nullptr;
+        std::string hs;
+        int pidx, frank;
+        if (!RecvFrame(fd, &hs, timeout_ms) ||
+            !ParseHandshake(hs, &pidx, &frank) || pidx <= 0 ||
+            pidx >= process_count || cp->worker_fds_[size_t(pidx)] != -1) {
+          CloseFd(fd);
+          return nullptr;
+        }
+        cp->worker_fds_[size_t(pidx)] = fd;
+        cp->worker_first_rank_[size_t(pidx)] = frank;
+      }
+    }
+  } else {
+    cp->coord_fd_ = DialRetry(coord_host, coord_port, timeout_ms);
+    if (cp->coord_fd_ < 0) return nullptr;
+    if (!SendFrame(cp->coord_fd_,
+                   HandshakeBlob(process_index, first_rank))) {
+      return nullptr;
+    }
+  }
+  return cp;
+}
+
+ControlPlane::~ControlPlane() {
+  for (int fd : worker_fds_) CloseFd(fd);
+  CloseFd(coord_fd_);
+  CloseFd(listen_fd_);
+}
+
+bool ControlPlane::Tick(const std::string& request_list_blob,
+                        int64_t fusion_threshold,
+                        std::string* response_list_blob) {
+  if (!is_coordinator()) {
+    // Worker: send our request list, wait for the response list.
+    return SendFrame(coord_fd_, request_list_blob) &&
+           RecvFrame(coord_fd_, response_list_blob, timeout_ms_);
+  }
+
+  // Coordinator: gather lists (own + one frame per worker, any order of
+  // arrival but deterministic processing order by process index).
+  bool shutdown = false;
+  std::vector<Request> all_requests;
+  std::unordered_map<std::string, const Request*> shape_info;
+
+  auto absorb = [&](const std::string& blob) -> bool {
+    RequestList list;
+    if (!ParseRequestList(
+            reinterpret_cast<const uint8_t*>(blob.data()), blob.size(),
+            &list)) {
+      return false;
+    }
+    shutdown = shutdown || list.shutdown;
+    for (auto& r : list.requests) all_requests.push_back(std::move(r));
+    return true;
+  };
+
+  if (!absorb(request_list_blob)) return false;
+  for (int i = 1; i < process_count_; ++i) {
+    std::string blob;
+    if (!RecvFrame(worker_fds_[size_t(i)], &blob, timeout_ms_)) return false;
+    if (!absorb(blob)) return false;
+  }
+
+  ResponseList out;
+  out.shutdown = shutdown;
+  std::unordered_map<std::string, Request> first_request;
+  for (const Request& r : all_requests) {
+    first_request.emplace(r.tensor_name, r);
+    bool ready;
+    try {
+      ready = table_->Increment(r);
+    } catch (const std::out_of_range&) {
+      Response err;
+      err.response_type = ResponseType::ERROR;
+      err.tensor_names = {r.tensor_name};
+      err.error_message = "Request rank out of range.";
+      out.responses.push_back(std::move(err));
+      continue;
+    }
+    if (ready) {
+      out.responses.push_back(table_->ConstructResponse(r.tensor_name));
+    }
+  }
+
+  // Fusion: payload sizes derived from the negotiated request shapes.
+  auto entry_bytes = [&](const std::string& name) -> int64_t {
+    auto it = first_request.find(name);
+    if (it == first_request.end()) return 0;
+    int64_t n = 1;
+    for (int64_t d : it->second.tensor_shape) n *= d;
+    return n * DtypeSize(it->second.tensor_type);
+  };
+  auto entry_dtype = [&](const std::string& name) -> std::string {
+    auto it = first_request.find(name);
+    return it == first_request.end() ? std::string()
+                                     : it->second.tensor_type;
+  };
+  out.responses =
+      PlanFusion(out.responses, entry_bytes, entry_dtype, fusion_threshold);
+
+  SerializeResponseList(out, response_list_blob);
+  for (int i = 1; i < process_count_; ++i) {
+    if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) return false;
+  }
+  return true;
+}
+
+bool ControlPlane::Allreduce(const std::string& dtype, const std::string& in,
+                             std::string* out) {
+  if (!is_coordinator()) {
+    return SendFrame(coord_fd_, in) &&
+           RecvFrame(coord_fd_, out, timeout_ms_);
+  }
+  *out = in;
+  for (int i = 1; i < process_count_; ++i) {
+    std::string contrib;
+    if (!RecvFrame(worker_fds_[size_t(i)], &contrib, timeout_ms_))
+      return false;
+    if (contrib.size() != out->size()) return false;
+    if (!SumInto(dtype, &(*out)[0], contrib.data(),
+                 int64_t(contrib.size()))) {
+      return false;
+    }
+  }
+  for (int i = 1; i < process_count_; ++i) {
+    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
+  }
+  return true;
+}
+
+bool ControlPlane::Allgather(const std::string& in, std::string* out) {
+  if (!is_coordinator()) {
+    return SendFrame(coord_fd_, in) &&
+           RecvFrame(coord_fd_, out, timeout_ms_);
+  }
+  // Concatenate contributions in global-rank order.
+  std::vector<std::string> parts(static_cast<size_t>(process_count_));
+  parts[0] = in;
+  for (int i = 1; i < process_count_; ++i) {
+    if (!RecvFrame(worker_fds_[size_t(i)], &parts[size_t(i)], timeout_ms_))
+      return false;
+  }
+  std::vector<int> order(static_cast<size_t>(process_count_));
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    return worker_first_rank_[size_t(a)] < worker_first_rank_[size_t(b)];
+  });
+  out->clear();
+  for (int idx : order) *out += parts[size_t(idx)];
+  for (int i = 1; i < process_count_; ++i) {
+    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
+  }
+  return true;
+}
+
+bool ControlPlane::Broadcast(int root_process, const std::string& in,
+                             std::string* out) {
+  if (!is_coordinator()) {
+    // Root worker ships its payload up; everyone receives the result.
+    if (process_index_ == root_process && !SendFrame(coord_fd_, in))
+      return false;
+    return RecvFrame(coord_fd_, out, timeout_ms_);
+  }
+  if (root_process == 0) {
+    *out = in;
+  } else if (!RecvFrame(worker_fds_[size_t(root_process)], out,
+                        timeout_ms_)) {
+    return false;
+  }
+  for (int i = 1; i < process_count_; ++i) {
+    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
+  }
+  return true;
+}
+
+std::vector<std::pair<std::string, std::vector<int>>> ControlPlane::Stalled(
+    double age_s) const {
+  if (!table_) return {};
+  return table_->Stalled(age_s);
+}
+
+}  // namespace htpu

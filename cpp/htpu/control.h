@@ -1,0 +1,85 @@
+// Multi-process control plane: negotiation + eager data plane over TCP.
+//
+// Native equivalent of the reference's per-tick MPI protocol
+// (operations.cc:1665-1903):
+//   a) every process sends its RequestList to the coordinator
+//      (MPI_Gather/Gatherv there; one TCP frame here),
+//   b) the coordinator feeds the shared MessageTable, constructs validated
+//      responses for tensors that became ready, fuses consecutive
+//      allreduces (PlanFusion), and
+//   c) broadcasts the ResponseList to every process (MPI_Bcast there).
+//
+// The eager data plane replaces the reference's CPU MPI_Allreduce /
+// Allgatherv / Bcast (operations.cc:1232-1353) with coordinator-rooted
+// reduce + broadcast over the same connections; payload ordering is
+// deterministic because every process executes the identical response list
+// in order.  (The in-jit hot path never touches this — it rides XLA
+// collectives over ICI; this plane serves the dynamic eager API across
+// hosts.)
+#ifndef HTPU_CONTROL_H_
+#define HTPU_CONTROL_H_
+
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "htpu/message_table.h"
+#include "htpu/wire.h"
+
+namespace htpu {
+
+class ControlPlane {
+ public:
+  // Coordinator (process_index 0) listens on coord_port; workers dial
+  // coord_host:coord_port.  first_rank orders multi-rank processes for
+  // allgather.  Blocks until the full job is connected; nullptr on failure.
+  static std::unique_ptr<ControlPlane> Create(
+      int process_index, int process_count, const std::string& coord_host,
+      int coord_port, int first_rank, int nranks_total, int timeout_ms);
+
+  ~ControlPlane();
+
+  // One negotiation tick (blocking, collective across all processes).
+  bool Tick(const std::string& request_list_blob, int64_t fusion_threshold,
+            std::string* response_list_blob);
+
+  // Eager data-plane collectives (blocking, collective; must be called in
+  // the same order on every process).  `in` is this process's contribution
+  // (allreduce: locally pre-summed across local ranks; allgather: local
+  // ranks' parts concatenated in rank order; broadcast: root's bytes, empty
+  // elsewhere).
+  bool Allreduce(const std::string& dtype, const std::string& in,
+                 std::string* out);
+  bool Allgather(const std::string& in, std::string* out);
+  bool Broadcast(int root_process, const std::string& in, std::string* out);
+
+  // Coordinator-side stall scan (empty on workers).
+  std::vector<std::pair<std::string, std::vector<int>>> Stalled(
+      double age_s) const;
+
+  int process_count() const { return process_count_; }
+
+ private:
+  ControlPlane() = default;
+
+  bool is_coordinator() const { return process_index_ == 0; }
+
+  int process_index_ = 0;
+  int process_count_ = 0;
+  int first_rank_ = 0;
+  int timeout_ms_ = 60000;
+
+  // Coordinator: connection fd per worker process (index 1..n-1), ordered
+  // by process index; worker: single fd to the coordinator.
+  std::vector<int> worker_fds_;
+  std::vector<int> worker_first_rank_;
+  int coord_fd_ = -1;
+  int listen_fd_ = -1;
+
+  std::unique_ptr<MessageTable> table_;   // coordinator only
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_CONTROL_H_

@@ -1,0 +1,108 @@
+// Software float16 / bfloat16 arithmetic for the host data plane.
+//
+// Native equivalent of the reference's half.{h,cc} (bit-level fp16<->fp32
+// conversion + custom MPI float16 sum, horovod/common/half.h:37-133,
+// half.cc:42-76) — re-implemented for the TPU stack where BOTH IEEE fp16
+// and bfloat16 appear on the wire.  Plain scalar loops; the compiler
+// auto-vectorizes them (-O2) on the host CPU, replacing the reference's
+// hand-written F16C/AVX path.
+#ifndef HTPU_HALF_H_
+#define HTPU_HALF_H_
+
+#include <cstdint>
+#include <cstring>
+
+namespace htpu {
+
+// IEEE binary16 -> binary32, bit-exact (subnormals and inf/nan included).
+inline float HalfBits2Float(uint16_t h) {
+  uint32_t sign = uint32_t(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ff;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;                          // +-0
+    } else {                             // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while ((man & 0x400) == 0) {
+        man <<= 1;
+        --exp;
+      }
+      man &= 0x3ff;
+      f = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000 | (man << 13);  // inf / nan
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, sizeof(out));
+  return out;
+}
+
+// binary32 -> binary16 with round-to-nearest-even.
+inline uint16_t Float2HalfBits(float v) {
+  uint32_t f;
+  std::memcpy(&f, &v, sizeof(f));
+  uint32_t sign = (f >> 16) & 0x8000;
+  int32_t exp = int32_t((f >> 23) & 0xff) - 127 + 15;
+  uint32_t man = f & 0x7fffff;
+  if (((f >> 23) & 0xff) == 0xff) {           // inf / nan
+    return uint16_t(sign | 0x7c00 | (man ? 0x200 : 0));
+  }
+  if (exp >= 0x1f) return uint16_t(sign | 0x7c00);   // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return uint16_t(sign);      // underflow -> 0
+    man |= 0x800000;                           // subnormal
+    uint32_t shift = uint32_t(14 - exp);
+    uint32_t half_man = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_man & 1))) ++half_man;
+    return uint16_t(sign | half_man);
+  }
+  uint32_t half_man = man >> 13;
+  uint32_t rem = man & 0x1fff;
+  uint16_t out = uint16_t(sign | (uint32_t(exp) << 10) | half_man);
+  if (rem > 0x1000 || (rem == 0x1000 && (out & 1))) ++out;
+  return out;
+}
+
+// bfloat16 is fp32's top 16 bits.
+inline float BfloatBits2Float(uint16_t b) {
+  uint32_t f = uint32_t(b) << 16;
+  float out;
+  std::memcpy(&out, &f, sizeof(out));
+  return out;
+}
+
+inline uint16_t Float2BfloatBits(float v) {
+  uint32_t f;
+  std::memcpy(&f, &v, sizeof(f));
+  // round-to-nearest-even on the dropped 16 bits (NaN-safe: rounding can't
+  // turn a NaN payload into inf because mantissa MSB survives).
+  uint32_t rounded = f + 0x7fff + ((f >> 16) & 1);
+  if ((f & 0x7f800000) == 0x7f800000) rounded = f;  // keep inf/nan exact
+  return uint16_t(rounded >> 16);
+}
+
+// Elementwise sums on raw buffers (the data-plane reduction kernels;
+// reference half.cc:42-76 does the fp16 case for MPI_Op).
+inline void HalfSumInto(uint16_t* acc, const uint16_t* in, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    acc[i] = Float2HalfBits(HalfBits2Float(acc[i]) + HalfBits2Float(in[i]));
+  }
+}
+
+inline void BfloatSumInto(uint16_t* acc, const uint16_t* in, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    acc[i] =
+        Float2BfloatBits(BfloatBits2Float(acc[i]) + BfloatBits2Float(in[i]));
+  }
+}
+
+}  // namespace htpu
+
+#endif  // HTPU_HALF_H_

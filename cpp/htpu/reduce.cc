@@ -1,0 +1,61 @@
+#include "htpu/reduce.h"
+
+#include "htpu/half.h"
+
+namespace htpu {
+
+namespace {
+
+template <typename T>
+void TypedSum(void* acc, const void* in, int64_t n) {
+  T* a = static_cast<T*>(acc);
+  const T* b = static_cast<const T*>(in);
+  for (int64_t i = 0; i < n; ++i) a[i] += b[i];
+}
+
+void BoolOr(void* acc, const void* in, int64_t n) {
+  // Summing bools saturates at true (logical OR), matching numpy's
+  // bool add semantics.
+  uint8_t* a = static_cast<uint8_t*>(acc);
+  const uint8_t* b = static_cast<const uint8_t*>(in);
+  for (int64_t i = 0; i < n; ++i) a[i] = (a[i] | b[i]) ? 1 : 0;
+}
+
+}  // namespace
+
+int DtypeSize(const std::string& d) {
+  if (d == "float32" || d == "int32" || d == "uint32") return 4;
+  if (d == "float64" || d == "int64" || d == "uint64") return 8;
+  if (d == "float16" || d == "bfloat16" || d == "int16" || d == "uint16")
+    return 2;
+  if (d == "int8" || d == "uint8" || d == "bool") return 1;
+  return 0;
+}
+
+bool SumInto(const std::string& d, void* acc, const void* in,
+             int64_t nbytes) {
+  int esize = DtypeSize(d);
+  if (esize == 0 || nbytes % esize != 0) return false;
+  int64_t n = nbytes / esize;
+  if (d == "float32") TypedSum<float>(acc, in, n);
+  else if (d == "float64") TypedSum<double>(acc, in, n);
+  else if (d == "int32") TypedSum<int32_t>(acc, in, n);
+  else if (d == "uint32") TypedSum<uint32_t>(acc, in, n);
+  else if (d == "int64") TypedSum<int64_t>(acc, in, n);
+  else if (d == "uint64") TypedSum<uint64_t>(acc, in, n);
+  else if (d == "int16") TypedSum<int16_t>(acc, in, n);
+  else if (d == "uint16") TypedSum<uint16_t>(acc, in, n);
+  else if (d == "int8") TypedSum<int8_t>(acc, in, n);
+  else if (d == "uint8") TypedSum<uint8_t>(acc, in, n);
+  else if (d == "float16")
+    HalfSumInto(static_cast<uint16_t*>(acc),
+                static_cast<const uint16_t*>(in), n);
+  else if (d == "bfloat16")
+    BfloatSumInto(static_cast<uint16_t*>(acc),
+                  static_cast<const uint16_t*>(in), n);
+  else if (d == "bool") BoolOr(acc, in, n);
+  else return false;
+  return true;
+}
+
+}  // namespace htpu

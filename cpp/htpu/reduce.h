@@ -1,0 +1,27 @@
+// Typed elementwise reduction for the host (eager) data plane.
+//
+// Role parity: the reference's CPU data plane hands fusion buffers to
+// MPI_Allreduce with a built-in or custom op (operations.cc:1268-1281,
+// half.cc); here the coordinator applies the sum itself as worker payloads
+// arrive, dispatching on the numpy-style dtype name carried by the wire
+// Request.
+#ifndef HTPU_REDUCE_H_
+#define HTPU_REDUCE_H_
+
+#include <cstdint>
+#include <string>
+
+namespace htpu {
+
+// acc += in, elementwise over `count` elements of dtype `dtype_name`
+// (numpy names: float32, float64, int8..int64, uint8..uint64, float16,
+// bfloat16, bool). Returns false on unknown dtype or misaligned size.
+bool SumInto(const std::string& dtype_name, void* acc, const void* in,
+             int64_t nbytes);
+
+// Element size in bytes for a supported dtype name, or 0.
+int DtypeSize(const std::string& dtype_name);
+
+}  // namespace htpu
+
+#endif  // HTPU_REDUCE_H_

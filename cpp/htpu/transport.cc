@@ -1,0 +1,136 @@
+#include "htpu/transport.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace htpu {
+
+namespace {
+
+bool WaitReadable(int fd, int timeout_ms) {
+  struct pollfd p;
+  p.fd = fd;
+  p.events = POLLIN;
+  int rc = poll(&p, 1, timeout_ms);
+  return rc > 0 && (p.revents & POLLIN);
+}
+
+bool SendAll(int fd, const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len > 0) {
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= size_t(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    if (!WaitReadable(fd, timeout_ms)) return false;
+    ssize_t n = recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= size_t(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+int DialRetry(const std::string& host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0) {
+      struct sockaddr_in addr;
+      std::memset(&addr, 0, sizeof(addr));
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(uint16_t(port));
+      if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) == 1 &&
+          connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return fd;
+      }
+      close(fd);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+int Listen(int port, int* out_port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (out_port) {
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+    *out_port = ntohs(addr.sin_port);
+  }
+  return fd;
+}
+
+int AcceptOne(int listen_fd, int timeout_ms) {
+  if (!WaitReadable(listen_fd, timeout_ms)) return -1;
+  int fd = accept(listen_fd, nullptr, nullptr);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+bool SendFrame(int fd, const std::string& payload) {
+  uint32_t len = uint32_t(payload.size());
+  char hdr[4];
+  for (int i = 0; i < 4; ++i) hdr[i] = char((len >> (8 * i)) & 0xff);
+  return SendAll(fd, hdr, 4) && SendAll(fd, payload.data(), payload.size());
+}
+
+bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
+  uint8_t hdr[4];
+  if (!RecvAll(fd, hdr, 4, timeout_ms)) return false;
+  uint32_t len = 0;
+  for (int i = 0; i < 4; ++i) len |= uint32_t(hdr[i]) << (8 * i);
+  if (len > (1u << 30)) return false;   // sanity: 1 GB frame cap
+  payload->resize(len);
+  return len == 0 || RecvAll(fd, &(*payload)[0], len, timeout_ms);
+}
+
+void CloseFd(int fd) {
+  if (fd >= 0) close(fd);
+}
+
+}  // namespace htpu

@@ -1,0 +1,43 @@
+// Framed TCP transport for the multi-process control plane.
+//
+// The reference's control plane is MPI: MPI_Gather(lengths) +
+// MPI_Gatherv(bodies) to rank 0, MPI_Bcast of the response list each tick
+// (operations.cc:1742-1763, 1844-1888).  The TPU-native equivalent has no
+// MPI: process 0 listens on a TCP socket (the address comes from the same
+// coordinator discovery used for jax.distributed), workers connect once at
+// init, and the same gather/broadcast pattern runs over length-framed
+// messages.  One connection per worker, used serially by the background
+// tick — no multiplexing needed.
+//
+// Frame format: u32 little-endian payload length, then payload bytes.
+// A tag byte inside payloads distinguishes message kinds (control.h).
+#ifndef HTPU_TRANSPORT_H_
+#define HTPU_TRANSPORT_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace htpu {
+
+// Returns a connected socket fd, or -1 (retries `timeout_ms` total).
+int DialRetry(const std::string& host, int port, int timeout_ms);
+
+// Listening socket on port (0 = ephemeral); returns fd or -1.
+// `out_port` receives the bound port.
+int Listen(int port, int* out_port);
+
+// Accept one connection (blocking, with timeout); fd or -1.
+int AcceptOne(int listen_fd, int timeout_ms);
+
+// Send a length-framed message; false on error.
+bool SendFrame(int fd, const std::string& payload);
+
+// Receive a length-framed message; false on error/EOF/timeout.
+bool RecvFrame(int fd, std::string* payload, int timeout_ms);
+
+void CloseFd(int fd);
+
+}  // namespace htpu
+
+#endif  // HTPU_TRANSPORT_H_

@@ -455,6 +455,36 @@ class Controller:
         from horovod_tpu import cpp_core
         self._use_cpp = cpp_core.available()
 
+        # Multi-process mode: negotiation + eager data plane ride the native
+        # TCP control plane (reference: MPI gather/bcast + CPU data plane).
+        self._control = None
+        self._rank_to_process: Dict[int, int] = {}
+        coord_addr = os.environ.get("HOROVOD_TPU_COORD_ADDR", "")
+        if coord_addr and topology.process_count > 1:
+            if not self._use_cpp:
+                raise RuntimeError(
+                    "multi-process mode requires the native core "
+                    "(unset HOROVOD_TPU_NO_CPP)")
+            host, _, port = coord_addr.rpartition(":")
+            timeout_ms = int(float(os.environ.get(
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S", "60")) * 1000)
+            self._control = cpp_core.CppControlPlane(
+                topology.process_index, topology.process_count,
+                host or "127.0.0.1", int(port), topology.rank,
+                topology.size, timeout_ms)
+            # Exchange the process layout once: (process_index, first_rank,
+            # local_size) per process -> global rank->process map (the
+            # reference gets this from MPI comm splits,
+            # operations.cc:1499-1532).
+            import struct
+            mine = struct.pack("<3i", topology.process_index, topology.rank,
+                               topology.local_size)
+            blob = self._control.allgather(mine)
+            for off in range(0, len(blob), 12):
+                pidx, frank, lsize = struct.unpack_from("<3i", blob, off)
+                for r in range(frank, frank + lsize):
+                    self._rank_to_process[r] = pidx
+
         self.timeline = None
         timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")
         if timeline_path and topology.rank == 0:
@@ -479,8 +509,14 @@ class Controller:
         self._thread: Optional[threading.Thread] = None
         self._last_stall_check = time.monotonic()
 
-        from horovod_tpu.ops.executor import Executor
-        self._executor = Executor(topology, mesh, self.timeline)
+        if self._control is not None:
+            from horovod_tpu.ops.executor import DistributedExecutor
+            self._executor = DistributedExecutor(
+                topology, mesh, self.timeline, self._control,
+                self._rank_to_process)
+        else:
+            from horovod_tpu.ops.executor import Executor
+            self._executor = Executor(topology, mesh, self.timeline)
 
     # ------------------------------------------------------------------ API
 
@@ -492,11 +528,15 @@ class Controller:
 
     def stop(self):
         """Coordinated shutdown: outstanding entries get SHUT_DOWN_ERROR
-        (reference ``operations.cc:1647-1662``)."""
+        (reference ``operations.cc:1647-1662``).  In multi-process mode the
+        shutdown flag rides the next request list, so every process exits
+        its loop together (``operations.cc:1780-1784, 1896-1899``)."""
         with self._lock:
             self._shutdown.set()
+        thread_exited = True
         if self._thread is not None:
-            self._thread.join(timeout=10.0)
+            self._thread.join(timeout=90.0)
+            thread_exited = not self._thread.is_alive()
             self._thread = None
         with self._lock:
             entries = list(self._tensor_table.values())
@@ -504,6 +544,12 @@ class Controller:
             self._message_queue.clear()
         for e in entries:
             e.callback(SHUT_DOWN_ERROR, None)
+        if self._control is not None and thread_exited:
+            # If the background thread is wedged inside a control-plane call
+            # (e.g. a dead peer), destroying the native object under it
+            # would be a use-after-free — leak it instead; the process is
+            # tearing down anyway.
+            self._control.close()
         if self.timeline:
             self.timeline.close()
 
@@ -539,6 +585,9 @@ class Controller:
     # ------------------------------------------------------- background loop
 
     def _background_loop(self):
+        if self._control is not None:
+            self._background_loop_distributed()
+            return
         while not self._shutdown.is_set():
             t0 = time.monotonic()
             try:
@@ -549,6 +598,62 @@ class Controller:
             remaining = self.cycle_time_s - elapsed
             if remaining > 0:
                 self._shutdown.wait(remaining)
+
+    def _background_loop_distributed(self):
+        """Multi-process tick loop.  Unlike the local loop, the final tick
+        after ``_shutdown`` is set still runs — it carries the shutdown flag
+        to the coordinator so every process exits together."""
+        while True:
+            t0 = time.monotonic()
+            shutting = self._shutdown.is_set()
+            try:
+                remote_shutdown = self._run_loop_once_distributed(shutting)
+            except Exception as exc:   # noqa: BLE001
+                self._fail_all(Status(StatusType.UNKNOWN_ERROR, repr(exc)))
+                self._shutdown.set()
+                return
+            if shutting or remote_shutdown:
+                if remote_shutdown and not shutting:
+                    # Another process shut down; fail outstanding work here
+                    # (stop() may never be called locally).
+                    self._shutdown.set()
+                    self._fail_all(SHUT_DOWN_ERROR)
+                return
+            elapsed = time.monotonic() - t0
+            remaining = self.cycle_time_s - elapsed
+            if remaining > 0:
+                self._shutdown.wait(remaining)
+
+    def _run_loop_once_distributed(self, shutting: bool) -> bool:
+        """One negotiation tick over the TCP control plane; returns True if
+        the coordinator announced job shutdown."""
+        from horovod_tpu import wire
+        with self._lock:
+            pending = list(self._message_queue)
+            self._message_queue.clear()
+        blob = wire.serialize_request_list(pending, shutdown=shutting)
+        resp_blob = self._control.tick(blob, self.fusion_threshold)
+        responses, remote_shutdown = wire.parse_response_list(resp_blob)
+        for resp in responses:
+            with self._lock:
+                entries = [self._tensor_table.pop(n)
+                           for n in resp.tensor_names
+                           if n in self._tensor_table]
+            if entries:
+                self._executor.execute(resp, entries)
+        self._maybe_check_stalls_distributed()
+        return remote_shutdown
+
+    def _maybe_check_stalls_distributed(self):
+        if self.stall_check_disabled or self.topology.process_index != 0:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_check < self.stall_warning_time_s:
+            return
+        self._last_stall_check = now
+        stalled = self._control.stalled(self.stall_warning_time_s)
+        if stalled:
+            self._warn_stalled(stalled)
 
     def _run_loop_once(self):
         with self._lock:
@@ -598,18 +703,21 @@ class Controller:
         stalled = self._message_table.pending_names_older_than(
             self.stall_warning_time_s)
         if stalled:
-            import sys
-            msg = ["WARNING: One or more tensors were submitted to be "
-                   "reduced, gathered or broadcasted by subset of ranks and "
-                   "are waiting for remainder of ranks for more than "
-                   f"{int(self.stall_warning_time_s)} seconds. This may "
-                   "indicate that different ranks are trying to submit "
-                   "different tensors or that only subset of ranks is "
-                   "submitting tensors, which will cause deadlock."]
-            for name, missing in stalled:
-                msg.append(f"Stalled op: {name} [missing ranks: "
-                           f"{', '.join(map(str, missing))}]")
-            print("\n".join(msg), file=sys.stderr)
+            self._warn_stalled(stalled)
+
+    def _warn_stalled(self, stalled):
+        import sys
+        msg = ["WARNING: One or more tensors were submitted to be "
+               "reduced, gathered or broadcasted by subset of ranks and "
+               "are waiting for remainder of ranks for more than "
+               f"{int(self.stall_warning_time_s)} seconds. This may "
+               "indicate that different ranks are trying to submit "
+               "different tensors or that only subset of ranks is "
+               "submitting tensors, which will cause deadlock."]
+        for name, missing in stalled:
+            msg.append(f"Stalled op: {name} [missing ranks: "
+                       f"{', '.join(map(str, missing))}]")
+        print("\n".join(msg), file=sys.stderr)
 
     def _fail_all(self, status: Status):
         with self._lock:

@@ -69,6 +69,30 @@ def _configure(lib) -> None:
     lib.htpu_timeline_activity_start.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.htpu_timeline_close.argtypes = [ctypes.c_void_p]
+    lib.htpu_control_create.restype = ctypes.c_void_p
+    lib.htpu_control_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.htpu_control_destroy.argtypes = [ctypes.c_void_p]
+    lib.htpu_control_tick.restype = ctypes.c_int
+    lib.htpu_control_tick.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_allreduce.restype = ctypes.c_int
+    lib.htpu_control_allreduce.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_allgather.restype = ctypes.c_int
+    lib.htpu_control_allgather.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_broadcast.restype = ctypes.c_int
+    lib.htpu_control_broadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_stalled.restype = ctypes.c_int
+    lib.htpu_control_stalled.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p)]
 
 
 def load():
@@ -79,18 +103,21 @@ def load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and os.path.isdir(_CPP_DIR):
+        if os.path.isdir(_CPP_DIR):
+            # Run make even when the .so exists: it no-ops when up to date
+            # and rebuilds a stale library whose symbols predate this module.
             try:
                 subprocess.run(["make", "-C", _CPP_DIR], check=True,
                                capture_output=True, timeout=120)
             except (subprocess.SubprocessError, OSError):
-                return None
+                pass   # fall through: a prebuilt .so may still be usable
         if not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _configure(lib)
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError = stale library missing newer symbols.
             return None
         _lib = lib
         return _lib
@@ -165,22 +192,7 @@ class CppMessageTable:
     def pending_names_older_than(self, age_s: float):
         out = ctypes.c_void_p()
         n = self._lib.htpu_table_stalled(self._ptr, age_s, ctypes.byref(out))
-        data = _take_buffer(self._lib, out, n)
-        # Length-prefixed records (names may contain any byte):
-        # { name_len:i32 name n_missing:i32 ranks:i32[] }*
-        import struct
-        result, pos = [], 0
-        while pos < len(data):
-            (nlen,) = struct.unpack_from("<i", data, pos)
-            pos += 4
-            name = data[pos:pos + nlen].decode("utf-8")
-            pos += nlen
-            (nmiss,) = struct.unpack_from("<i", data, pos)
-            pos += 4
-            ranks = list(struct.unpack_from(f"<{nmiss}i", data, pos))
-            pos += 4 * nmiss
-            result.append((name, ranks))
-        return result
+        return _parse_stall_records(_take_buffer(self._lib, out, n))
 
 
 def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
@@ -202,6 +214,97 @@ def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
                               n, threshold, ctypes.byref(out))
     fused, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
     return fused
+
+
+def _parse_stall_records(data: bytes):
+    import struct
+    result, pos = [], 0
+    while pos < len(data):
+        (nlen,) = struct.unpack_from("<i", data, pos)
+        pos += 4
+        name = data[pos:pos + nlen].decode("utf-8")
+        pos += nlen
+        (nmiss,) = struct.unpack_from("<i", data, pos)
+        pos += 4
+        ranks = list(struct.unpack_from(f"<{nmiss}i", data, pos))
+        pos += 4 * nmiss
+        result.append((name, ranks))
+    return result
+
+
+class CppControlPlane:
+    """Multi-process control + eager data plane (TCP, native).
+
+    Replaces the reference's MPI gather/bcast negotiation and CPU MPI data
+    plane (``operations.cc:1665-1903, 1232-1353``).  Process 0 is the
+    coordinator; construction blocks until the whole job is connected.
+    """
+
+    def __init__(self, process_index: int, process_count: int, host: str,
+                 port: int, first_rank: int, nranks_total: int,
+                 timeout_ms: int = 60000):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._ptr = self._lib.htpu_control_create(
+            process_index, process_count, host.encode("utf-8"), port,
+            first_rank, nranks_total, timeout_ms)
+        if not self._ptr:
+            raise ConnectionError(
+                f"control plane failed to form (coordinator {host}:{port}, "
+                f"process {process_index}/{process_count})")
+
+    def tick(self, request_list_blob: bytes,
+             fusion_threshold: int) -> bytes:
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_tick(
+            self._ptr, request_list_blob, len(request_list_blob),
+            fusion_threshold, ctypes.byref(out))
+        if n < 0:
+            raise ConnectionError("control-plane tick failed")
+        return _take_buffer(self._lib, out, n)
+
+    def allreduce(self, dtype: str, data: bytes) -> bytes:
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_allreduce(
+            self._ptr, dtype.encode("utf-8"), data, len(data),
+            ctypes.byref(out))
+        if n < 0:
+            raise ConnectionError("data-plane allreduce failed")
+        return _take_buffer(self._lib, out, n)
+
+    def allgather(self, data: bytes) -> bytes:
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_allgather(
+            self._ptr, data, len(data), ctypes.byref(out))
+        if n < 0:
+            raise ConnectionError("data-plane allgather failed")
+        return _take_buffer(self._lib, out, n)
+
+    def broadcast(self, root_process: int, data: bytes) -> bytes:
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_broadcast(
+            self._ptr, root_process, data, len(data), ctypes.byref(out))
+        if n < 0:
+            raise ConnectionError("data-plane broadcast failed")
+        return _take_buffer(self._lib, out, n)
+
+    def stalled(self, age_s: float):
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_stalled(self._ptr, age_s,
+                                           ctypes.byref(out))
+        return _parse_stall_records(_take_buffer(self._lib, out, n))
+
+    def close(self):
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.htpu_control_destroy(ptr)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
 
 
 class CppTimeline:

@@ -57,7 +57,12 @@ def _normalize(tensor, name_prefix: str, name: Optional[str]):
     nlocal = st.topology.local_size
     if isinstance(tensor, PerRank):
         vals = [np.asarray(v) for v in tensor.values]
-        if len(vals) != nlocal and len(vals) != st.topology.size:
+        # Single-process may pass one value per global rank (it controls
+        # them all); multi-process controls only its local ranks.
+        allowed = {nlocal}
+        if st.topology.process_count == 1:
+            allowed.add(st.topology.size)
+        if len(vals) not in allowed:
             raise ValueError(
                 f"PerRank needs {nlocal} values (one per controlled rank), "
                 f"got {len(vals)}")

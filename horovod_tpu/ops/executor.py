@@ -195,3 +195,102 @@ class Executor:
             if self.timeline:
                 self.timeline.activity_end_all([e])
             e.callback(Status.OK(), out)
+
+
+class DistributedExecutor(Executor):
+    """Multi-process data plane: collective payloads cross processes via the
+    native TCP control plane (:class:`horovod_tpu.cpp_core.CppControlPlane`),
+    replacing the reference's CPU MPI data plane
+    (``operations.cc:1232-1353``).  Local per-rank contributions are
+    pre-reduced / pre-concatenated on this process first — the same two-level
+    structure as the reference's hierarchical path (local first, then
+    cross-node)."""
+
+    def __init__(self, topology, mesh, timeline, control, rank_to_process):
+        super().__init__(topology, mesh, timeline)
+        self._control = control
+        self._rank_to_process = rank_to_process
+
+    def _allreduce(self, response: Response, entries: List[TensorTableEntry]):
+        dtype = np.dtype(entries[0].dtype)
+        nranks = self.nranks   # GLOBAL rank count (for averaging)
+
+        if self.timeline:
+            self.timeline.activity_start_all(entries,
+                                             "MEMCPY_IN_FUSION_BUFFER")
+        # Local pre-reduction across this process's ranks, then one fused
+        # buffer for the cross-process exchange.
+        flats = []
+        for e in entries:
+            parts = [np.asarray(p, dtype=dtype).reshape(-1)
+                     for p in e.per_rank]
+            acc = parts[0].copy()
+            for p in parts[1:]:
+                acc = (acc + p).astype(dtype, copy=False)
+            flats.append(acc)
+        buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+            self.timeline.activity_start_all(entries, "TCP_ALLREDUCE")
+        reduced = np.frombuffer(
+            self._control.allreduce(str(dtype), buf.tobytes()), dtype=dtype)
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+            self.timeline.activity_start_all(entries,
+                                             "MEMCPY_OUT_FUSION_BUFFER")
+        offset = 0
+        for e in entries:
+            n = int(np.prod(e.per_rank[0].shape))
+            out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
+            offset += n
+            if e.average:
+                if np.issubdtype(dtype, np.floating):
+                    out = (out / nranks).astype(dtype)
+                else:
+                    out = out // nranks
+            e.callback(Status.OK(), self._to_device(out))
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+
+    def _allgather(self, response: Response,
+                   entries: List[TensorTableEntry]):
+        for e in entries:
+            if self.timeline:
+                self.timeline.activity_start_all([e], "TCP_ALLGATHER")
+            dtype = np.dtype(e.dtype)
+            local = np.concatenate(
+                [np.asarray(p, dtype=dtype) for p in e.per_rank], axis=0)
+            data = self._control.allgather(local.tobytes())
+            row_shape = e.per_rank[0].shape[1:]
+            total_rows = sum(response.tensor_sizes)
+            out = np.frombuffer(data, dtype=dtype).reshape(
+                (total_rows,) + tuple(row_shape))
+            if self.timeline:
+                self.timeline.activity_end_all([e])
+            e.callback(Status.OK(), self._to_device(out))
+
+    def _broadcast(self, response: Response,
+                   entries: List[TensorTableEntry]):
+        first_rank = self.topology.rank
+        for e in entries:
+            if self.timeline:
+                self.timeline.activity_start_all([e], "TCP_BROADCAST")
+            dtype = np.dtype(e.dtype)
+            root_process = self._rank_to_process[e.root_rank]
+            root_local = e.root_rank - first_rank
+            if 0 <= root_local < len(e.per_rank):
+                payload = np.asarray(e.per_rank[root_local],
+                                     dtype=dtype).tobytes()
+            else:
+                payload = b""
+            data = self._control.broadcast(root_process, payload)
+            out = np.frombuffer(data, dtype=dtype).reshape(
+                e.per_rank[0].shape)
+            if self.timeline:
+                self.timeline.activity_end_all([e])
+            e.callback(Status.OK(), self._to_device(out))
+
+    def _to_device(self, arr: np.ndarray):
+        if _needs_host_path(arr.dtype):
+            return arr.copy()
+        return jax.device_put(arr, _replicate_sharding(self.mesh))

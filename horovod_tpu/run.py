@@ -1,0 +1,92 @@
+"""Job launcher — the TPU-native replacement for ``mpirun``.
+
+The reference launches with plain ``mpirun -np 4 -H host1:2,host2:2 python
+train.py`` and relies on MPI for rank/topology env propagation
+(``docs/running.md:1-46``).  Here:
+
+* On a TPU pod, you normally need NO launcher at all — the pod runtime
+  starts one process per host and ``hvd.init()`` reads the topology from
+  JAX.  This launcher serves the *eager multi-process* mode (the TCP
+  control plane) and local development.
+* ``python -m horovod_tpu.run -np 4 python train.py`` spawns 4 local
+  processes wired to a fresh coordinator.
+* Multi-host: run the same command on every host with ``--coord
+  host0:port``, ``--process-index``/``--process-count`` set per host.
+
+Env contract (what mpirun's ``-x`` propagation becomes):
+``HOROVOD_TPU_COORD_ADDR``, ``HOROVOD_TPU_PROCESS_INDEX``,
+``HOROVOD_TPU_PROCESS_COUNT``, ``HOROVOD_TPU_SIZE``, ``HOROVOD_TPU_RANK``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="horovod_tpu.run",
+        usage="python -m horovod_tpu.run -np N [options] -- command ...")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="number of processes to launch (this host)")
+    p.add_argument("--ranks-per-process", type=int, default=1,
+                   help="chips driven per process (devices per process)")
+    p.add_argument("--coord", default="",
+                   help="coordinator host:port (default: local ephemeral)")
+    p.add_argument("--process-index-base", type=int, default=0,
+                   help="first process index on this host (multi-host)")
+    p.add_argument("--process-count", type=int, default=0,
+                   help="total processes in the job (default: -np)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program to run (prefix with --)")
+    args = p.parse_args(argv)
+
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given")
+
+    nproc_total = args.process_count or args.num_proc
+    coord = args.coord or f"127.0.0.1:{free_port()}"
+    rpp = args.ranks_per_process
+    size = nproc_total * rpp
+
+    procs = []
+    for i in range(args.num_proc):
+        pidx = args.process_index_base + i
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_COORD_ADDR": coord,
+            "HOROVOD_TPU_PROCESS_INDEX": str(pidx),
+            "HOROVOD_TPU_PROCESS_COUNT": str(nproc_total),
+            "HOROVOD_TPU_SIZE": str(size),
+            "HOROVOD_TPU_RANK": str(pidx * rpp),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    rc = 0
+    try:
+        for proc in procs:
+            rc = proc.wait() or rc
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.terminate()
+        rc = 130
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,9 +40,16 @@ class Topology:
     local_devices: Tuple[jax.Device, ...]    # devices owned by this process
     process_index: int
     process_count: int
+    # Multi-process eager mode (TCP control plane): the global rank space
+    # spans processes whose devices this process cannot see; these override
+    # the device-derived values.  -1 = derive from devices.
+    size_override: int = -1
+    rank_override: int = -1
 
     @property
     def size(self) -> int:
+        if self.size_override >= 0:
+            return self.size_override
         return len(self.devices)
 
     @property
@@ -52,6 +59,8 @@ class Topology:
     @property
     def rank(self) -> int:
         """Global rank of this process's first device."""
+        if self.rank_override >= 0:
+            return self.rank_override
         first = self.local_devices[0]
         for i, d in enumerate(self.devices):
             if d.id == first.id:
@@ -89,7 +98,32 @@ def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
     ranks, mirroring ``hvd.init(comm=[0, 1, ...])``'s subset-communicator
     support (reference ``horovod/common/__init__.py:58-68``,
     ``operations.cc:1469-1483``).
+
+    Multi-process eager mode: when ``HOROVOD_TPU_COORD_ADDR`` is set
+    together with ``HOROVOD_TPU_PROCESS_COUNT`` > 1, the rank space spans
+    several independent processes connected by the TCP control plane (the
+    launcher provides the layout, replacing ``mpirun``'s env propagation,
+    reference ``docs/running.md:20-33``):
+
+    * ``HOROVOD_TPU_SIZE``          — total ranks in the job,
+    * ``HOROVOD_TPU_RANK``          — this process's first global rank,
+    * ``HOROVOD_TPU_PROCESS_INDEX`` / ``HOROVOD_TPU_PROCESS_COUNT``.
     """
+    import os
+    if (os.environ.get("HOROVOD_TPU_COORD_ADDR")
+            and int(os.environ.get("HOROVOD_TPU_PROCESS_COUNT", "1")) > 1):
+        if ranks is not None:
+            raise ValueError(
+                "rank subsets are not supported in multi-process mode")
+        local = tuple(jax.local_devices())
+        return Topology(
+            devices=local,
+            local_devices=local,
+            process_index=int(os.environ["HOROVOD_TPU_PROCESS_INDEX"]),
+            process_count=int(os.environ["HOROVOD_TPU_PROCESS_COUNT"]),
+            size_override=int(os.environ["HOROVOD_TPU_SIZE"]),
+            rank_override=int(os.environ["HOROVOD_TPU_RANK"]),
+        )
     all_devices = tuple(jax.devices())
     if ranks is not None:
         ranks = list(ranks)

@@ -1,0 +1,151 @@
+"""Multi-process collectives over the native TCP control plane.
+
+The reference runs its whole test suite under ``mpirun -np 2`` (SURVEY §4);
+this is the TPU-native equivalent: N real OS processes, each a separate JAX
+runtime, negotiating through the C++ coordinator on localhost.  Covers
+allreduce (fused, averaged, fp16/bf16 via the native half arithmetic),
+ragged allgather, broadcast from a non-coordinator root, cross-rank
+validation errors, and coordinated shutdown.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import cpp_core
+
+pytestmark = pytest.mark.skipif(
+    not cpp_core.available(), reason="native core not built")
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.eager import PerRank
+
+    hvd.init()
+    rank = hvd.rank()          # first global rank of this process
+    n = hvd.size()
+    nlocal = hvd.local_size()
+
+    # 1. fused allreduce: several tensors in one negotiation window,
+    #    per-rank-distinct values; sum oracle = sum over all global ranks.
+    handles = []
+    for i in range(5):
+        per = PerRank([np.full((8,), float(rank + j) * (i + 1), np.float32)
+                       for j in range(nlocal)])
+        handles.append(hvd.allreduce_async(per, average=False,
+                                           name=f"mp.fused.{i}"))
+    for i, h in enumerate(handles):
+        out = np.asarray(hvd.synchronize(h))
+        want = sum(float(r) * (i + 1) for r in range(n))
+        np.testing.assert_allclose(out, np.full((8,), want), rtol=1e-6)
+
+    # 2. averaged allreduce
+    per = PerRank([np.full((4,), float(rank + j + 1), np.float32)
+                   for j in range(nlocal)])
+    out = np.asarray(hvd.allreduce(per, average=True, name="mp.avg"))
+    want = sum(r + 1 for r in range(n)) / n
+    np.testing.assert_allclose(out, np.full((4,), want), rtol=1e-6)
+
+    # 3. bf16 allreduce through the native half arithmetic
+    import jax.numpy as jnp
+    per = PerRank([np.full((4,), 1.5, np.float16) for _ in range(nlocal)])
+    out = np.asarray(hvd.allreduce(per, average=False, name="mp.fp16"))
+    np.testing.assert_allclose(out.astype(np.float32), 1.5 * n, rtol=1e-2)
+
+    # 4. ragged allgather: global rank r contributes r+1 rows of value r
+    per = PerRank([np.full((rank + j + 1, 2), float(rank + j), np.float32)
+                   for j in range(nlocal)])
+    out = np.asarray(hvd.allgather(per, name="mp.gather"))
+    rows = []
+    for r in range(n):
+        rows.append(np.full((r + 1, 2), float(r), np.float32))
+    np.testing.assert_allclose(out, np.concatenate(rows, axis=0))
+
+    # 5. broadcast from the LAST rank (non-coordinator root process)
+    per = PerRank([np.full((3,), float(rank + j), np.float32)
+                   for j in range(nlocal)])
+    out = np.asarray(hvd.broadcast(per, root_rank=n - 1, name="mp.bcast"))
+    np.testing.assert_allclose(out, np.full((3,), float(n - 1)))
+
+    # 6. validation error crosses processes: coordinator's message text
+    try:
+        bad_dtype = np.int32 if rank == 0 else np.float32
+        per = PerRank([np.zeros((2,), bad_dtype) for _ in range(nlocal)])
+        hvd.allreduce(per, name="mp.bad")
+        raise AssertionError("expected CollectiveError")
+    except hvd.CollectiveError as e:
+        assert "Mismatched data types" in str(e), str(e)
+
+    # 7. still working after the error
+    out = np.asarray(hvd.allreduce(np.ones(2, np.float32), average=False,
+                                   name="mp.after"))
+    np.testing.assert_allclose(out, float(n))
+
+    print(f"WORKER_OK rank={rank}")
+    hvd.shutdown()
+""")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(nprocs, ranks_per_proc=2, timeout=180):
+    port = free_port()
+    procs = []
+    size = nprocs * ranks_per_proc
+    for i in range(nprocs):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": str(nprocs),
+            "HOROVOD_TPU_SIZE": str(size),
+            "HOROVOD_TPU_RANK": str(i * ranks_per_proc),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={ranks_per_proc}",
+        })
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
+def test_two_processes_two_ranks_each():
+    outs = launch(nprocs=2, ranks_per_proc=2)
+    for rc, out in outs:
+        assert rc == 0, out
+        assert "WORKER_OK" in out, out
+
+
+def test_three_processes_one_rank_each():
+    outs = launch(nprocs=3, ranks_per_proc=1)
+    for rc, out in outs:
+        assert rc == 0, out
+        assert "WORKER_OK" in out, out
