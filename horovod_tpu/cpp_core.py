@@ -19,6 +19,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import warnings
 from typing import List, Optional
 
 from horovod_tpu import wire
@@ -93,6 +94,10 @@ def _configure(lib) -> None:
     lib.htpu_control_stalled.restype = ctypes.c_int
     lib.htpu_control_stalled.argtypes = [
         ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_data_bytes.restype = None
+    lib.htpu_control_data_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
 
 
 def load():
@@ -109,15 +114,30 @@ def load():
             try:
                 subprocess.run(["make", "-C", _CPP_DIR], check=True,
                                capture_output=True, timeout=120)
-            except (subprocess.SubprocessError, OSError):
-                pass   # fall through: a prebuilt .so may still be usable
+            except subprocess.CalledProcessError as e:
+                # Fall through: a prebuilt .so may still be usable — but say
+                # so, or the pure-Python fallback engages silently.
+                warnings.warn(
+                    "horovod_tpu: native core build failed; falling back to "
+                    "the pure-Python control path if no prebuilt library "
+                    "exists.\n--- make stderr ---\n"
+                    + e.stderr.decode(errors="replace")[-2000:],
+                    RuntimeWarning)
+            except (subprocess.SubprocessError, OSError) as e:
+                warnings.warn(
+                    f"horovod_tpu: native core build did not run ({e}); "
+                    "falling back to the pure-Python control path if no "
+                    "prebuilt library exists.", RuntimeWarning)
         if not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _configure(lib)
-        except (OSError, AttributeError):
+        except (OSError, AttributeError) as e:
             # AttributeError = stale library missing newer symbols.
+            warnings.warn(
+                f"horovod_tpu: native core library unusable ({e}); using "
+                "the pure-Python control path.", RuntimeWarning)
             return None
         _lib = lib
         return _lib
@@ -288,6 +308,16 @@ class CppControlPlane:
         if n < 0:
             raise ConnectionError("data-plane broadcast failed")
         return _take_buffer(self._lib, out, n)
+
+    def data_bytes(self):
+        """(sent, received) cumulative eager data-plane payload bytes of
+        this process — the ring keeps both O(payload) per collective
+        regardless of process count."""
+        sent = ctypes.c_longlong()
+        recvd = ctypes.c_longlong()
+        self._lib.htpu_control_data_bytes(self._ptr, ctypes.byref(sent),
+                                          ctypes.byref(recvd))
+        return sent.value, recvd.value
 
     def stalled(self, age_s: float):
         out = ctypes.c_void_p()

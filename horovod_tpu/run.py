@@ -74,6 +74,7 @@ def main(argv=None):
             "HOROVOD_TPU_PROCESS_COUNT": str(nproc_total),
             "HOROVOD_TPU_SIZE": str(size),
             "HOROVOD_TPU_RANK": str(pidx * rpp),
+            "HOROVOD_TPU_LOCAL_SIZE": str(rpp),
         })
         procs.append(subprocess.Popen(cmd, env=env))
 

@@ -116,13 +116,33 @@ def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
             raise ValueError(
                 "rank subsets are not supported in multi-process mode")
         local = tuple(jax.local_devices())
+        size = int(os.environ["HOROVOD_TPU_SIZE"])
+        rank = int(os.environ["HOROVOD_TPU_RANK"])
+        # The launcher computed the global rank space from its
+        # --ranks-per-process; if this process actually owns a different
+        # number of devices the rank space has gaps/overlaps and every
+        # negotiation deadlocks with only a stall warning.  Fail fast
+        # instead (round-1 advisor finding).
+        expected_local = int(os.environ.get("HOROVOD_TPU_LOCAL_SIZE", "0"))
+        if expected_local and expected_local != len(local):
+            raise RuntimeError(
+                f"horovod_tpu: launcher assigned {expected_local} ranks to "
+                f"this process but jax.local_devices() reports {len(local)} "
+                "devices; the global rank space would have gaps and all "
+                "collectives would stall. Pass --ranks-per-process matching "
+                "the per-process device count (or adjust JAX_PLATFORMS/"
+                "XLA_FLAGS so each process sees the intended devices).")
+        if rank + len(local) > size:
+            raise RuntimeError(
+                f"horovod_tpu: rank layout overflows the job: first rank "
+                f"{rank} + {len(local)} local devices > size {size}.")
         return Topology(
             devices=local,
             local_devices=local,
             process_index=int(os.environ["HOROVOD_TPU_PROCESS_INDEX"]),
             process_count=int(os.environ["HOROVOD_TPU_PROCESS_COUNT"]),
-            size_override=int(os.environ["HOROVOD_TPU_SIZE"]),
-            rank_override=int(os.environ["HOROVOD_TPU_RANK"]),
+            size_override=size,
+            rank_override=rank,
         )
     all_devices = tuple(jax.devices())
     if ranks is not None:

@@ -118,7 +118,10 @@ def parse_request_list(data: bytes) -> Tuple[List[Request], bool]:
     rd = _Reader(data)
     shutdown = rd.i8() != 0
     reqs = [parse_request(rd) for _ in range(rd.i32())]
-    assert rd.pos == len(data), "trailing bytes in request list"
+    if rd.pos != len(data):
+        raise ValueError(
+            f"trailing bytes in request list: parsed {rd.pos} of "
+            f"{len(data)} bytes (corrupt or truncated frame)")
     return reqs, shutdown
 
 
@@ -136,7 +139,10 @@ def parse_response_list(data: bytes) -> Tuple[List[Response], bool]:
     rd = _Reader(data)
     shutdown = rd.i8() != 0
     resps = [parse_response(rd) for _ in range(rd.i32())]
-    assert rd.pos == len(data), "trailing bytes in response list"
+    if rd.pos != len(data):
+        raise ValueError(
+            f"trailing bytes in response list: parsed {rd.pos} of "
+            f"{len(data)} bytes (corrupt or truncated frame)")
     return resps, shutdown
 
 
