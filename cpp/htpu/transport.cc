@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 
@@ -113,6 +114,13 @@ int AcceptOne(int listen_fd, int timeout_ms) {
 }
 
 bool SendFrame(int fd, const std::string& payload) {
+  if (payload.size() > kMaxFrameBytes) {
+    fprintf(stderr,
+            "htpu transport: refusing to send a %zu-byte frame (cap %llu "
+            "bytes); payloads this large must be chunked across frames\n",
+            payload.size(), (unsigned long long)kMaxFrameBytes);
+    return false;
+  }
   uint32_t len = uint32_t(payload.size());
   char hdr[4];
   for (int i = 0; i < 4; ++i) hdr[i] = char((len >> (8 * i)) & 0xff);
@@ -124,9 +132,88 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
   if (!RecvAll(fd, hdr, 4, timeout_ms)) return false;
   uint32_t len = 0;
   for (int i = 0; i < 4; ++i) len |= uint32_t(hdr[i]) << (8 * i);
-  if (len > (1u << 30)) return false;   // sanity: 1 GB frame cap
+  if (len > kMaxFrameBytes) {
+    fprintf(stderr,
+            "htpu transport: incoming frame length %u exceeds the %llu-byte "
+            "cap — corrupt stream or an unchunked oversized payload\n", len,
+            (unsigned long long)kMaxFrameBytes);
+    return false;
+  }
   payload->resize(len);
   return len == 0 || RecvAll(fd, &(*payload)[0], len, timeout_ms);
+}
+
+bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
+                    int recv_fd, char* recv_buf, size_t recv_len,
+                    int timeout_ms) {
+  constexpr size_t kSliceBytes = 1 << 20;
+  size_t sent = 0, rcvd = 0;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (sent < send_len || rcvd < recv_len) {
+    struct pollfd fds[2];
+    int nfds = 0, send_slot = -1, recv_slot = -1;
+    if (sent < send_len) {
+      fds[nfds].fd = send_fd;
+      fds[nfds].events = POLLOUT;
+      fds[nfds].revents = 0;
+      send_slot = nfds++;
+    }
+    if (rcvd < recv_len) {
+      fds[nfds].fd = recv_fd;
+      fds[nfds].events = POLLIN;
+      fds[nfds].revents = 0;
+      recv_slot = nfds++;
+    }
+    int remain = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count());
+    if (remain <= 0) return false;
+    int pr = poll(fds, nfds_t(nfds), remain);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (pr == 0) return false;  // timeout
+    if (send_slot >= 0 && (fds[send_slot].revents & (POLLOUT | POLLERR))) {
+      size_t want = send_len - sent;
+      if (want > kSliceBytes) want = kSliceBytes;
+      ssize_t n = send(send_fd, send_buf + sent, want,
+                       MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (n < 0) {
+        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+          return false;
+      } else {
+        sent += size_t(n);
+      }
+    }
+    if (recv_slot >= 0 &&
+        (fds[recv_slot].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t n =
+          recv(recv_fd, recv_buf + rcvd, recv_len - rcvd, MSG_DONTWAIT);
+      if (n < 0) {
+        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+          return false;
+      } else if (n == 0) {
+        return false;  // peer closed mid-transfer
+      } else {
+        rcvd += size_t(n);
+      }
+    }
+  }
+  return true;
+}
+
+std::string LocalAddrOf(int fd) {
+  struct sockaddr_in addr;
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &len) != 0 ||
+      addr.sin_family != AF_INET) {
+    return "";
+  }
+  char buf[INET_ADDRSTRLEN];
+  if (!inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf))) return "";
+  return buf;
 }
 
 void CloseFd(int fd) {

@@ -20,6 +20,12 @@
 
 namespace htpu {
 
+// Hard per-frame size cap (sanity bound on the u32 length header).  Data
+// planes must chunk payloads larger than this across frames; exceeding it
+// is reported on stderr so the failure is attributable (round-1 advisor
+// finding: an over-cap frame surfaced as a generic ConnectionError).
+constexpr uint64_t kMaxFrameBytes = 1ull << 30;  // 1 GB
+
 // Returns a connected socket fd, or -1 (retries `timeout_ms` total).
 int DialRetry(const std::string& host, int port, int timeout_ms);
 
@@ -35,6 +41,21 @@ bool SendFrame(int fd, const std::string& payload);
 
 // Receive a length-framed message; false on error/EOF/timeout.
 bool RecvFrame(int fd, std::string* payload, int timeout_ms);
+
+// Full-duplex raw transfer: send exactly `send_len` bytes on `send_fd`
+// while receiving exactly `recv_len` bytes from `recv_fd`, interleaved via
+// poll so neither direction can starve the other.  This is the primitive
+// under the ring data plane: every ring step sends one segment downstream
+// while receiving another from upstream, and blocking send()s around a
+// cycle of processes would deadlock once payloads exceed kernel socket
+// buffers.  Either length may be 0 (pass fd -1 for an unused direction).
+bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
+                    int recv_fd, char* recv_buf, size_t recv_len,
+                    int timeout_ms);
+
+// Local (own-side) IPv4 address of a connected socket — the address this
+// host uses on the route to the peer; empty string on failure.
+std::string LocalAddrOf(int fd);
 
 void CloseFd(int fd);
 
