@@ -213,8 +213,10 @@ int htpu_control_tick(void* cp, const void* req_blob, int len,
   return CopyOut(result, out);
 }
 
+// Exceptions (e.g. bad_alloc on giant payloads) must not cross the C
+// boundary into ctypes; data-plane failures are -1 like any other error.
 int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
-                           long long len, void** out) {
+                           long long len, void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
   if (!static_cast<htpu::ControlPlane*>(cp)->Allreduce(dtype, contrib,
@@ -222,20 +224,24 @@ int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
     return -1;
   }
   return CopyOut(result, out);
+} catch (...) {
+  return -1;
 }
 
 int htpu_control_allgather(void* cp, const void* in, long long len,
-                           void** out) {
+                           void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
   if (!static_cast<htpu::ControlPlane*>(cp)->Allgather(contrib, &result)) {
     return -1;
   }
   return CopyOut(result, out);
+} catch (...) {
+  return -1;
 }
 
 int htpu_control_broadcast(void* cp, int root_process, const void* in,
-                           long long len, void** out) {
+                           long long len, void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
   if (!static_cast<htpu::ControlPlane*>(cp)->Broadcast(root_process, contrib,
@@ -243,6 +249,13 @@ int htpu_control_broadcast(void* cp, int root_process, const void* in,
     return -1;
   }
   return CopyOut(result, out);
+} catch (...) {
+  return -1;
+}
+
+// Cumulative eager-data-plane payload traffic of this process.
+void htpu_control_data_bytes(void* cp, long long* sent, long long* recvd) {
+  static_cast<htpu::ControlPlane*>(cp)->DataBytes(sent, recvd);
 }
 
 // Coordinator-side stall scan; same length-prefixed record format as

@@ -79,13 +79,99 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
       return nullptr;
     }
   }
+  if (process_count > 1 && !cp->SetupRing(coord_host)) return nullptr;
   return cp;
+}
+
+bool ControlPlane::SetupRing(const std::string& coord_host) {
+  // 1. Every process opens an ephemeral listen socket for its ring-prev.
+  int ring_port = 0;
+  int ring_listen = Listen(0, &ring_port);
+  if (ring_listen < 0) return false;
+
+  // 2. Advertise "host\tport\tfirst_rank".  The coordinator is reachable at
+  // the address everyone already dialed; a worker advertises the local
+  // address of its coordinator connection (the interface that routes to
+  // the rest of the job).
+  std::string host =
+      is_coordinator() ? coord_host : LocalAddrOf(coord_fd_);
+  if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
+  std::string record = host + "\t" + std::to_string(ring_port) + "\t" +
+                       std::to_string(first_rank_);
+
+  // 3. Exchange the address book over the star.
+  std::string book;
+  if (is_coordinator()) {
+    std::vector<std::string> records(static_cast<size_t>(process_count_));
+    records[0] = record;
+    for (int i = 1; i < process_count_; ++i) {
+      if (!RecvFrame(worker_fds_[size_t(i)], &records[size_t(i)],
+                     timeout_ms_)) {
+        CloseFd(ring_listen);
+        return false;
+      }
+    }
+    for (int i = 0; i < process_count_; ++i) {
+      if (i) book += "\n";
+      book += records[size_t(i)];
+    }
+    for (int i = 1; i < process_count_; ++i) {
+      if (!SendFrame(worker_fds_[size_t(i)], book)) {
+        CloseFd(ring_listen);
+        return false;
+      }
+    }
+  } else {
+    if (!SendFrame(coord_fd_, record) ||
+        !RecvFrame(coord_fd_, &book, timeout_ms_)) {
+      CloseFd(ring_listen);
+      return false;
+    }
+  }
+
+  // 4. Parse the book; dial ring-next, accept ring-prev.
+  std::vector<std::string> hosts;
+  std::vector<int> ports;
+  all_first_ranks_.clear();
+  size_t pos = 0;
+  while (pos <= book.size()) {
+    size_t nl = book.find('\n', pos);
+    std::string line =
+        book.substr(pos, nl == std::string::npos ? nl : nl - pos);
+    size_t t1 = line.find('\t'), t2 = line.rfind('\t');
+    if (t1 == std::string::npos || t2 == t1) {
+      CloseFd(ring_listen);
+      return false;
+    }
+    hosts.push_back(line.substr(0, t1));
+    ports.push_back(std::stoi(line.substr(t1 + 1, t2 - t1 - 1)));
+    all_first_ranks_.push_back(std::stoi(line.substr(t2 + 1)));
+    if (nl == std::string::npos) break;
+    pos = nl + 1;
+  }
+  if (int(hosts.size()) != process_count_) {
+    CloseFd(ring_listen);
+    return false;
+  }
+
+  int next = (process_index_ + 1) % process_count_;
+  ring_next_fd_ = DialRetry(hosts[size_t(next)], ports[size_t(next)],
+                            timeout_ms_);
+  if (ring_next_fd_ < 0) {
+    CloseFd(ring_listen);
+    return false;
+  }
+  ring_prev_fd_ = AcceptOne(ring_listen, timeout_ms_);
+  CloseFd(ring_listen);
+  return ring_prev_fd_ >= 0;
 }
 
 ControlPlane::~ControlPlane() {
   for (int fd : worker_fds_) CloseFd(fd);
   CloseFd(coord_fd_);
   CloseFd(listen_fd_);
+  CloseFd(ring_next_fd_);
+  CloseFd(ring_prev_fd_);
 }
 
 bool ControlPlane::Tick(const std::string& request_list_blob,
@@ -168,68 +254,256 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
 
 bool ControlPlane::Allreduce(const std::string& dtype, const std::string& in,
                              std::string* out) {
-  if (!is_coordinator()) {
-    return SendFrame(coord_fd_, in) &&
-           RecvFrame(coord_fd_, out, timeout_ms_);
+  if (process_count_ == 1) {
+    *out = in;
+    return true;
   }
+  return RingAllreduce(dtype, in, out);
+}
+
+// Chunked ring allreduce: reduce-scatter then allgather, P-1 steps each.
+// Every step sends one segment downstream while receiving another from
+// upstream (full duplex), so per-process traffic is 2*(P-1)/P * payload —
+// the reference got the same property from MPI's ring algorithms for free.
+bool ControlPlane::RingAllreduce(const std::string& dtype,
+                                 const std::string& in, std::string* out) {
+  const int P = process_count_;
+  const int r = process_index_;
+  const int elem = DtypeSize(dtype);
+  if (elem <= 0 || in.size() % size_t(elem) != 0) return false;
+  const int64_t n_elems = int64_t(in.size()) / elem;
+
   *out = in;
-  for (int i = 1; i < process_count_; ++i) {
-    std::string contrib;
-    if (!RecvFrame(worker_fds_[size_t(i)], &contrib, timeout_ms_))
+  if (in.empty()) return true;
+
+  // Segment boundaries by element count (segments may be empty when
+  // n_elems < P).
+  std::vector<int64_t> seg_off(size_t(P) + 1, 0);
+  {
+    int64_t base = n_elems / P, rem = n_elems % P;
+    for (int i = 0; i < P; ++i)
+      seg_off[size_t(i) + 1] =
+          seg_off[size_t(i)] + (base + (i < rem ? 1 : 0));
+  }
+  auto off_bytes = [&](int seg) { return seg_off[size_t(seg)] * elem; };
+  auto len_bytes = [&](int seg) {
+    return (seg_off[size_t(seg) + 1] - seg_off[size_t(seg)]) * elem;
+  };
+
+  std::string tmp;
+  tmp.resize(size_t((n_elems / P + 1) * elem));
+
+  // Phase 1: reduce-scatter.  After step s, this process holds the partial
+  // sum of segments (r - s - 1) mod P across s + 2 processes.
+  for (int s = 0; s < P - 1; ++s) {
+    int send_seg = (r - s + P) % P;
+    int recv_seg = (r - s - 1 + P) % P;
+    int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
+    if (!DuplexTransfer(ring_next_fd_, out->data() + off_bytes(send_seg),
+                        size_t(sbytes), ring_prev_fd_, &tmp[0],
+                        size_t(rbytes), timeout_ms_)) {
       return false;
-    if (contrib.size() != out->size()) return false;
-    if (!SumInto(dtype, &(*out)[0], contrib.data(),
-                 int64_t(contrib.size()))) {
+    }
+    data_bytes_sent_ += sbytes;
+    data_bytes_recv_ += rbytes;
+    if (rbytes &&
+        !SumInto(dtype, &(*out)[size_t(off_bytes(recv_seg))], tmp.data(),
+                 rbytes)) {
       return false;
     }
   }
-  for (int i = 1; i < process_count_; ++i) {
-    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
+
+  // Phase 2: allgather of the fully reduced segments.
+  for (int s = 0; s < P - 1; ++s) {
+    int send_seg = (r + 1 - s + P) % P;
+    int recv_seg = (r - s + P) % P;
+    int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
+    if (!DuplexTransfer(ring_next_fd_, out->data() + off_bytes(send_seg),
+                        size_t(sbytes), ring_prev_fd_,
+                        &(*out)[size_t(off_bytes(recv_seg))], size_t(rbytes),
+                        timeout_ms_)) {
+      return false;
+    }
+    data_bytes_sent_ += sbytes;
+    data_bytes_recv_ += rbytes;
   }
   return true;
 }
 
 bool ControlPlane::Allgather(const std::string& in, std::string* out) {
-  if (!is_coordinator()) {
-    return SendFrame(coord_fd_, in) &&
-           RecvFrame(coord_fd_, out, timeout_ms_);
+  if (process_count_ == 1) {
+    *out = in;
+    return true;
   }
-  // Concatenate contributions in global-rank order.
-  std::vector<std::string> parts(static_cast<size_t>(process_count_));
-  parts[0] = in;
-  for (int i = 1; i < process_count_; ++i) {
-    if (!RecvFrame(worker_fds_[size_t(i)], &parts[size_t(i)], timeout_ms_))
+  return RingAllgather(in, out);
+}
+
+// Ring allgather: rotate contributions around the cycle, P-1 steps; the
+// output concatenates contributions in global-rank order (processes may be
+// connected in any process-index order, so placement uses the first-rank
+// book exchanged at ring setup).
+bool ControlPlane::RingAllgather(const std::string& in, std::string* out) {
+  const int P = process_count_;
+  const int r = process_index_;
+
+  // Step 0: rotate per-process byte sizes so everyone can place every
+  // contribution (the first-rank placement map is static — collected once
+  // at ring setup into all_first_ranks_; only sizes vary per collective).
+  std::vector<int64_t> recs(static_cast<size_t>(P), 0);
+  recs[size_t(r)] = int64_t(in.size());
+  for (int s = 0; s < P - 1; ++s) {
+    int send_idx = (r - s + P) % P;
+    int recv_idx = (r - s - 1 + P) % P;
+    if (!DuplexTransfer(
+            ring_next_fd_,
+            reinterpret_cast<const char*>(&recs[size_t(send_idx)]),
+            sizeof(int64_t), ring_prev_fd_,
+            reinterpret_cast<char*>(&recs[size_t(recv_idx)]),
+            sizeof(int64_t), timeout_ms_)) {
       return false;
+    }
+    if (recs[size_t(recv_idx)] < 0 ||
+        uint64_t(recs[size_t(recv_idx)]) > kMaxFrameBytes) {
+      fprintf(stderr,
+              "htpu control: ring allgather size header %lld exceeds the "
+              "%llu-byte cap — desynced ring stream or oversized payload\n",
+              (long long)recs[size_t(recv_idx)],
+              (unsigned long long)kMaxFrameBytes);
+      return false;
+    }
   }
-  std::vector<int> order(static_cast<size_t>(process_count_));
+
+  // Rotate payloads.
+  std::vector<std::string> parts(static_cast<size_t>(P));
+  parts[size_t(r)] = in;
+  for (int s = 0; s < P - 1; ++s) {
+    int send_idx = (r - s + P) % P;
+    int recv_idx = (r - s - 1 + P) % P;
+    int64_t sbytes = int64_t(parts[size_t(send_idx)].size());
+    int64_t rbytes = recs[size_t(recv_idx)];
+    parts[size_t(recv_idx)].resize(size_t(rbytes));
+    if (!DuplexTransfer(ring_next_fd_, parts[size_t(send_idx)].data(),
+                        size_t(sbytes), ring_prev_fd_,
+                        rbytes ? &parts[size_t(recv_idx)][0] : nullptr,
+                        size_t(rbytes), timeout_ms_)) {
+      return false;
+    }
+    data_bytes_sent_ += sbytes;
+    data_bytes_recv_ += rbytes;
+  }
+
+  // Concatenate in global-rank order (placement map from ring setup).
+  std::vector<int> order(static_cast<size_t>(P));
   std::iota(order.begin(), order.end(), 0);
   std::sort(order.begin(), order.end(), [&](int a, int b) {
-    return worker_first_rank_[size_t(a)] < worker_first_rank_[size_t(b)];
+    return all_first_ranks_[size_t(a)] < all_first_ranks_[size_t(b)];
   });
   out->clear();
   for (int idx : order) *out += parts[size_t(idx)];
-  for (int i = 1; i < process_count_; ++i) {
-    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
-  }
   return true;
 }
 
 bool ControlPlane::Broadcast(int root_process, const std::string& in,
                              std::string* out) {
-  if (!is_coordinator()) {
-    // Root worker ships its payload up; everyone receives the result.
-    if (process_index_ == root_process && !SendFrame(coord_fd_, in))
-      return false;
-    return RecvFrame(coord_fd_, out, timeout_ms_);
-  }
-  if (root_process == 0) {
+  if (process_count_ == 1) {
     *out = in;
-  } else if (!RecvFrame(worker_fds_[size_t(root_process)], out,
-                        timeout_ms_)) {
-    return false;
+    return true;
   }
-  for (int i = 1; i < process_count_; ++i) {
-    if (!SendFrame(worker_fds_[size_t(i)], *out)) return false;
+  return RingBroadcast(root_process, in, out);
+}
+
+// Pipelined chain broadcast: payload flows root -> root+1 -> ... around the
+// ring in ~1 MB chunks; a middle process forwards chunk k-1 downstream
+// while receiving chunk k from upstream, so each link carries the payload
+// exactly once and the pipeline hides the hop latency.
+bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
+                                 std::string* out) {
+  constexpr int64_t kChunk = 1 << 20;
+  const int P = process_count_;
+  const int r = process_index_;
+  const bool is_root = (r == root_process);
+  // The chain ends at the process whose ring-next is the root.
+  const bool is_last = ((r + 1) % P == root_process);
+
+  // Size header travels the chain first.
+  uint64_t nbytes = is_root ? in.size() : 0;
+  if (!is_root) {
+    if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_,
+                        reinterpret_cast<char*>(&nbytes), sizeof(nbytes),
+                        timeout_ms_)) {
+      return false;
+    }
+    // A desynced ring stream (earlier transfer failed mid-flight) yields a
+    // garbage header; validate before resize() so the failure is an
+    // attributable error, not a bad_alloc across the C boundary.
+    if (nbytes > kMaxFrameBytes) {
+      fprintf(stderr,
+              "htpu control: ring broadcast size header %llu exceeds the "
+              "%llu-byte cap — desynced ring stream or oversized payload\n",
+              (unsigned long long)nbytes,
+              (unsigned long long)kMaxFrameBytes);
+      return false;
+    }
+  }
+  if (!is_last) {
+    if (!DuplexTransfer(ring_next_fd_,
+                        reinterpret_cast<const char*>(&nbytes),
+                        sizeof(nbytes), -1, nullptr, 0, timeout_ms_)) {
+      return false;
+    }
+  }
+
+  if (is_root) {
+    *out = in;
+  } else {
+    out->resize(size_t(nbytes));
+  }
+  if (nbytes == 0) return true;
+
+  const int64_t n_chunks = (int64_t(nbytes) + kChunk - 1) / kChunk;
+  auto chunk_ptr = [&](int64_t k) { return &(*out)[size_t(k * kChunk)]; };
+  auto chunk_len = [&](int64_t k) {
+    return std::min(kChunk, int64_t(nbytes) - k * kChunk);
+  };
+
+  if (is_root) {
+    for (int64_t k = 0; k < n_chunks; ++k) {
+      if (!DuplexTransfer(ring_next_fd_, chunk_ptr(k), size_t(chunk_len(k)),
+                          -1, nullptr, 0, timeout_ms_)) {
+        return false;
+      }
+      data_bytes_sent_ += chunk_len(k);
+    }
+  } else if (is_last) {
+    for (int64_t k = 0; k < n_chunks; ++k) {
+      if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(k),
+                          size_t(chunk_len(k)), timeout_ms_)) {
+        return false;
+      }
+      data_bytes_recv_ += chunk_len(k);
+    }
+  } else {
+    // Middle of the chain: receive chunk k while forwarding chunk k-1.
+    if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(0),
+                        size_t(chunk_len(0)), timeout_ms_)) {
+      return false;
+    }
+    data_bytes_recv_ += chunk_len(0);
+    for (int64_t k = 1; k < n_chunks; ++k) {
+      if (!DuplexTransfer(ring_next_fd_, chunk_ptr(k - 1),
+                          size_t(chunk_len(k - 1)), ring_prev_fd_,
+                          chunk_ptr(k), size_t(chunk_len(k)), timeout_ms_)) {
+        return false;
+      }
+      data_bytes_sent_ += chunk_len(k - 1);
+      data_bytes_recv_ += chunk_len(k);
+    }
+    if (!DuplexTransfer(ring_next_fd_, chunk_ptr(n_chunks - 1),
+                        size_t(chunk_len(n_chunks - 1)), -1, nullptr, 0,
+                        timeout_ms_)) {
+      return false;
+    }
+    data_bytes_sent_ += chunk_len(n_chunks - 1);
   }
   return true;
 }

@@ -10,12 +10,16 @@
 //   c) broadcasts the ResponseList to every process (MPI_Bcast there).
 //
 // The eager data plane replaces the reference's CPU MPI_Allreduce /
-// Allgatherv / Bcast (operations.cc:1232-1353) with coordinator-rooted
-// reduce + broadcast over the same connections; payload ordering is
-// deterministic because every process executes the identical response list
-// in order.  (The in-jit hot path never touches this — it rides XLA
-// collectives over ICI; this plane serves the dynamic eager API across
-// hosts.)
+// Allgatherv / Bcast (operations.cc:1232-1353) with ring algorithms over a
+// dedicated cycle of process-to-process connections (bootstrapped through
+// the coordinator's star at init): chunked ring reduce-scatter+allgather
+// for allreduce, ring rotation for allgather, pipelined chain for
+// broadcast.  Per-process traffic is O(payload) independent of process
+// count — the round-1 star relay moved O(P * payload) through the
+// coordinator.  Payload ordering is deterministic because every process
+// executes the identical response list in order.  (The in-jit hot path
+// never touches this — it rides XLA collectives over ICI; this plane
+// serves the dynamic eager API across hosts.)
 #ifndef HTPU_CONTROL_H_
 #define HTPU_CONTROL_H_
 
@@ -60,10 +64,28 @@ class ControlPlane {
 
   int process_count() const { return process_count_; }
 
+  // Cumulative eager-data-plane traffic of THIS process (payload bytes put
+  // on / taken off the wire).  Lets tests assert the ring's O(payload)
+  // scaling — under the old star relay the coordinator moved ~P x payload.
+  void DataBytes(long long* sent, long long* received) const {
+    *sent = data_bytes_sent_;
+    *received = data_bytes_recv_;
+  }
+
  private:
   ControlPlane() = default;
 
   bool is_coordinator() const { return process_index_ == 0; }
+
+  // Establish the ring: exchange listen addresses through the star, then
+  // connect process p -> p+1 (mod P).
+  bool SetupRing(const std::string& coord_host);
+
+  bool RingAllreduce(const std::string& dtype, const std::string& in,
+                     std::string* out);
+  bool RingAllgather(const std::string& in, std::string* out);
+  bool RingBroadcast(int root_process, const std::string& in,
+                     std::string* out);
 
   int process_index_ = 0;
   int process_count_ = 0;
@@ -71,11 +93,19 @@ class ControlPlane {
   int timeout_ms_ = 60000;
 
   // Coordinator: connection fd per worker process (index 1..n-1), ordered
-  // by process index; worker: single fd to the coordinator.
+  // by process index; worker: single fd to the coordinator.  Carries
+  // negotiation ticks and ring bootstrap only — data rides the ring fds.
   std::vector<int> worker_fds_;
   std::vector<int> worker_first_rank_;
   int coord_fd_ = -1;
   int listen_fd_ = -1;
+
+  // Ring data plane (all processes when process_count > 1).
+  int ring_next_fd_ = -1;   // to process (index+1) % P
+  int ring_prev_fd_ = -1;   // from process (index-1+P) % P
+  std::vector<int> all_first_ranks_;  // first global rank per process index
+  long long data_bytes_sent_ = 0;
+  long long data_bytes_recv_ = 0;
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
 };
