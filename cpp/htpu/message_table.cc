@@ -137,6 +137,23 @@ Response MessageTable::ConstructResponse(const std::string& name) {
     }
   }
 
+  // Device-placement consistency: host (-1) vs accelerator, mirroring the
+  // CPU-vs-GPU check in ConstructMPIResponse (reference
+  // operations.cc:470-487).
+  if (error.empty()) {
+    bool first_is_host = requests[0].device < 0;
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      bool this_is_host = requests[i].device < 0;
+      if (this_is_host != first_is_host) {
+        error = std::string("Mismatched ") + RequestTypeName(message_type) +
+                " CPU/TPU device selection: One rank specified device " +
+                (first_is_host ? "CPU" : "TPU") +
+                ", but another rank specified device " +
+                (this_is_host ? "CPU" : "TPU") + ".";
+      }
+    }
+  }
+
   std::vector<int32_t> devices(requests.size(), 0);
   for (const auto& r : requests) devices[size_t(r.request_rank)] = r.device;
 

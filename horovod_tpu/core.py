@@ -284,6 +284,21 @@ class MessageTable:
                              f"{r.root_rank}.")
                     break
 
+        # Device-placement consistency: every rank must agree on host (-1)
+        # vs accelerator placement, mirroring the CPU-vs-GPU check in
+        # ConstructMPIResponse (reference operations.cc:470-487).
+        if error is None:
+            first_is_host = requests[0].device < 0
+            for r in requests[1:]:
+                this_is_host = r.device < 0
+                if this_is_host != first_is_host:
+                    error = (f"Mismatched {request_type_name(message_type)} "
+                             "CPU/TPU device selection: One rank specified "
+                             f"device {'CPU' if first_is_host else 'TPU'}, "
+                             "but another rank specified device "
+                             f"{'CPU' if this_is_host else 'TPU'}.")
+                    break
+
         devices = [0] * len(requests)
         for r in requests:
             devices[r.request_rank] = r.device

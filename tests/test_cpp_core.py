@@ -6,6 +6,7 @@ The reference has no such dual implementation (its core is C++-only); here
 the Python path is the spec and the C++ path must match it exactly.
 """
 
+import dataclasses
 import json
 import os
 
@@ -78,6 +79,23 @@ class TestMessageTableParity:
         resps = run_both(2, [req(0, shape=(4, 2)), req(1, shape=(4, 3))])
         assert resps[0].response_type == ResponseType.ERROR
         assert "tensor shapes" in resps[0].error_message
+
+    def test_mismatched_device_placement(self):
+        # Host (-1) vs accelerator placement must be rejected, mirroring the
+        # reference's CPU-vs-GPU negative test (test_tensorflow.py:297,
+        # operations.cc:470-487).
+        py, cpp = both_tables(2)
+        r0 = req(0)
+        r1 = dataclasses.replace(req(1), device=-1)
+        for table in (py, cpp):
+            table.increment(r0)
+            assert table.increment(r1)
+        for table in (py, cpp):
+            resp = table.construct_response("t")
+            assert resp.response_type == ResponseType.ERROR
+            assert ("Mismatched ALLREDUCE CPU/TPU device selection: One rank "
+                    "specified device TPU, but another rank specified device "
+                    "CPU.") == resp.error_message
 
     def test_allgather_ragged_dim0(self):
         resps = run_both(3, [
