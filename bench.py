@@ -26,6 +26,55 @@ import optax
 
 BASELINE_PER_DEVICE = 1656.82 / 16.0   # reference docs/benchmarks.md:22-39
 
+# Peak bf16 matmul FLOP/s per chip by device kind, for the MFU report.
+# Sources: public TPU spec sheets (v5e 394 TF/s bf16, v4 275, v5p 459,
+# v6e "Trillium" 918); host CPU fallback is nominal.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 394e12,
+    "TPU v5e": 394e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip():
+    kind = jax.devices()[0].device_kind
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return kind, peak
+    return kind, None
+
+
+# HBM bandwidth per chip (bytes/s) for the roofline report; ResNet-50 at
+# bf16 is HBM-bound on v5e (profiled: ~70% of device time at 77-98% of
+# peak BW), so bandwidth utilization is the telling number, not MFU.
+PEAK_HBM_BYTES = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+
+def step_costs(step, args):
+    """(flops, bytes_accessed) of one compiled training step from XLA's
+    cost model; (None, None) when the backend doesn't report them."""
+    try:
+        compiled = step.lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0)) or None
+        nbytes = float(analysis.get("bytes accessed", 0.0)) or None
+        return flops, nbytes
+    except Exception:
+        return None, None
+
 
 def main():
     import horovod_tpu as hvd
@@ -91,11 +140,47 @@ def main():
 
     img_per_sec = batch * timed_batches / dt
     per_chip = img_per_sec / nchips
+    step_ms = dt / timed_batches * 1e3
+
+    # MFU: achieved FLOP/s over the chip's peak bf16 FLOP/s.  FLOPs per
+    # step come from XLA's cost model for the compiled step (falls back to
+    # the analytic ~3 x 4.1 GFLOP/img fwd+bwd estimate for ResNet-50/224).
+    # All roofline numbers are PER CHIP: XLA's cost analysis describes the
+    # per-device SPMD module, and the analytic fallback uses the per-chip
+    # batch, so both branches normalize against one chip's peak.
+    kind, peak = peak_flops_per_chip()
+    flops, nbytes = step_costs(step, (params, batch_stats, opt_state, data))
+    if flops is None:
+        flops = 3 * 4.1e9 * batch_per_chip if image_size == 224 else None
+    mfu = None
+    achieved = None
+    if flops:
+        achieved = flops / (dt / timed_batches)
+        if peak:
+            mfu = achieved / peak
+    hbm_util = None
+    peak_bw = next((v for k, v in PEAK_HBM_BYTES.items()
+                    if kind.startswith(k)), None)
+    if nbytes and peak_bw:
+        hbm_util = (nbytes / (dt / timed_batches)) / peak_bw
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+        "step_time_ms": round(step_ms, 2),
+        "batch_per_chip": batch_per_chip,
+        "device_kind": kind,
+        "peak_bf16_tflops_per_chip": (peak / 1e12 if peak else None),
+        "achieved_tflops_per_chip": (round(achieved / 1e12, 2)
+                                     if achieved else None),
+        "mfu": (round(mfu, 4) if mfu is not None else None),
+        # XLA cost-model bytes over HBM peak: a roofline proxy, not a
+        # measurement — values near/over 1.0 mean the step is bandwidth-
+        # dominated (some of those accesses are served from VMEM).
+        "xla_bytes_over_hbm_peak": (round(hbm_util, 4)
+                                    if hbm_util is not None else None),
         "baseline": "resnet101 103.55 img/s/device (16x Pascal, "
                     "docs/benchmarks.md:22-39 — the reference's only "
                     "published absolute throughput; no resnet50 number "
