@@ -32,25 +32,29 @@ int CopyOut(const std::string& s, void** out) {
 
 }  // namespace
 
+// The library is built -fvisibility=hidden + a version script; only the
+// C API below is re-exported.
+#define HTPU_API __attribute__((visibility("default")))
+
 extern "C" {
 
-const char* htpu_version() { return "0.1.0"; }
+HTPU_API const char* htpu_version() { return "0.1.0"; }
 
-void htpu_free(void* p) { free(p); }
+HTPU_API void htpu_free(void* p) { free(p); }
 
 // ------------------------------------------------------------ message table
 
-void* htpu_table_create(int size) {
+HTPU_API void* htpu_table_create(int size) {
   return new htpu::MessageTable(size);
 }
 
-void htpu_table_destroy(void* t) {
+HTPU_API void htpu_table_destroy(void* t) {
   delete static_cast<htpu::MessageTable*>(t);
 }
 
 // Returns 1 when all ranks have reported for this tensor, 0 otherwise,
 // -1 on parse error or an out-of-range rank.
-int htpu_table_increment(void* t, const void* req_bytes, int len) {
+HTPU_API int htpu_table_increment(void* t, const void* req_bytes, int len) {
   htpu::Request req;
   size_t pos = 0;
   if (!htpu::ParseRequest(static_cast<const uint8_t*>(req_bytes), size_t(len),
@@ -66,7 +70,7 @@ int htpu_table_increment(void* t, const void* req_bytes, int len) {
 }
 
 // Serialized Response into *out; returns its length (>=0) or -1.
-int htpu_table_construct_response(void* t, const char* name, void** out) {
+HTPU_API int htpu_table_construct_response(void* t, const char* name, void** out) {
   htpu::Response resp =
       static_cast<htpu::MessageTable*>(t)->ConstructResponse(name);
   std::string buf;
@@ -74,17 +78,17 @@ int htpu_table_construct_response(void* t, const char* name, void** out) {
   return CopyOut(buf, out);
 }
 
-int htpu_table_num_pending(void* t) {
+HTPU_API int htpu_table_num_pending(void* t) {
   return int(static_cast<htpu::MessageTable*>(t)->NumPending());
 }
 
-void htpu_table_clear(void* t) {
+HTPU_API void htpu_table_clear(void* t) {
   static_cast<htpu::MessageTable*>(t)->Clear();
 }
 
 // Stalled entries, length-prefixed (names may contain any byte):
 // repeated { name_len:i32 name:bytes n_missing:i32 ranks:i32[n_missing] }.
-int htpu_table_stalled(void* t, double age_s, void** out) {
+HTPU_API int htpu_table_stalled(void* t, double age_s, void** out) {
   auto stalled = static_cast<htpu::MessageTable*>(t)->Stalled(age_s);
   std::string buf;
   auto put_i32 = [&buf](int32_t v) {
@@ -104,7 +108,7 @@ int htpu_table_stalled(void* t, double age_s, void** out) {
 
 // responses: serialized ResponseList. names/bytes/dtypes: parallel arrays
 // describing each tensor's payload. Result: serialized ResponseList.
-int htpu_plan_fusion(const void* responses_bytes, int len,
+HTPU_API int htpu_plan_fusion(const void* responses_bytes, int len,
                      const char** names, const int64_t* nbytes,
                      const char** dtypes, int n_entries, int64_t threshold,
                      void** out) {
@@ -139,7 +143,7 @@ int htpu_plan_fusion(const void* responses_bytes, int len,
 
 // ----------------------------------------------------------------- timeline
 
-void* htpu_timeline_create(const char* path) {
+HTPU_API void* htpu_timeline_create(const char* path) {
   auto* tl = new htpu::Timeline(path);
   if (!tl->ok()) {
     delete tl;
@@ -148,47 +152,47 @@ void* htpu_timeline_create(const char* path) {
   return tl;
 }
 
-void htpu_timeline_destroy(void* tl) {
+HTPU_API void htpu_timeline_destroy(void* tl) {
   delete static_cast<htpu::Timeline*>(tl);
 }
 
-void htpu_timeline_negotiate_start(void* tl, const char* name, int req_type) {
+HTPU_API void htpu_timeline_negotiate_start(void* tl, const char* name, int req_type) {
   static_cast<htpu::Timeline*>(tl)->NegotiateStart(
       name, htpu::RequestType(req_type));
 }
 
-void htpu_timeline_negotiate_rank_ready(void* tl, const char* name, int rank) {
+HTPU_API void htpu_timeline_negotiate_rank_ready(void* tl, const char* name, int rank) {
   static_cast<htpu::Timeline*>(tl)->NegotiateRankReady(name, rank);
 }
 
-void htpu_timeline_negotiate_end(void* tl, const char* name) {
+HTPU_API void htpu_timeline_negotiate_end(void* tl, const char* name) {
   static_cast<htpu::Timeline*>(tl)->NegotiateEnd(name);
 }
 
-void htpu_timeline_start(void* tl, const char* name, int resp_type) {
+HTPU_API void htpu_timeline_start(void* tl, const char* name, int resp_type) {
   static_cast<htpu::Timeline*>(tl)->Start(name, htpu::ResponseType(resp_type));
 }
 
-void htpu_timeline_end(void* tl, const char* name) {
+HTPU_API void htpu_timeline_end(void* tl, const char* name) {
   static_cast<htpu::Timeline*>(tl)->End(name);
 }
 
-void htpu_timeline_activity_start(void* tl, const char* name,
+HTPU_API void htpu_timeline_activity_start(void* tl, const char* name,
                                   const char* activity) {
   static_cast<htpu::Timeline*>(tl)->ActivityStart(name, activity);
 }
 
-void htpu_timeline_activity_end(void* tl, const char* name) {
+HTPU_API void htpu_timeline_activity_end(void* tl, const char* name) {
   static_cast<htpu::Timeline*>(tl)->ActivityEnd(name);
 }
 
-void htpu_timeline_close(void* tl) {
+HTPU_API void htpu_timeline_close(void* tl) {
   static_cast<htpu::Timeline*>(tl)->Close();
 }
 
 // ------------------------------------------------- multi-process control
 
-void* htpu_control_create(int process_index, int process_count,
+HTPU_API void* htpu_control_create(int process_index, int process_count,
                           const char* coord_host, int coord_port,
                           int first_rank, int nranks_total, int timeout_ms) {
   auto cp = htpu::ControlPlane::Create(process_index, process_count,
@@ -197,12 +201,12 @@ void* htpu_control_create(int process_index, int process_count,
   return cp.release();
 }
 
-void htpu_control_destroy(void* cp) {
+HTPU_API void htpu_control_destroy(void* cp) {
   delete static_cast<htpu::ControlPlane*>(cp);
 }
 
 // Serialized ResponseList into *out; length or -1.
-int htpu_control_tick(void* cp, const void* req_blob, int len,
+HTPU_API int htpu_control_tick(void* cp, const void* req_blob, int len,
                       long long fusion_threshold, void** out) {
   std::string blob(static_cast<const char*>(req_blob), size_t(len));
   std::string result;
@@ -215,7 +219,7 @@ int htpu_control_tick(void* cp, const void* req_blob, int len,
 
 // Exceptions (e.g. bad_alloc on giant payloads) must not cross the C
 // boundary into ctypes; data-plane failures are -1 like any other error.
-int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
+HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
                            long long len, void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
@@ -228,7 +232,7 @@ int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
   return -1;
 }
 
-int htpu_control_allgather(void* cp, const void* in, long long len,
+HTPU_API int htpu_control_allgather(void* cp, const void* in, long long len,
                            void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
@@ -240,7 +244,7 @@ int htpu_control_allgather(void* cp, const void* in, long long len,
   return -1;
 }
 
-int htpu_control_broadcast(void* cp, int root_process, const void* in,
+HTPU_API int htpu_control_broadcast(void* cp, int root_process, const void* in,
                            long long len, void** out) try {
   std::string contrib(static_cast<const char*>(in), size_t(len));
   std::string result;
@@ -254,13 +258,13 @@ int htpu_control_broadcast(void* cp, int root_process, const void* in,
 }
 
 // Cumulative eager-data-plane payload traffic of this process.
-void htpu_control_data_bytes(void* cp, long long* sent, long long* recvd) {
+HTPU_API void htpu_control_data_bytes(void* cp, long long* sent, long long* recvd) {
   static_cast<htpu::ControlPlane*>(cp)->DataBytes(sent, recvd);
 }
 
 // Coordinator-side stall scan; same length-prefixed record format as
 // htpu_table_stalled.
-int htpu_control_stalled(void* cp, double age_s, void** out) {
+HTPU_API int htpu_control_stalled(void* cp, double age_s, void** out) {
   auto stalled = static_cast<htpu::ControlPlane*>(cp)->Stalled(age_s);
   std::string buf;
   auto put_i32 = [&buf](int32_t v) {

@@ -66,11 +66,17 @@ def latest_epoch(directory: str) -> int:
 
 
 def restore(directory: str, epoch: int, like: Any) -> Any:
-    """Restore the checkpoint for ``epoch`` with the structure of ``like``."""
+    """Restore the checkpoint for ``epoch`` with the structure of ``like``.
+
+    Passing ``item=like`` makes orbax rebuild the original pytree structure
+    (optax states are NamedTuples/tuples, which the stored metadata alone
+    round-trips as lists).
+    """
     import orbax.checkpoint as ocp
     path = checkpoint_path(directory, epoch)
     return _checkpointer().restore(
-        path, restore_args=ocp.checkpoint_utils.construct_restore_args(like))
+        path, item=like,
+        restore_args=ocp.checkpoint_utils.construct_restore_args(like))
 
 
 def restore_and_broadcast(directory: str, like: Any,

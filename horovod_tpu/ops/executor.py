@@ -37,23 +37,46 @@ from horovod_tpu.parallel.mesh import RANKS_AXIS
 
 
 @functools.lru_cache(maxsize=None)
-def _reduce_fn(mesh, length: int, dtype: str):
-    """Jitted fused-buffer reduction: (nranks, length) sharded over ranks →
-    (length,) replicated.  Cached per (shape, dtype) like the reference's
-    reusable fusion buffers (``operations.cc:149-165``).  Always sums:
+def _fused_reduce_fn(mesh, lengths: tuple, dtype: str):
+    """Jitted fused allreduce program: per-rank contribution lists →
+    flatten/concat into one fusion row per rank → reshard the (nranks, L)
+    buffer over the ``ranks`` axis → sum (XLA AllReduce) → replicated
+    (L,) result.  Cached per (entry lengths, dtype) like the reference's
+    reusable fusion buffers (``operations.cc:149-165``) — but the
+    "memcpy into the fusion buffer" is part of the same XLA program, so
+    device-resident inputs never take a host round-trip.  Always sums:
     averaging is applied per tensor in the completion layer, exactly like
     the reference (``mpi_ops_v2.cc:65-71`` divides in the callback) — which
     is also what lets tensors with different ``average`` flags share a
     fusion buffer."""
-    in_sharding = NamedSharding(mesh, P(RANKS_AXIS))
+    sharded = NamedSharding(mesh, P(RANKS_AXIS))
     out_sharding = NamedSharding(mesh, P())
 
-    def fn(stacked):
+    def fn(per_rank):
+        rows = [r[0] if len(r) == 1 else jnp.concatenate(r)
+                for r in per_rank]
+        stacked = jax.lax.with_sharding_constraint(jnp.stack(rows), sharded)
         # dtype-preserving sum: MPI_Allreduce keeps the element type
         # (small ints wrap), unlike jnp.sum's default promotion.
         return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
 
-    return jax.jit(fn, in_shardings=in_sharding, out_shardings=out_sharding)
+    return jax.jit(fn, out_shardings=out_sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _stacked_reduce_fn(mesh, length: int, dtype: str):
+    """Jitted reduction of a pre-staged (nranks, length) host fusion buffer:
+    ``in_shardings`` places each row directly on its target device in the
+    single device_put, then sums over the ``ranks`` axis (XLA AllReduce).
+    The path for host-borne contributions."""
+    in_sharding = NamedSharding(mesh, P(RANKS_AXIS))
+    out_sharding = NamedSharding(mesh, P())
+
+    def fn(stacked):
+        return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
+
+    return jax.jit(fn, in_shardings=in_sharding,
+                   out_shardings=out_sharding)
 
 
 @functools.lru_cache(maxsize=None)
@@ -115,22 +138,43 @@ class Executor:
         dtype = np.dtype(entries[0].dtype)
 
         if self.timeline:
-            self.timeline.activity_start_all(entries, "MEMCPY_IN_FUSION_BUFFER")
-        # Per-rank fusion buffer: flatten + concat this rank's contributions.
-        per_rank_flat = []
-        for r in range(nranks):
-            flats = [np.asarray(e.per_rank[r]).reshape(-1) for e in entries]
-            per_rank_flat.append(
-                np.concatenate(flats) if len(flats) > 1 else flats[0])
-        stacked = np.stack(per_rank_flat)           # (nranks, L)
-        if self.timeline:
-            self.timeline.activity_end_all(entries)
             self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
-
+        lengths = tuple(int(np.prod(e.per_rank[0].shape)) for e in entries)
+        device_resident = all(
+            isinstance(e.per_rank[r], jax.Array)
+            for e in entries for r in range(nranks))
         if _needs_host_path(dtype):
-            reduced = stacked.sum(axis=0, dtype=stacked.dtype)
+            # 64-bit element types: host fusion buffer + host sum.
+            per_rank_flat = [
+                np.concatenate(
+                    [np.asarray(e.per_rank[r]).reshape(-1) for e in entries])
+                if len(entries) > 1
+                else np.asarray(entries[0].per_rank[r]).reshape(-1)
+                for r in range(nranks)]
+            reduced = np.stack(per_rank_flat).sum(axis=0, dtype=dtype)
+        elif device_resident:
+            # Device-borne contributions: fusion-buffer build + collective
+            # as ONE jitted program, consumed in place — no host round-trip
+            # (the reference's CPU path can't avoid its memcpys,
+            # operations.cc:1239-1311; XLA turns ours into HBM moves).
+            fn = _fused_reduce_fn(self.mesh, lengths, str(dtype))
+            reduced = fn(tuple(
+                tuple(e.per_rank[r].reshape(-1) for e in entries)
+                for r in range(nranks)))
         else:
-            fn = _reduce_fn(self.mesh, stacked.shape[1], str(dtype))
+            # Host-borne contributions: stage the (nranks, L) fusion buffer
+            # on host, ONE sharded device_put placing each row on its rank's
+            # device, then the jitted sum.
+            per_rank_flat = [
+                np.concatenate(
+                    [np.asarray(e.per_rank[r], dtype=dtype).reshape(-1)
+                     for e in entries])
+                if len(entries) > 1
+                else np.asarray(entries[0].per_rank[r],
+                                dtype=dtype).reshape(-1)
+                for r in range(nranks)]
+            stacked = np.stack(per_rank_flat)
+            fn = _stacked_reduce_fn(self.mesh, stacked.shape[1], str(dtype))
             reduced = fn(jax.device_put(
                 stacked, NamedSharding(self.mesh, P(RANKS_AXIS))))
         if self.timeline:
@@ -138,8 +182,7 @@ class Executor:
             self.timeline.activity_start_all(entries,
                                              "MEMCPY_OUT_FUSION_BUFFER")
         offset = 0
-        for e in entries:
-            n = int(np.prod(e.per_rank[0].shape))
+        for e, n in zip(entries, lengths):
             out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
             offset += n
             if e.average:

@@ -1,0 +1,43 @@
+"""Build hooks: compile the native core into the wheel.
+
+The reference ships its native code as compiled extensions inside the
+wheel (setup.py custom_build_ext); the TPU-native equivalent is one
+ctypes-loaded shared library, ``horovod_tpu/lib/libhtpu_core.so``, built
+by ``cpp/Makefile`` with hidden visibility + an ``htpu_*`` export list.
+
+``pip install .`` builds the library here, so an installed package never
+needs ``make`` at import time (``cpp_core.load()`` only rebuilds when the
+``cpp/`` source tree is present, i.e. in a git checkout).
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BuildNativeCore(build_py):
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        cpp_dir = os.path.join(here, "cpp")
+        if os.path.isdir(cpp_dir):
+            subprocess.run(["make", "-C", cpp_dir], check=True)
+        super().run()
+
+
+class BinaryDistribution(Distribution):
+    """The package carries a compiled .so (via package_data, not
+    ext_modules), so the wheel must be platform-tagged — a py3-none-any
+    wheel would claim to run on platforms whose ELF loader can't load it."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(
+    cmdclass={"build_py": BuildNativeCore},
+    distclass=BinaryDistribution,
+    package_data={"horovod_tpu": ["lib/*.so"]},
+)

@@ -15,6 +15,10 @@ from horovod_tpu.callbacks import (BroadcastGlobalVariablesCallback,
                                    find_hyperparams)
 
 
+def get_lr(state):
+    return float(np.asarray(find_hyperparams(state.opt_state)["learning_rate"]))
+
+
 def make_state(lr=0.1, momentum=0.9):
     tx = optax.inject_hyperparams(optax.sgd)(learning_rate=lr,
                                              momentum=momentum)
@@ -51,7 +55,7 @@ class TestSchedule:
         for epoch, expect in [(0, 0.1), (1, 0.01), (2, 0.001)]:
             cb.on_epoch_begin(epoch, state)
             cb.on_batch_begin(0, state)
-            assert cb._get_lr(state) == pytest.approx(expect)
+            assert get_lr(state) == pytest.approx(expect)
 
     def test_constant_multiplier_and_window(self, hvd):
         state, _ = make_state(lr=1.0)
@@ -61,10 +65,10 @@ class TestSchedule:
         cb.on_train_begin(state)
         cb.on_epoch_begin(0, state)
         cb.on_batch_begin(0, state)
-        assert cb._get_lr(state) == pytest.approx(1.0)   # before window
+        assert get_lr(state) == pytest.approx(1.0)   # before window
         cb.on_epoch_begin(2, state)
         cb.on_batch_begin(0, state)
-        assert cb._get_lr(state) == pytest.approx(0.5)   # inside
+        assert get_lr(state) == pytest.approx(0.5)   # inside
         state2, _ = make_state(lr=1.0)
         cb2 = LearningRateScheduleCallback(
             multiplier=0.5, start_epoch=2, end_epoch=4,
@@ -72,7 +76,7 @@ class TestSchedule:
         cb2.on_train_begin(state2)
         cb2.on_epoch_begin(5, state2)
         cb2.on_batch_begin(0, state2)
-        assert cb2._get_lr(state2) == pytest.approx(1.0)  # after window
+        assert get_lr(state2) == pytest.approx(1.0)  # after window
 
     def test_momentum_correction_applied_and_restored(self, hvd):
         state, _ = make_state(lr=0.1, momentum=0.9)
@@ -95,7 +99,7 @@ class TestSchedule:
         cb.on_train_begin(state)
         cb.on_epoch_begin(1, state)
         cb.on_batch_begin(5, state)
-        assert cb._get_lr(state) == pytest.approx(1.0 + 1.5)
+        assert get_lr(state) == pytest.approx(1.0 + 1.5)
 
     def test_lr_logged_at_epoch_end(self, hvd):
         state, _ = make_state(lr=0.1)
@@ -135,13 +139,13 @@ class TestWarmup:
         # First batch of epoch 0: lr ≈ base/size
         cb.on_epoch_begin(0, state)
         cb.on_batch_begin(0, state)
-        first = cb._get_lr(state)
+        first = get_lr(state)
         assert first == pytest.approx(
             n * (1.0 / n) * ((0.1 / 5) * (n - 1) + 1), rel=1e-5)
         # Last batch of the last warmup epoch: lr == base exactly
         cb.on_epoch_begin(4, state)
         cb.on_batch_begin(9, state)
-        assert cb._get_lr(state) == pytest.approx(float(n), rel=1e-6)
+        assert get_lr(state) == pytest.approx(float(n), rel=1e-6)
 
     def test_monotonic_ramp(self, hvd):
         state, _ = make_state(lr=8.0)
@@ -153,7 +157,7 @@ class TestWarmup:
             cb.on_epoch_begin(epoch, state)
             for b in range(4):
                 cb.on_batch_begin(b, state)
-                lrs.append(cb._get_lr(state))
+                lrs.append(get_lr(state))
         assert all(b >= a for a, b in zip(lrs, lrs[1:])), lrs
 
 

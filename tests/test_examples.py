@@ -21,6 +21,35 @@ def test_mnist_example(hvd, monkeypatch):
     assert acc > 0.9, f"synthetic MNIST should be learnable, got acc={acc}"
 
 
+def test_mnist_advanced_example(hvd, monkeypatch, tmp_path, capsys):
+    monkeypatch.setattr(sys, "argv", [
+        "x", "--epochs", "2", "--batch-size", "16", "--warmup-epochs", "1",
+        "--checkpoint-dir", str(tmp_path)])
+    ns = runpy.run_path("examples/jax_mnist_advanced.py")
+    acc = ns["main"]()
+    assert acc > 0.9, f"augmented synthetic MNIST should learn, got {acc}"
+    # Rank-0 checkpoint convention: one checkpoint per epoch was written.
+    assert (tmp_path / "checkpoint-1").exists()
+
+
+def test_mnist_estimator_example(hvd, monkeypatch, tmp_path, capsys):
+    # Total steps are divided by world size (reference estimator :178).
+    first = 40 // hvd.size()
+    args = ["--batch-size", "16", "--model-dir", str(tmp_path),
+            "--checkpoint-every", "3"]
+    monkeypatch.setattr(sys, "argv", ["x", "--steps", "40"] + args)
+    ns = runpy.run_path("examples/jax_mnist_estimator.py")
+    ns["main"]()
+    out = capsys.readouterr().out
+    assert f"global_step={first}" in out
+    # Second run auto-resumes from the saved global step.
+    monkeypatch.setattr(sys, "argv", ["x", "--steps", "16"] + args)
+    ns = runpy.run_path("examples/jax_mnist_estimator.py")
+    ns["main"]()
+    out = capsys.readouterr().out
+    assert f"global_step={first + 16 // hvd.size()}" in out
+
+
 def test_word2vec_example(hvd, monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "x", "--steps", "30", "--vocab", "300", "--dim", "16",

@@ -119,6 +119,30 @@ class TestCheckpoint:
                                    np.arange(6))
         assert int(np.asarray(restored["step"])) == 7
 
+    def test_optimizer_state_roundtrip(self, hvd, tmp_path):
+        """optax states are NamedTuple/tuple pytrees — the restore must
+        rebuild that structure, not the lists orbax stores them as
+        (the torch analogue: broadcast_optimizer_state round-trips the
+        full state dict, reference horovod/torch/__init__.py:170-263)."""
+        import optax
+        params = {"w": jnp.arange(4, dtype=jnp.float32)}
+        tx = optax.sgd(0.1, momentum=0.9)
+        opt_state = tx.init(params)
+        # Take one step so momentum is nonzero.
+        updates, opt_state = tx.update(
+            {"w": jnp.ones(4, jnp.float32)}, opt_state, params)
+        state = {"params": params, "opt_state": opt_state}
+        checkpoint.save(str(tmp_path), state, epoch=0)
+        like = {"params": {"w": jnp.zeros(4, jnp.float32)},
+                "opt_state": tx.init({"w": jnp.zeros(4, jnp.float32)})}
+        restored = checkpoint.restore(str(tmp_path), 0, like)
+        assert (jax.tree.structure(restored["opt_state"])
+                == jax.tree.structure(opt_state))
+        got = jax.tree.leaves(restored["opt_state"])
+        want = jax.tree.leaves(opt_state)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w))
+
     def test_latest_epoch_empty(self, tmp_path):
         assert checkpoint.latest_epoch(str(tmp_path)) == -1
         assert checkpoint.latest_epoch(str(tmp_path / "missing")) == -1

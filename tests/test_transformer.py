@@ -16,6 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu import basics
 from horovod_tpu.models import TransformerLM
 from horovod_tpu.parallel.mesh import build_mesh
+from horovod_tpu.parallel.ring_attention import zigzag_indices
 
 
 VOCAB, DIM, DEPTH, HEADS = 64, 32, 2, 4
@@ -33,12 +34,12 @@ def loss_of(model, params, tokens, labels):
         logits, labels).mean()
 
 
-@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+@pytest.mark.parametrize("attn", ["ring", "ring_zigzag", "ulysses"])
 def test_sp_loss_matches_full(hvd, attn):
     """Same params, same tokens: sequence-parallel loss == full loss."""
     n = hvd.size()
     # Ulysses shards heads across ranks, so it needs heads % ranks == 0.
-    heads = HEADS if attn == "ring" else n
+    heads = n if attn == "ulysses" else HEADS
     model_full = TransformerLM(vocab=VOCAB, dim=DIM * 2, depth=DEPTH,
                                num_heads=heads, attn="full",
                                dtype=jnp.float32)
@@ -47,6 +48,12 @@ def test_sp_loss_matches_full(hvd, attn):
     T = 4 * n
     tokens, labels = data(2, T)
     want = float(loss_of(model_full, params, tokens, labels))
+    if attn == "ring_zigzag":
+        # The zigzag layout is a fixed host-side permutation of the
+        # sequence; mean LM loss is invariant when tokens and labels are
+        # permuted identically.
+        idx = zigzag_indices(n, T)
+        tokens, labels = tokens[:, idx], labels[:, idx]
 
     model_sp = TransformerLM(vocab=VOCAB, dim=DIM * 2, depth=DEPTH,
                              num_heads=heads, attn=attn, sp_axis="ranks",
