@@ -91,7 +91,8 @@ def main():
     timed_batches = int(os.environ.get("BENCH_ITERS", "30"))
     batch = batch_per_chip * nchips
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, remat=remat)
     rng = jax.random.PRNGKey(42)
     # Generate the global batch already sharded over the mesh so no single
     # chip ever holds it (the reference generates per-rank data locally,

@@ -90,6 +90,15 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         _state.mesh = _mesh_mod.build_ranks_mesh(_state.topology)
         from horovod_tpu import core as _core_mod
         _state.controller = _core_mod.Controller(_state.topology, _state.mesh)
+        # Multi-process: the controller's layout exchange discovered which
+        # processes share this host (reference: shared-memory comm split,
+        # operations.cc:1499-1509); fold that into the topology so
+        # local_rank() reports the discovered index.
+        if _state.controller.host_local_rank is not None:
+            import dataclasses
+            _state.topology = dataclasses.replace(
+                _state.topology,
+                local_rank_override=_state.controller.host_local_rank)
         _state.controller.start()
         if not _state.atexit_registered:
             atexit.register(shutdown)

@@ -474,6 +474,10 @@ class Controller:
         # TCP control plane (reference: MPI gather/bcast + CPU data plane).
         self._control = None
         self._rank_to_process: Dict[int, int] = {}
+        # Host grouping (None = not discovered; single-process jobs don't
+        # need it — one process per host is the TPU pod norm).
+        self.host_local_rank: Optional[int] = None
+        self.host_local_size: Optional[int] = None
         coord_addr = os.environ.get("HOROVOD_TPU_COORD_ADDR", "")
         if coord_addr and topology.process_count > 1:
             if not self._use_cpp:
@@ -488,17 +492,27 @@ class Controller:
                 host or "127.0.0.1", int(port), topology.rank,
                 topology.size, timeout_ms)
             # Exchange the process layout once: (process_index, first_rank,
-            # local_size) per process -> global rank->process map (the
-            # reference gets this from MPI comm splits,
-            # operations.cc:1499-1532).
+            # local_size, hostname) per process -> global rank->process map
+            # plus host grouping (the reference gets both from MPI comm
+            # splits, operations.cc:1499-1532; hostname equality is the
+            # TPU-native stand-in for MPI_Comm_split_type(SHARED)).
+            import socket
             import struct
-            mine = struct.pack("<3i", topology.process_index, topology.rank,
-                               topology.local_size)
+            my_host = socket.gethostname().encode()[:64]
+            mine = struct.pack("<3i64s", topology.process_index,
+                               topology.rank, topology.local_size, my_host)
             blob = self._control.allgather(mine)
-            for off in range(0, len(blob), 12):
-                pidx, frank, lsize = struct.unpack_from("<3i", blob, off)
+            host_procs = []
+            for off in range(0, len(blob), 76):
+                pidx, frank, lsize, host = struct.unpack_from(
+                    "<3i64s", blob, off)
                 for r in range(frank, frank + lsize):
                     self._rank_to_process[r] = pidx
+                if host.rstrip(b"\0") == my_host.rstrip(b"\0"):
+                    host_procs.append(pidx)
+            host_procs.sort()
+            self.host_local_rank = host_procs.index(topology.process_index)
+            self.host_local_size = len(host_procs)
 
         self.timeline = None
         timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")

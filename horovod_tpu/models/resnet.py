@@ -60,12 +60,19 @@ class BottleneckBlock(nn.Module):
 
 
 class ResNet(nn.Module):
-    """ResNet v1.5 for NHWC images."""
+    """ResNet v1.5 for NHWC images.
+
+    ``remat=True`` checkpoints each bottleneck block: the backward pass
+    recomputes block activations instead of streaming them from HBM —
+    trading MXU FLOPs (abundant at this model's ~15% MFU) for HBM
+    bandwidth (the measured bottleneck; see docs/benchmarks.md).
+    """
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -75,6 +82,8 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32, axis_name=None)
         act = nn.relu
+        block_cls = (nn.remat(BottleneckBlock) if self.remat
+                     else BottleneckBlock)
 
         x = jnp.asarray(x, self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
@@ -82,11 +91,17 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_idx = 0
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
-                                    conv=conv, norm=norm, act=act)(x)
+                # Explicit name: nn.remat changes the auto-derived module
+                # path, which would make remat=True/False checkpoints
+                # incompatible; pinning the name keeps one param tree.
+                x = block_cls(self.num_filters * 2 ** i, strides,
+                              conv=conv, norm=norm, act=act,
+                              name=f"BottleneckBlock_{block_idx}")(x)
+                block_idx += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32, name="head")(x)

@@ -93,12 +93,35 @@ def _needs_host_path(dtype) -> bool:
     return np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64
 
 
+def _host_fusion_rows(entries, nranks: int, dtype) -> List[np.ndarray]:
+    """Host-side fusion buffer: one flattened row per rank, same-dtype
+    entries concatenated (the staging the reference does with memcpys,
+    ``operations.cc:1239-1258``)."""
+    return [
+        np.concatenate(
+            [np.asarray(e.per_rank[r], dtype=dtype).reshape(-1)
+             for e in entries])
+        if len(entries) > 1
+        else np.asarray(entries[0].per_rank[r], dtype=dtype).reshape(-1)
+        for r in range(nranks)]
+
+
 class Executor:
     def __init__(self, topology, mesh, timeline=None):
         self.topology = topology
         self.mesh = mesh
         self.timeline = timeline
         self.nranks = topology.size
+        self._mesh_device_set = set(np.asarray(mesh.devices).flat)
+
+    def _mesh_safe(self, v) -> "jax.Array":
+        """Make a device contribution consumable by the mesh-wide jitted
+        program: arrays committed to devices that are not exactly the mesh's
+        device set would make jit raise an incompatible-devices error, so
+        replicate them onto the mesh first (device-to-device, no host hop)."""
+        if v.committed and set(v.sharding.device_set) != self._mesh_device_set:
+            return jax.device_put(v, _replicate_sharding(self.mesh))
+        return v
 
     # ----------------------------------------------------------------- entry
 
@@ -137,43 +160,44 @@ class Executor:
         nranks = self.nranks
         dtype = np.dtype(entries[0].dtype)
 
-        if self.timeline:
-            self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
         lengths = tuple(int(np.prod(e.per_rank[0].shape)) for e in entries)
         device_resident = all(
             isinstance(e.per_rank[r], jax.Array)
             for e in entries for r in range(nranks))
         if _needs_host_path(dtype):
             # 64-bit element types: host fusion buffer + host sum.
-            per_rank_flat = [
-                np.concatenate(
-                    [np.asarray(e.per_rank[r]).reshape(-1) for e in entries])
-                if len(entries) > 1
-                else np.asarray(entries[0].per_rank[r]).reshape(-1)
-                for r in range(nranks)]
-            reduced = np.stack(per_rank_flat).sum(axis=0, dtype=dtype)
+            if self.timeline:
+                self.timeline.activity_start_all(entries,
+                                                 "MEMCPY_IN_FUSION_BUFFER")
+            rows = _host_fusion_rows(entries, nranks, dtype)
+            if self.timeline:
+                self.timeline.activity_end_all(entries)
+                self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
+            reduced = np.stack(rows).sum(axis=0, dtype=dtype)
         elif device_resident:
             # Device-borne contributions: fusion-buffer build + collective
             # as ONE jitted program, consumed in place — no host round-trip
             # (the reference's CPU path can't avoid its memcpys,
-            # operations.cc:1239-1311; XLA turns ours into HBM moves).
+            # operations.cc:1239-1311; XLA turns ours into HBM moves, so
+            # there is no separate MEMCPY_IN span in this mode).
+            if self.timeline:
+                self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
             fn = _fused_reduce_fn(self.mesh, lengths, str(dtype))
             reduced = fn(tuple(
-                tuple(e.per_rank[r].reshape(-1) for e in entries)
+                tuple(self._mesh_safe(e.per_rank[r]).reshape(-1)
+                      for e in entries)
                 for r in range(nranks)))
         else:
             # Host-borne contributions: stage the (nranks, L) fusion buffer
             # on host, ONE sharded device_put placing each row on its rank's
             # device, then the jitted sum.
-            per_rank_flat = [
-                np.concatenate(
-                    [np.asarray(e.per_rank[r], dtype=dtype).reshape(-1)
-                     for e in entries])
-                if len(entries) > 1
-                else np.asarray(entries[0].per_rank[r],
-                                dtype=dtype).reshape(-1)
-                for r in range(nranks)]
-            stacked = np.stack(per_rank_flat)
+            if self.timeline:
+                self.timeline.activity_start_all(entries,
+                                                 "MEMCPY_IN_FUSION_BUFFER")
+            stacked = np.stack(_host_fusion_rows(entries, nranks, dtype))
+            if self.timeline:
+                self.timeline.activity_end_all(entries)
+                self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
             fn = _stacked_reduce_fn(self.mesh, stacked.shape[1], str(dtype))
             reduced = fn(jax.device_put(
                 stacked, NamedSharding(self.mesh, P(RANKS_AXIS))))

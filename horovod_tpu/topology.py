@@ -45,6 +45,10 @@ class Topology:
     # the device-derived values.  -1 = derive from devices.
     size_override: int = -1
     rank_override: int = -1
+    # Host grouping discovered at init via the control-plane hostname
+    # exchange (the reference's MPI_Comm_split_type(SHARED) equivalent,
+    # operations.cc:1499-1509).  -1 = not discovered.
+    local_rank_override: int = -1
 
     @property
     def size(self) -> int:
@@ -69,16 +73,22 @@ class Topology:
 
     @property
     def local_rank(self) -> int:
-        """Index of this process among processes on the same host.
+        """Index of this process among processes on the same host
+        (reference ``horovod/common/__init__.py:103-117``; derived there
+        from a shared-memory comm split, ``operations.cc:1499-1509``).
 
-        TPU pods run one process per host, so this is 0 unless a launcher
-        that packs several processes per host sets
-        ``HOROVOD_TPU_LOCAL_RANK`` explicitly (JAX does not expose host
-        grouping).  Kept for API parity with the reference
-        (``horovod/common/__init__.py:103-117``).
+        Resolution order: explicit ``HOROVOD_TPU_LOCAL_RANK`` (launcher
+        override) → host grouping discovered by the control-plane hostname
+        exchange (multi-process mode) → 0 (single process per host, the
+        TPU pod norm).
         """
         import os
-        return int(os.environ.get("HOROVOD_TPU_LOCAL_RANK", "0"))
+        env = os.environ.get("HOROVOD_TPU_LOCAL_RANK")
+        if env is not None:
+            return int(env)
+        if self.local_rank_override >= 0:
+            return self.local_rank_override
+        return 0
 
     @property
     def local_rank_device_ids(self) -> Tuple[int, ...]:

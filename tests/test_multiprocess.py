@@ -91,6 +91,13 @@ WORKER = textwrap.dedent("""
                                    name="mp.after"))
     np.testing.assert_allclose(out, float(n))
 
+    # 8. host grouping: all test processes share this host, so the
+    #    discovered local_rank equals the process index (reference derives
+    #    this from MPI_Comm_split_type(SHARED), operations.cc:1499-1509;
+    #    here it comes from the control-plane hostname exchange).
+    assert hvd.local_rank() == hvd.process_index(), (
+        hvd.local_rank(), hvd.process_index())
+
     print(f"WORKER_OK rank={rank}")
     hvd.shutdown()
 """)

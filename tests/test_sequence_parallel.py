@@ -142,7 +142,12 @@ class TestZigzagRingAttention:
         balanced half-work schedule beats the dense-masked contiguous one
         (observed ~1.5x on the 8-device host platform; asserted loosely to
         tolerate timer noise)."""
+        import os
         import time
+
+        if (os.cpu_count() or 0) < 8:
+            pytest.skip("8 virtual devices need >= 8 cores for timing to "
+                        "mean anything")
 
         n = hvd.size()
         B, T, H, D = 1, 128 * n, 8, 64

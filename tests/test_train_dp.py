@@ -165,3 +165,36 @@ def test_broadcast_optimizer_state(hvd):
     # structure and values preserved
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b)), out, state)
+
+
+def test_resnet_remat_is_semantics_preserving(hvd):
+    """ResNet(remat=True) must share the param tree with remat=False (the
+    knob trades HBM traffic for recompute, nothing else) — forward and
+    gradients identical with the same params."""
+    from horovod_tpu.models import ResNet50
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    plain = ResNet50(num_classes=10, dtype=jnp.float32, remat=False)
+    ckpt = ResNet50(num_classes=10, dtype=jnp.float32, remat=True)
+    variables = plain.init(jax.random.PRNGKey(0), x, train=True)
+
+    def loss_with(model):
+        def loss(p):
+            out, _ = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return (out ** 2).mean()
+        return loss
+
+    # Same param tree: apply each model with the OTHER's init.
+    out_plain, _ = plain.apply(x=x, train=True, mutable=["batch_stats"],
+                               variables=variables)
+    out_ckpt, _ = ckpt.apply(x=x, train=True, mutable=["batch_stats"],
+                             variables=variables)
+    np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_ckpt),
+                               rtol=1e-5, atol=1e-5)
+    g_plain = jax.grad(loss_with(plain))(variables["params"])
+    g_ckpt = jax.grad(loss_with(ckpt))(variables["params"])
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_ckpt)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
