@@ -1,0 +1,51 @@
+"""VMA (varying-manual-axes) helpers shared by the model-parallel modules.
+
+Under ``shard_map(..., check_vma=True)`` JAX tracks whether each value is
+invariant or varying across every manual mesh axis; the psum/pvary
+transpose pairing that makes model-parallel gradients exact depends on
+per-shard parameters actually being *varying*.  A constant initializer
+(``zeros``) produces a value with no data dependence on the shard index,
+which the tracker would classify invariant — i.e. one shared array whose
+gradient gets cross-shard summed.  ``ensure_varying`` closes that hole.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _to_varying(v, axis: str):
+    # jax >= 0.9 spells this lax.pcast(..., to='varying'); pvary is the
+    # deprecated spelling kept as a fallback.
+    try:
+        return lax.pcast(v, axis, to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(v, axis)
+
+
+def ensure_varying(v, axis: str):
+    """Mark ``v`` varying over manual ``axis`` if it isn't already."""
+    if axis not in getattr(jax.typeof(v), "vma", frozenset()):
+        v = _to_varying(v, axis)
+    return v
+
+
+def ensure_varying_tree(tree, axis: str):
+    """:func:`ensure_varying` over every leaf of a pytree."""
+    return jax.tree.map(lambda v: ensure_varying(v, axis), tree)
+
+
+def per_shard_init(init, axis: str):
+    """Wrap a flax initializer so each shard along ``axis`` draws a
+    distinct, VMA-varying slice: folds the shard index into the RNG key
+    and marks the result varying (constant initializers like ``zeros``
+    ignore the key and would otherwise be classified invariant — i.e. one
+    shared array whose gradient gets cross-shard summed)."""
+    from jax import lax
+
+    def wrapped(key, shape, dtype):
+        return ensure_varying(
+            init(jax.random.fold_in(key, lax.axis_index(axis)),
+                 shape, dtype), axis)
+    return wrapped

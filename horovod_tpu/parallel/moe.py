@@ -1,0 +1,110 @@
+"""Expert parallelism — Switch-style mixture-of-experts over a mesh axis.
+
+Beyond the reference's scope (data-parallel only, SURVEY §2.3): the MLP is
+replaced by E experts, one per chip along the ``ep`` mesh axis, and each
+token is routed to one expert.  The TPU-first realization runs inside
+``shard_map`` with tokens sharded over ``ep`` (data parallel within the
+expert group):
+
+* the router is a small replicated dense — top-1 expert + gate probability
+  per token (Switch Transformer routing);
+* dispatch is pure matmul: a ``(tokens, E, capacity)`` one-hot dispatch
+  tensor built from a cumulative-sum position assignment — einsums instead
+  of scatters, so everything lands on the MXU with static shapes;
+* one ``lax.all_to_all`` ships each shard's per-expert buffers to the
+  owning chips, the local expert FFN runs on its ``(E*capacity, d)``
+  tokens, and a second all_to_all ships results home, where the same
+  dispatch tensor combines them (weighted by the gate).
+
+Tokens over capacity are dropped (pass through the residual only) — the
+Switch behaviour; size capacity with ``capacity_factor``.  The router's
+load-balancing auxiliary loss (Switch eq. 4: ``E * Σ_e f_e · p_e``) is
+returned alongside the output; add ``aux_weight * aux`` to the loss.
+
+Training runs under ``shard_map(..., check_vma=True)`` like the other
+model-parallel modules; expert params are VMA-varying over ``ep``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel._vma import per_shard_init as _expert_init
+
+EP_AXIS = "ep"
+
+
+class MoELayer(nn.Module):
+    """Top-1 (Switch) MoE feed-forward, one expert per ``axis`` shard.
+
+    Input ``(tokens_local, d)`` — this shard's tokens, sharded over
+    ``axis``.  Returns ``(output, aux_loss)``: output ``(tokens_local, d)``
+    (zero rows for dropped tokens — callers keep the residual connection),
+    aux_loss the scalar Switch load-balancing loss for this shard's tokens.
+    """
+
+    hidden: int
+    capacity_factor: float = 1.25
+    axis: str = EP_AXIS
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        E = lax.axis_size(self.axis)
+        T, d = x.shape
+        C = max(1, int(self.capacity_factor * T / E))
+
+        # Router (replicated params): top-1 expert and gate prob per token.
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=self.param_dtype,
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)           # (T, E)
+        gate = probs.max(axis=-1)                         # (T,)
+        expert = probs.argmax(axis=-1)                    # (T,)
+
+        # Position of each token within its expert's capacity; tokens past
+        # capacity are dropped (Switch semantics).
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # (T, E)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot         # (T, E)
+        pos_in_expert = pos.sum(-1).astype(jnp.int32)             # (T,)
+        keep = (pos_in_expert < C).astype(jnp.float32)
+        # (T, E, C) dispatch tensor: token t -> slot (e, c).
+        disp = (onehot[:, :, None]
+                * jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None])
+
+        # Local buffers -> owning experts -> FFN -> back home.
+        buffers = jnp.einsum("td,tec->ecd", x.astype(self.dtype),
+                             disp.astype(self.dtype))             # (E, C, d)
+        recv = lax.all_to_all(buffers, self.axis, split_axis=0,
+                              concat_axis=0)                      # (E, C, d)
+        h = recv.reshape(E * C, d)
+        w1 = self.param("w1", _expert_init(nn.initializers.lecun_normal(),
+                                           self.axis),
+                        (d, self.hidden), self.param_dtype)
+        w2 = self.param("w2", _expert_init(nn.initializers.lecun_normal(),
+                                           self.axis),
+                        (self.hidden, d), self.param_dtype)
+        h = jnp.dot(h.astype(self.dtype), w1.astype(self.dtype))
+        h = nn.gelu(h)
+        h = jnp.dot(h, w2.astype(self.dtype))
+        sent = lax.all_to_all(h.reshape(E, C, d), self.axis,
+                              split_axis=0, concat_axis=0)        # (E, C, d)
+        out = jnp.einsum("ecd,tec->td", sent.astype(jnp.float32),
+                         disp)                                    # (T, d)
+        # Dropped rows are already exactly zero (their disp slice is all
+        # zeros); only the gate weighting remains to apply.
+        out = out * gate[:, None]
+
+        # Switch load-balancing aux loss: E * sum_e f_e * p_e  where f_e is
+        # the fraction of tokens routed to e, p_e the mean router prob.
+        f = onehot.mean(axis=0)
+        p = probs.mean(axis=0)
+        aux = E * jnp.sum(f * p)
+        return out.astype(x.dtype), aux

@@ -1,0 +1,287 @@
+"""Tensor (model) parallelism — Megatron-style sharded matmuls on a mesh axis.
+
+Beyond the reference's scope (it is data-parallel only, SURVEY §2.3), but a
+required scaling axis for models whose layers don't fit one chip.  The
+TPU-first design runs *inside* ``shard_map`` over a ``tp`` mesh axis:
+
+* :class:`ColumnParallelDense` — output features sharded: each chip holds
+  ``features/tp`` columns of the kernel and computes its slice with **no
+  communication**; activations leave feature-sharded.
+* :class:`RowParallelDense` — input features sharded: each chip holds
+  ``in/tp`` rows, computes a partial product, and one ``psum`` over the
+  ``tp`` axis (XLA AllReduce over ICI) completes the matmul.  Bias is added
+  after the reduction so it is applied once.
+
+The canonical pairing (one collective per block, the Megatron recipe):
+MLP = Column(4C) → gelu → Row(C); attention = per-head sharding — Q/K/V
+projections column-parallel (each chip gets ``heads/tp`` heads), attention
+computed locally on those heads, output projection row-parallel.
+
+Param placement: kernels are *materially sharded* — each shard initializes
+only its slice (the init RNG folds in ``lax.axis_index`` so slices differ,
+and the slice is marked VMA-varying over ``tp``), and the host-side param
+tree holds arrays sharded over ``tp``.  Use :func:`tp_spec_tree` to derive
+the ``PartitionSpec`` tree for ``shard_map``/``jit`` in/out specs, and
+:func:`tp_value_and_grad` for training gradients.
+
+Training must run under ``shard_map(..., check_vma=True)``: the VMA
+(varying-manual-axes) tracking is what gives ``psum``/``pvary`` their
+correct transposes, so gradients of sharded and replicated params come out
+exact with no manual correction factors (asserted against a dense oracle
+in ``tests/test_tensor_parallel.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+TP_AXIS = "tp"
+
+
+from horovod_tpu.parallel._vma import per_shard_init as _per_shard_init
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features sharded over ``axis``.
+
+    ``features`` is the GLOBAL output width; this shard computes
+    ``features // tp`` of it.  Input must be replicated across ``axis``;
+    output is feature-sharded (feed it to a :class:`RowParallelDense` or
+    consume it locally, e.g. as attention heads).
+    """
+
+    features: int
+    axis: str = TP_AXIS
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        n = lax.axis_size(self.axis)
+        if self.features % n:
+            raise ValueError(
+                f"ColumnParallelDense features={self.features} not divisible "
+                f"by tp={n}")
+        local = self.features // n
+        kernel = self.param(
+            "kernel", _per_shard_init(self.kernel_init, self.axis),
+            (x.shape[-1], local), self.param_dtype)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias", _per_shard_init(self.bias_init, self.axis),
+                (local,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features sharded over ``axis``.
+
+    This shard holds ``in_local`` rows of the global ``(in, features)``
+    kernel; the partial products are reduced with one ``psum``.  The input
+    must already be feature-sharded (a ColumnParallelDense output); the
+    result is replicated across ``axis``.
+    """
+
+    features: int
+    axis: str = TP_AXIS
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", _per_shard_init(self.kernel_init, self.axis),
+            (x.shape[-1], self.features), self.param_dtype)
+        partial = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        y = lax.psum(partial, self.axis)
+        if self.use_bias:
+            # Replicated bias, added once — after the reduction.
+            bias = self.param("bias", self.bias_init,
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class TPMlp(nn.Module):
+    """Megatron MLP: Column(hidden) → act → Row(out) — one psum total."""
+
+    hidden: int
+    out: int
+    axis: str = TP_AXIS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, self.axis, dtype=self.dtype,
+                                name="col")(x)
+        h = nn.gelu(h)
+        return RowParallelDense(self.out, self.axis, dtype=self.dtype,
+                                name="row")(h)
+
+
+class TPSelfAttention(nn.Module):
+    """Causal self-attention with heads sharded over ``axis``.
+
+    Q/K/V projections are column-parallel (this shard computes
+    ``num_heads // tp`` heads), attention runs locally on those heads, and
+    the output projection is row-parallel — one psum per layer, the
+    Megatron schedule.
+    """
+
+    num_heads: int
+    axis: str = TP_AXIS
+    causal: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from horovod_tpu.parallel.ring_attention import full_attention
+
+        B, T, C = x.shape
+        n = lax.axis_size(self.axis)
+        if self.num_heads % n:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by tp={n}")
+        local_heads = self.num_heads // n
+        D = C // self.num_heads
+        qkv = ColumnParallelDense(3 * C, self.axis, use_bias=False,
+                                  dtype=self.dtype, name="col_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)       # each (B, T, C/tp)
+        q = q.reshape(B, T, local_heads, D)
+        k = k.reshape(B, T, local_heads, D)
+        v = v.reshape(B, T, local_heads, D)
+        out = full_attention(q, k, v, causal=self.causal)
+        out = out.reshape(B, T, local_heads * D)
+        return RowParallelDense(C, self.axis, use_bias=False,
+                                dtype=self.dtype, name="row_proj")(out)
+
+
+# --------------------------------------------------------- spec derivation
+
+def tp_abstract_params(init_fn: Callable[[], Any], tp_size: int,
+                       axis: str = TP_AXIS):
+    """Shape-evaluate a TP model's init OUTSIDE shard_map.
+
+    TP layers call ``lax.axis_size(axis)`` so a bare ``jax.eval_shape``
+    fails with "unbound axis name"; this binds ``axis`` abstractly via a
+    size-``tp_size`` vmap, evaluates shapes only (no FLOPs, no devices),
+    and strips the vmap axis — giving the PER-SHARD param
+    ``ShapeDtypeStruct`` tree.  Feed it to :func:`tp_spec_tree` to get the
+    ``PartitionSpec`` tree before ever touching the mesh::
+
+        shapes = tp_abstract_params(lambda: mlp.init(key, x)["params"], tp)
+        specs  = tp_spec_tree(shapes)
+    """
+    out = jax.eval_shape(
+        jax.vmap(lambda _: init_fn(), axis_name=axis, axis_size=tp_size),
+        jax.ShapeDtypeStruct((tp_size,), jnp.int32))
+    return jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), out)
+
+def _is_col_name(name: str) -> bool:
+    return (name.startswith("ColumnParallelDense") or name == "col"
+            or name.startswith("col_"))
+
+
+def _is_row_name(name: str) -> bool:
+    return (name.startswith("RowParallelDense") or name == "row"
+            or name.startswith("row_"))
+
+
+def tp_spec_tree(params, axis: str = TP_AXIS):
+    """PartitionSpec tree for a param pytree containing parallel layers.
+
+    Classified by the leaf's DIRECT parent module name (flax auto-names
+    ``ColumnParallelDense_i`` / ``RowParallelDense_i``, or the explicit
+    naming convention ``col`` / ``col_*`` / ``row`` / ``row_*`` used by
+    :class:`TPMlp` and :class:`TPSelfAttention`):
+
+    * column-parallel — kernel ``P(None, tp)``, bias ``P(tp)``;
+    * row-parallel    — kernel ``P(tp, None)``, bias replicated;
+    * everything else — replicated.
+
+    Name your own non-TP modules outside the ``col_*`` / ``row_*``
+    convention (or build the spec tree yourself) to avoid
+    misclassification — the layout is a naming contract, not introspection.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+
+    def classify(path):
+        keys = [str(getattr(p, "key", p)) for p in path]
+        parent = keys[-2] if len(keys) >= 2 else ""
+        leaf = keys[-1] if keys else ""
+        if _is_col_name(parent):
+            return P(None, axis) if leaf == "kernel" else P(axis)
+        if _is_row_name(parent):
+            return P(axis, None) if leaf == "kernel" else P()
+        return P()
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [classify(path) for path, _ in flat])
+
+
+def tp_optimizer_specs(opt_state_shapes, param_shapes, param_specs):
+    """PartitionSpec tree for an optax state over TP-sharded params.
+
+    Optimizer states embed copies of the param tree (SGD momentum, Adam
+    mu/nu, ...): every subtree structurally identical to ``param_shapes``
+    gets ``param_specs`` (so moment estimates shard exactly like their
+    params); every other leaf (step counters, scalars) is replicated.
+
+    ``opt_state_shapes`` from ``jax.eval_shape(tx.init, param_shapes)``
+    with ``param_shapes`` from :func:`tp_abstract_params`.
+    """
+    import jax.tree_util as jtu
+    pstruct = jtu.tree_structure(param_shapes)
+
+    def is_param_tree(node):
+        try:
+            return jtu.tree_structure(node) == pstruct
+        except Exception:   # noqa: BLE001 — unflattenable odd nodes
+            return False
+
+    return jax.tree.map(
+        lambda sub: param_specs if is_param_tree(sub) else P(),
+        opt_state_shapes, is_leaf=is_param_tree)
+
+
+def tp_value_and_grad(loss_fn, params, dp_axes: Sequence[str] = ()):
+    """``value_and_grad`` for TP models inside ``shard_map`` with
+    ``check_vma=True`` (required — VMA tracking is what makes the psum /
+    pvary transposes correct for mixed sharded/replicated params).
+
+    The data-parallel gradient reduction is NOT an explicit pmean here:
+    params are dp-invariant, so AD's pvary-transpose already **sums** their
+    gradients across ``dp_axes``.  Scaling the per-shard loss by
+    ``1/dp_size`` turns that sum into the mean; the returned loss is the
+    global mean (psum of the scaled per-shard losses).  tp-sharded params
+    (VMA-varying over tp, see :func:`_per_shard_init`) get per-slice
+    gradients with no cross-shard mixing.
+    """
+    dp_axes = tuple(dp_axes)
+
+    def scaled(p):
+        loss = loss_fn(p)
+        for ax in dp_axes:
+            loss = loss / lax.axis_size(ax)
+        return loss
+
+    loss, grads = jax.value_and_grad(scaled)(params)
+    if dp_axes:
+        loss = lax.psum(loss, dp_axes)
+    return loss, grads
