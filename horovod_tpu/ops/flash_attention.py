@@ -1,0 +1,226 @@
+"""Pallas flash attention — the fused single-chip attention hot path.
+
+The transformer family's attention math (`full_attention`) leaves XLA to
+materialize the (T, T) logits in HBM.  This kernel computes the same
+causal softmax-attention with the flash schedule instead: Q blocks stay
+resident in VMEM while K/V blocks stream through, the online-softmax
+accumulators (running max / sum / output, all f32) never leave VMEM, and
+the MXU sees back-to-back (block_q x d) @ (d x block_k) matmuls.  HBM
+traffic drops from O(T^2) to O(T·d).
+
+Layout: grid ``(batch*heads, T/block_q, T/block_k)`` with the KV axis
+innermost ("arbitrary" semantics — accumulators persist across it);
+causal Q/KV block pairs that are entirely masked are skipped with
+``pl.when``, halving the work like the zigzag ring layout does across
+chips.
+
+Backward: ``jax.custom_vjp`` saving (o, logsumexp); gradients use the
+standard flash-backward identities (dS = P * (dP - rowsum(dO*o))) with
+blockwise XLA einsums over KV chunks via ``lax.map`` — linear memory, no
+(T, T) materialization.
+
+Composition: this is the *single-chip* block; for sequences sharded
+across chips use :mod:`horovod_tpu.parallel.ring_attention`, which
+streams K/V between chips with the same online-softmax math.
+
+``interpret=True`` runs the kernel on CPU for tests; on TPU the shapes
+must tile ((block sizes multiples of 128 ideally), else the caller should
+fall back to ``full_attention``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: a KV block strictly after the last query row of this Q block
+    # contributes nothing — skip its compute entirely.
+    q_last = (qi + 1) * block_q - 1
+    k_first = kj * block_k
+
+    @pl.when(jnp.logical_or(not causal, k_first <= q_last))
+    def _compute():
+        # Matmuls consume the native (bf16) element type so the MXU runs
+        # at full rate; accumulation is f32 via preferred_element_type.
+        q = q_ref[0]                                  # (BQ, D)
+        k = k_ref[0]                                  # (BK, D)
+        v = v_ref[0]                                  # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (BQ, BK)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_BIG)
+        m_prev = m_scr[...]                            # (BQ, 128)
+        block_max = jnp.max(s, axis=1, keepdims=True)  # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(block_max,
+                                                     m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # (BQ, 1)
+        p = jnp.exp(s - m_new[:, :1])                  # (BQ, BK)
+        if causal:
+            p = jnp.where(cols <= rows, p, 0.0)
+        l_new = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # lse laid out (BQ, 8) — the minimal last-dim tile the TPU block
+        # constraints allow for this narrow per-row scalar.
+        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                      (block_q, 8))
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    BH, T, D = q.shape
+    nq = T // block_q
+    nk = T // block_k
+    grid = (BH, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_xla(q, k, v, o, lse, do, *, scale, causal, chunk):
+    """Flash backward with blockwise XLA einsums over KV chunks: linear
+    memory, uses the saved logsumexp (no softmax recompute instability)."""
+    BH, T, D = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)     # (BH, T)
+    rows = jnp.arange(T)
+
+    def one_chunk(start):
+        ks = lax.dynamic_slice_in_dim(kf, start, chunk, axis=1)
+        vs = lax.dynamic_slice_in_dim(vf, start, chunk, axis=1)
+        cols = start + jnp.arange(chunk)
+        s = jnp.einsum("btd,bcd->btc", qf, ks) * scale
+        if causal:
+            mask = cols[None, :] <= rows[:, None]             # (T, chunk)
+            s = jnp.where(mask[None], s, _NEG_BIG)
+        p = jnp.exp(s - lse[..., None])                       # (BH, T, c)
+        if causal:
+            p = jnp.where(mask[None], p, 0.0)
+        dp = jnp.einsum("btd,bcd->btc", dof, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_c = jnp.einsum("btc,bcd->btd", ds, ks)
+        dk_c = jnp.einsum("btc,btd->bcd", ds, qf)
+        dv_c = jnp.einsum("btc,btd->bcd", p, dof)
+        return dq_c, dk_c, dv_c
+
+    starts = jnp.arange(0, T, chunk)
+    dq_chunks, dk_chunks, dv_chunks = lax.map(one_chunk, starts)
+    dq = jnp.sum(dq_chunks, axis=0)
+    # Chunk results are (n_chunks, BH, chunk, D); chunks tile the T axis.
+    dk = dk_chunks.transpose(1, 0, 2, 3).reshape(BH, T, D)
+    dv = dv_chunks.transpose(1, 0, 2, 3).reshape(BH, T, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_xla(q, k, v, o, lse, do, scale=scale, causal=causal,
+                    chunk=block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Fused flash attention for ``(B, T, H, D)`` inputs (same contract as
+    :func:`~horovod_tpu.parallel.ring_attention.full_attention`).
+
+    Requires ``T % block == 0`` (clamps the blocks to ``T`` when the
+    sequence is shorter); differentiable via the flash-backward identities.
+    Set ``interpret=True`` to run off-TPU (tests).
+    """
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"flash_attention needs T divisible by the block sizes, got "
+            f"T={T}, block_q={block_q}, block_k={block_k}; use "
+            f"full_attention for ragged lengths")
+
+    def merge(x):   # (B, T, H, D) -> (B*H, T, D)
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    out = _flash(merge(q), merge(k), merge(v), float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -27,7 +27,7 @@ model-parallel modules; expert params are VMA-varying over ``ep``.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import flax.linen as nn
 import jax

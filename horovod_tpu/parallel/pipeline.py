@@ -25,7 +25,7 @@ oracle in ``tests/test_pipeline.py``).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

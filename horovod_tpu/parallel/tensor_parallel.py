@@ -33,7 +33,7 @@ in ``tests/test_tensor_parallel.py``).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax
@@ -41,10 +41,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-TP_AXIS = "tp"
-
-
 from horovod_tpu.parallel._vma import per_shard_init as _per_shard_init
+
+TP_AXIS = "tp"
 
 
 class ColumnParallelDense(nn.Module):

@@ -50,6 +50,17 @@ def test_mnist_estimator_example(hvd, monkeypatch, tmp_path, capsys):
     assert f"global_step={first + 16 // hvd.size()}" in out
 
 
+def test_model_parallel_example(hvd, monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", [
+        "x", "--steps", "30", "--batch-size", "8", "--dim", "16",
+        "--hidden-per-chip", "8"])
+    ns = runpy.run_path("examples/jax_model_parallel.py")
+    losses = ns["main"]()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    out = capsys.readouterr().out
+    assert "sharded PartitionSpec(None, 'tp')" in out
+
+
 def test_word2vec_example(hvd, monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "x", "--steps", "30", "--vocab", "300", "--dim", "16",
