@@ -25,7 +25,6 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu import basics
 from horovod_tpu.parallel.mesh import build_mesh
 from horovod_tpu.parallel.tensor_parallel import (
     TPMlp, tp_abstract_params, tp_optimizer_specs, tp_spec_tree,
@@ -48,7 +47,7 @@ def main():
     if n % 2:
         raise SystemExit("needs an even number of chips (dp=2)")
     dp, tp = 2, n // 2
-    mesh = build_mesh(basics._require_init().topology, (dp, tp),
+    mesh = build_mesh(hvd.get_topology(), (dp, tp),
                       ("dp", "tp"))
     D = args.dim
     mlp = TPMlp(hidden=args.hidden_per_chip * tp, out=D, dtype=jnp.float32)

@@ -169,6 +169,13 @@ def ranks_mesh():
     return _require_init().mesh
 
 
+def get_topology():
+    """The resolved job topology snapshot — pass it to
+    :func:`horovod_tpu.parallel.mesh.build_mesh` to lay custom mesh shapes
+    (dp/tp/pp/sp/ep axes) over the participating chips."""
+    return _require_init().topology
+
+
 def controller():
     return _require_init().controller
 

@@ -68,12 +68,21 @@ class Attention(nn.Module):
         elif self.attn == "full":
             out = full_attention(q, k, v, causal=True)
         elif self.attn == "flash":
+            import math
+
             from horovod_tpu.ops.flash_attention import flash_attention
-            out = flash_attention(
-                q, k, v, causal=True,
-                # The Mosaic TPU kernel path needs a TPU backend; interpret
-                # mode keeps the model runnable (slowly) off-TPU for tests.
-                interpret=jax.default_backend() != "tpu")
+            blk = math.gcd(T, 128)
+            if blk >= 8:
+                out = flash_attention(
+                    q, k, v, causal=True, block_q=blk, block_k=blk,
+                    # The Mosaic TPU kernel path needs a TPU backend;
+                    # interpret mode keeps the model runnable (slowly)
+                    # off-TPU for tests.
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                # Sequence length doesn't tile the kernel's blocks — the
+                # dense path handles ragged lengths.
+                out = full_attention(q, k, v, causal=True)
         else:
             raise ValueError(f"unknown attention impl: {self.attn!r}")
         out = out.reshape(B, T, C)
@@ -86,12 +95,23 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     attn: str = "full"
     sp_axis: Any = RANKS_AXIS
+    tp_axis: Any = None
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         C = x.shape[-1]
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        if self.tp_axis:
+            # Megatron layout: heads and MLP hidden sharded over tp_axis,
+            # one psum per sub-block (see parallel/tensor_parallel.py).
+            from horovod_tpu.parallel.tensor_parallel import (
+                TPMlp, TPSelfAttention)
+            x = x + TPSelfAttention(self.num_heads, axis=self.tp_axis,
+                                    dtype=self.dtype, name="attn")(h)
+            h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+            return x + TPMlp(self.mlp_ratio * C, C, axis=self.tp_axis,
+                             dtype=self.dtype, name="mlp")(h)
         x = x + Attention(self.num_heads, self.attn, self.sp_axis,
                           self.dtype, name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
@@ -116,10 +136,18 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     attn: str = "full"
     sp_axis: Any = RANKS_AXIS
+    # Tensor parallelism: shard heads + MLP hidden over this mesh axis
+    # (Megatron layout); embeddings/head replicated.  Requires running
+    # inside shard_map with check_vma=True and attn="full".
+    tp_axis: Any = None
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, tokens):
+        if self.tp_axis and self.attn != "full":
+            raise ValueError(
+                "tp_axis composes with attn='full' only (TP attention "
+                f"computes the full sequence locally); got {self.attn!r}")
         B, T = tokens.shape
         if self.attn in ("full", "flash"):
             pos = jnp.arange(T)
@@ -135,7 +163,8 @@ class TransformerLM(nn.Module):
         x = tok_emb + pos_emb[None]
         for i in range(self.depth):
             x = Block(self.num_heads, attn=self.attn, sp_axis=self.sp_axis,
-                      dtype=self.dtype, name=f"block_{i}")(x)
+                      tp_axis=self.tp_axis, dtype=self.dtype,
+                      name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="head")(x)

@@ -39,7 +39,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+# Shared with the oracle/ring implementations so masking stays numerically
+# identical across all attention paths.
+from horovod_tpu.parallel.ring_attention import _NEG_BIG
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -147,7 +149,7 @@ def _bwd_xla(q, k, v, o, lse, do, *, scale, causal, chunk):
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)     # (BH, T)
     rows = jnp.arange(T)
 
-    def one_chunk(start):
+    def one_chunk(dq_acc, start):
         ks = lax.dynamic_slice_in_dim(kf, start, chunk, axis=1)
         vs = lax.dynamic_slice_in_dim(vf, start, chunk, axis=1)
         cols = start + jnp.arange(chunk)
@@ -160,15 +162,17 @@ def _bwd_xla(q, k, v, o, lse, do, *, scale, causal, chunk):
             p = jnp.where(mask[None], p, 0.0)
         dp = jnp.einsum("btd,bcd->btc", dof, vs)
         ds = p * (dp - delta[..., None]) * scale
-        dq_c = jnp.einsum("btc,bcd->btd", ds, ks)
+        # dq accumulates across chunks in the scan carry (keeping per-chunk
+        # dq stacked would be the O(T^2) buffer this path exists to avoid);
+        # dk/dv tile the T axis, so stacking them is linear.
+        dq_acc = dq_acc + jnp.einsum("btc,bcd->btd", ds, ks)
         dk_c = jnp.einsum("btc,btd->bcd", ds, qf)
         dv_c = jnp.einsum("btc,btd->bcd", p, dof)
-        return dq_c, dk_c, dv_c
+        return dq_acc, (dk_c, dv_c)
 
     starts = jnp.arange(0, T, chunk)
-    dq_chunks, dk_chunks, dv_chunks = lax.map(one_chunk, starts)
-    dq = jnp.sum(dq_chunks, axis=0)
-    # Chunk results are (n_chunks, BH, chunk, D); chunks tile the T axis.
+    dq, (dk_chunks, dv_chunks) = lax.scan(
+        one_chunk, jnp.zeros_like(qf), starts)
     dk = dk_chunks.transpose(1, 0, 2, 3).reshape(BH, T, D)
     dv = dv_chunks.transpose(1, 0, 2, 3).reshape(BH, T, D)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

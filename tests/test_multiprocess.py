@@ -111,7 +111,8 @@ def free_port():
     return port
 
 
-def launch(nprocs, ranks_per_proc=2, timeout=180, script=None):
+def launch(nprocs, ranks_per_proc=2, timeout=180, script=None,
+           extra_env=None):
     port = free_port()
     procs = []
     size = nprocs * ranks_per_proc
@@ -128,6 +129,7 @@ def launch(nprocs, ranks_per_proc=2, timeout=180, script=None):
             "XLA_FLAGS":
                 f"--xla_force_host_platform_device_count={ranks_per_proc}",
         })
+        env.update(extra_env or {})
         env.pop("HOROVOD_TPU_TIMELINE", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script or WORKER], env=env,
@@ -189,6 +191,44 @@ def test_three_processes_one_rank_each():
     for rc, out in outs:
         assert rc == 0, out
         assert "WORKER_OK" in out, out
+
+
+CRASH_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.process_index() == 1:
+        os._exit(42)      # hard crash: no shutdown handshake, socket drops
+
+    try:
+        hvd.allreduce(np.ones(4, np.float32), name="crash.ar")
+        raise AssertionError("expected CollectiveError after peer crash")
+    except hvd.CollectiveError as e:
+        print(f"CRASH_SURFACED: {str(e)[:80]}")
+    hvd.shutdown()        # must not hang after the failure
+    print("WORKER_OK rank=0")
+""")
+
+
+def test_peer_crash_fails_collectives_not_hangs():
+    """A peer dying without the shutdown handshake (reference: an MPI rank
+    crash) must surface as a CollectiveError on the survivors within the
+    control-plane timeout — never a silent hang (SURVEY §5.3)."""
+    outs = launch(nprocs=2, ranks_per_proc=1, script=CRASH_WORKER,
+                  timeout=120,
+                  extra_env={"HOROVOD_TPU_CONTROL_TIMEOUT_S": "5"})
+    rc0, out0 = outs[0]
+    rc1, _ = outs[1]
+    assert rc1 == 42                       # the simulated crash
+    assert rc0 == 0, out0                  # the survivor exits cleanly
+    assert "CRASH_SURFACED" in out0, out0
+    assert "WORKER_OK" in out0, out0
 
 
 def test_ring_data_plane_bandwidth():

@@ -73,6 +73,71 @@ def test_sp_loss_matches_full(hvd, attn):
     assert got == pytest.approx(want, rel=1e-4)
 
 
+def test_dp_tp_transformer_trains(hvd):
+    """Tensor-parallel TransformerLM on a (dp, tp) mesh: heads + MLP hidden
+    sharded, params materially distributed, full training step with
+    tp_value_and_grad; the per-block cross-shard math is oracle-tested in
+    test_tensor_parallel.py — here the composed model must learn."""
+    import optax
+
+    from horovod_tpu.parallel.tensor_parallel import (
+        tp_abstract_params, tp_optimizer_specs, tp_spec_tree,
+        tp_value_and_grad)
+
+    n = hvd.size()
+    if n % 2:
+        pytest.skip("needs an even device count")
+    dp, tp = 2, n // 2
+    mesh = build_mesh(basics._require_init().topology, (dp, tp),
+                      ("dp", "tp"))
+    heads = 2 * tp
+    model = TransformerLM(vocab=VOCAB, dim=heads * 8, depth=2,
+                          num_heads=heads, tp_axis="tp",
+                          dtype=jnp.float32)
+    tx = optax.adam(1e-2)
+    T = 8
+    tokens, labels = data(2 * dp, T, seed=7)
+
+    shapes = tp_abstract_params(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, T), jnp.int32))["params"], tp)
+    # Sanity: heads really shard — the qkv kernel is 1/tp wide per shard.
+    assert (shapes["block_0"]["attn"]["col_qkv"]["kernel"].shape[1]
+            == 3 * heads * 8 // tp)
+    pspecs = tp_spec_tree(shapes)
+    ospecs = tp_optimizer_specs(jax.eval_shape(tx.init, shapes),
+                                shapes, pspecs)
+
+    def init_body(x):
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        return params, tx.init(params)
+
+    def step_body(params, opt_state, toks, lbls):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, lbls).mean()
+        loss, grads = tp_value_and_grad(loss_fn, params, dp_axes=("dp",))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tok_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    lab_sh = jax.device_put(labels, NamedSharding(mesh, P("dp")))
+    params, opt_state = jax.jit(shard_map(
+        init_body, mesh=mesh, in_specs=(P("dp"),),
+        out_specs=(pspecs, ospecs), check_vma=True))(tok_sh)
+    step = jax.jit(shard_map(
+        step_body, mesh=mesh,
+        in_specs=(pspecs, ospecs, P("dp"), P("dp")),
+        out_specs=(pspecs, ospecs, P()), check_vma=True))
+    losses = []
+    for _ in range(15):
+        params, opt_state, loss = step(params, opt_state, tok_sh, lab_sh)
+        losses.append(float(np.asarray(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
 def test_dp_sp_train_step(hvd):
     """Full training step over a 2-D (dp, sp) mesh with ring attention:
     batch sharded on dp, sequence sharded on sp, grads reduced over both."""
