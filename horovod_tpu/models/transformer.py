@@ -68,10 +68,12 @@ class Attention(nn.Module):
         elif self.attn == "full":
             out = full_attention(q, k, v, causal=True)
         elif self.attn == "flash":
-            import math
-
             from horovod_tpu.ops.flash_attention import flash_attention
-            blk = math.gcd(T, 128)
+            # Largest divisor of T up to 128: keeps blocks near the MXU's
+            # native tile for any length that tiles at all (gcd(T, 128)
+            # would collapse to tiny blocks for e.g. T=1032).
+            blk = max((d for d in range(1, min(128, T) + 1) if T % d == 0),
+                      default=1)
             if blk >= 8:
                 out = flash_attention(
                     q, k, v, causal=True, block_q=blk, block_k=blk,

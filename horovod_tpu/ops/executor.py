@@ -230,12 +230,21 @@ class Executor:
         for e in entries:
             if self.timeline:
                 self.timeline.activity_start_all([e], "XLA_ALLGATHER")
-            parts = [np.asarray(a) for a in e.per_rank]
-            gathered = np.concatenate(parts, axis=0)
-            if _needs_host_path(gathered.dtype):
-                out = gathered
+            if (all(isinstance(a, jax.Array) for a in e.per_rank)
+                    and not _needs_host_path(e.per_rank[0].dtype)):
+                # Device-resident: concat on device, replicate — no host hop.
+                out = jax.device_put(
+                    jnp.concatenate(
+                        [self._mesh_safe(a) for a in e.per_rank], axis=0),
+                    _replicate_sharding(self.mesh))
             else:
-                out = jax.device_put(gathered, _replicate_sharding(self.mesh))
+                gathered = np.concatenate(
+                    [np.asarray(a) for a in e.per_rank], axis=0)
+                if _needs_host_path(gathered.dtype):
+                    out = gathered
+                else:
+                    out = jax.device_put(gathered,
+                                         _replicate_sharding(self.mesh))
             if self.timeline:
                 self.timeline.activity_end_all([e])
             e.callback(Status.OK(), out)
@@ -254,11 +263,18 @@ class Executor:
                 # be one of our ranks.
                 raise ValueError(
                     f"root rank {e.root_rank} not controlled by this process")
-            data = np.asarray(e.per_rank[root_local])
-            if _needs_host_path(data.dtype):
-                out = data.copy()
+            src = e.per_rank[root_local]
+            if (isinstance(src, jax.Array)
+                    and not _needs_host_path(src.dtype)):
+                # Device-resident: replicate straight from HBM.
+                out = jax.device_put(src, _replicate_sharding(self.mesh))
             else:
-                out = jax.device_put(data, _replicate_sharding(self.mesh))
+                data = np.asarray(src)
+                if _needs_host_path(data.dtype):
+                    out = data.copy()
+                else:
+                    out = jax.device_put(data,
+                                         _replicate_sharding(self.mesh))
             if self.timeline:
                 self.timeline.activity_end_all([e])
             e.callback(Status.OK(), out)

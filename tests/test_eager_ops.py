@@ -173,3 +173,33 @@ def test_duplicate_name_in_flight_error(hvd):
             assert "Duplicate tensor name" in str(e)
     finally:
         hvd2.synchronize(h1)
+
+
+def test_device_resident_contributions_stay_on_device(hvd):
+    """jax.Array contributions — including arrays committed to specific
+    devices — must flow through every collective without breaking, and
+    results come back as replicated jax.Arrays (the zero-host-copy
+    contract of the device data plane)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = hvd.size()
+    devs = jax.devices()
+    # allreduce of per-device committed arrays
+    vals = [jax.device_put(jnp.full((3,), float(r)), devs[r])
+            for r in range(n)]
+    out = hvd.allreduce(hvd.PerRank(vals), average=False, name="devres.ar")
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), sum(range(n)))
+    # ragged allgather of committed arrays
+    parts = [jax.device_put(jnp.full((1 + r % 2, 2), float(r)), devs[r])
+             for r in range(n)]
+    g = hvd.allgather(hvd.PerRank(parts), name="devres.ag")
+    assert isinstance(g, jax.Array)
+    assert g.shape == (sum(1 + r % 2 for r in range(n)), 2)
+    # broadcast from a committed non-coordinator root
+    b = hvd.broadcast(hvd.PerRank(vals), n - 1, name="devres.bc")
+    np.testing.assert_allclose(np.asarray(b), float(n - 1))
+    # results feed back in with zero resharding (mesh-replicated already)
+    out2 = hvd.allreduce(out, average=True, name="devres.again")
+    np.testing.assert_allclose(np.asarray(out2), sum(range(n)))
