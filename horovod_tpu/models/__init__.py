@@ -4,4 +4,5 @@ from horovod_tpu.models.mlp import MLP, ConvNet          # noqa: F401
 from horovod_tpu.models.resnet import (                   # noqa: F401
     ResNet, ResNet50, ResNet101, ResNet152,
 )
-from horovod_tpu.models.transformer import TransformerLM  # noqa: F401
+from horovod_tpu.models.transformer import (               # noqa: F401
+    BlockStack, TransformerLM)

@@ -125,6 +125,44 @@ class Block(nn.Module):
         return x + h
 
 
+def _apply_block_stack(x, *, num_heads, depth, mlp_ratio, attn, sp_axis,
+                       tp_axis, dtype):
+    """Run ``depth`` Blocks named ``block_{i}`` in the caller's flax scope
+    (shared by TransformerLM and BlockStack so their param trees agree)."""
+    for i in range(depth):
+        x = Block(num_heads, mlp_ratio=mlp_ratio, attn=attn,
+                  sp_axis=sp_axis, tp_axis=tp_axis, dtype=dtype,
+                  name=f"block_{i}")(x)
+    return x
+
+
+class BlockStack(nn.Module):
+    """``depth`` consecutive transformer blocks — ONE pipeline stage.
+
+    Activation-shape preserving, so it slots into
+    :func:`horovod_tpu.parallel.pipeline.pipeline_apply` as ``stage_fn``:
+    initialize per-stage params with ``stage_params_init``, keep the token
+    embedding and LM head outside the pipeline (replicated), and each
+    chip along ``pp`` runs its ``depth`` blocks.  See
+    ``examples/jax_pipeline_transformer.py`` for the full wiring.
+    """
+
+    num_heads: int
+    depth: int
+    mlp_ratio: int = 4
+    attn: str = "full"
+    sp_axis: Any = RANKS_AXIS
+    tp_axis: Any = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        return _apply_block_stack(
+            x, num_heads=self.num_heads, depth=self.depth,
+            mlp_ratio=self.mlp_ratio, attn=self.attn,
+            sp_axis=self.sp_axis, tp_axis=self.tp_axis, dtype=self.dtype)
+
+
 class TransformerLM(nn.Module):
     """Causal LM over token ids.
 
@@ -163,10 +201,10 @@ class TransformerLM(nn.Module):
         pos_emb = nn.Embed(self.max_len, self.dim, param_dtype=jnp.float32,
                            dtype=self.dtype, name="pos_emb")(pos)
         x = tok_emb + pos_emb[None]
-        for i in range(self.depth):
-            x = Block(self.num_heads, attn=self.attn, sp_axis=self.sp_axis,
-                      tp_axis=self.tp_axis, dtype=self.dtype,
-                      name=f"block_{i}")(x)
+        x = _apply_block_stack(
+            x, num_heads=self.num_heads, depth=self.depth, mlp_ratio=4,
+            attn=self.attn, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
+            dtype=self.dtype)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="head")(x)

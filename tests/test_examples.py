@@ -61,6 +61,16 @@ def test_model_parallel_example(hvd, monkeypatch, capsys):
     assert "sharded PartitionSpec(None, 'tp')" in out
 
 
+def test_pipeline_transformer_example(hvd, monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", ["x", "--steps", "25", "--dim", "16",
+                                      "--heads", "2", "--seq-len", "8"])
+    ns = runpy.run_path("examples/jax_pipeline_transformer.py")
+    losses = ns["main"]()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    out = capsys.readouterr().out
+    assert f"pipeline stages={hvd.size()}" in out
+
+
 def test_word2vec_example(hvd, monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "x", "--steps", "30", "--vocab", "300", "--dim", "16",
