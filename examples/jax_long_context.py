@@ -3,7 +3,8 @@
 No counterpart exists in the reference (it is data-parallel only,
 SURVEY §5.7) — this example shows the framework's long-context story: a
 causal transformer whose sequence dimension is sharded across the chip mesh,
-with attention running as a K/V ring over ICI (``--attn ring``) or via
+with attention running as a K/V ring over ICI (``--attn ring``, or
+``--attn ring_zigzag`` for the causal-load-balanced layout) or via
 all-to-all head re-sharding (``--attn ulysses``).
 
 Memory scaling: with ring attention, per-chip attention memory is
@@ -29,7 +30,8 @@ from horovod_tpu.models import TransformerLM
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    p.add_argument("--attn", default="ring",
+                   choices=["ring", "ring_zigzag", "ulysses"])
     p.add_argument("--seq-len", type=int, default=8192,
                    help="GLOBAL sequence length (sharded over chips)")
     p.add_argument("--batch-size", type=int, default=1,
@@ -72,14 +74,24 @@ def main():
 
     rng = np.random.RandomState(0)
     spec = NamedSharding(mesh, P(None, "ranks"))
+    if args.attn == "ring_zigzag":
+        # Zigzag layout: fixed host-side permutation of the sequence so
+        # each chip holds chunks (r, 2n-1-r) — the causal-balanced
+        # schedule (docs/long-context.md).  Labels permute identically,
+        # so the mean LM loss is unchanged.
+        from horovod_tpu.parallel.ring_attention import zigzag_indices
+        zz = zigzag_indices(n, args.seq_len)
     aux = {}
     t0 = time.perf_counter()
     for i in range(args.steps):
         toks = rng.randint(0, args.vocab,
                            (args.batch_size, args.seq_len + 1)).astype(
             np.int32)
-        tokens = jax.device_put(toks[:, :-1], spec)
-        labels = jax.device_put(toks[:, 1:], spec)
+        x, y = toks[:, :-1], toks[:, 1:]
+        if args.attn == "ring_zigzag":
+            x, y = x[:, zz], y[:, zz]
+        tokens = jax.device_put(x, spec)
+        labels = jax.device_put(y, spec)
         params, aux, opt_state, loss = fn(params, aux, opt_state,
                                           (tokens, labels))
         if hvd.rank() == 0 and i % 5 == 0:
