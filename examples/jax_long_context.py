@@ -10,8 +10,9 @@ all-to-all head re-sharding (``--attn ulysses``).
 Memory scaling: with ring attention, per-chip attention memory is
 O(T/n × T/n) per block, so context length scales linearly with chips.
 Ulysses keeps activations at O(T/n) but its default local kernel
-materializes full T×T logits for this rank's head subset — use it when
-heads ≥ chips and T is moderate, or plug a flash kernel via ``attn_fn``.
+materializes full T×T logits for this rank's head subset — use
+``--attn ulysses_flash`` to run the local attention through the Pallas
+flash kernel instead (linear memory, docs/long-context.md).
 """
 
 import argparse
@@ -31,7 +32,8 @@ from horovod_tpu.models import TransformerLM
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--attn", default="ring",
-                   choices=["ring", "ring_zigzag", "ulysses"])
+                   choices=["ring", "ring_zigzag", "ulysses",
+                            "ulysses_flash"])
     p.add_argument("--seq-len", type=int, default=8192,
                    help="GLOBAL sequence length (sharded over chips)")
     p.add_argument("--batch-size", type=int, default=1,
@@ -48,7 +50,7 @@ def main():
     n = hvd.size()
     mesh = hvd.ranks_mesh()
     assert args.seq_len % n == 0, "seq-len must divide across chips"
-    if args.attn == "ulysses":
+    if args.attn.startswith("ulysses"):
         assert args.heads % n == 0, "ulysses shards heads across chips"
 
     model = TransformerLM(

@@ -17,7 +17,9 @@ pre-norm blocks, and a pluggable attention implementation:
   :func:`~horovod_tpu.parallel.ring_attention.zigzag_indices`; ~2x faster
   causal hops),
 * ``attn="ulysses"``     — :func:`horovod_tpu.parallel.ulysses` (all-to-all
-  head/sequence re-shard).
+  head/sequence re-shard),
+* ``attn="ulysses_flash"`` — Ulysses with the Pallas flash kernel as the
+  local attention (linear memory for the full-sequence local compute).
 
 With ``attn != "full"`` the module must run inside shard_map with the
 sequence dimension sharded on ``sp_axis``; position embeddings are computed
@@ -34,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.ops.flash_attention import flash_attention_auto
 from horovod_tpu.parallel.mesh import RANKS_AXIS
 from horovod_tpu.parallel.ring_attention import (
     full_attention, ring_attention, zigzag_shard_positions)
@@ -68,23 +71,14 @@ class Attention(nn.Module):
         elif self.attn == "full":
             out = full_attention(q, k, v, causal=True)
         elif self.attn == "flash":
-            from horovod_tpu.ops.flash_attention import flash_attention
-            # Largest divisor of T up to 128: keeps blocks near the MXU's
-            # native tile for any length that tiles at all (gcd(T, 128)
-            # would collapse to tiny blocks for e.g. T=1032).
-            blk = max((d for d in range(1, min(128, T) + 1) if T % d == 0),
-                      default=1)
-            if blk >= 8:
-                out = flash_attention(
-                    q, k, v, causal=True, block_q=blk, block_k=blk,
-                    # The Mosaic TPU kernel path needs a TPU backend;
-                    # interpret mode keeps the model runnable (slowly)
-                    # off-TPU for tests.
-                    interpret=jax.default_backend() != "tpu")
-            else:
-                # Sequence length doesn't tile the kernel's blocks — the
-                # dense path handles ragged lengths.
-                out = full_attention(q, k, v, causal=True)
+            out = flash_attention_auto(q, k, v, causal=True)
+        elif self.attn == "ulysses_flash":
+            # Ulysses re-shard with the Pallas kernel as the local
+            # attention — linear memory for the full-sequence local
+            # compute instead of the dense (T, T) logits.
+            out = ulysses_attention(q, k, v, axis_name=self.sp_axis,
+                                    causal=True,
+                                    attn_fn=flash_attention_auto)
         else:
             raise ValueError(f"unknown attention impl: {self.attn!r}")
         out = out.reshape(B, T, C)

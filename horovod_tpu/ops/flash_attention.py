@@ -41,7 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Shared with the oracle/ring implementations so masking stays numerically
 # identical across all attention paths.
-from horovod_tpu.parallel.ring_attention import _NEG_BIG
+from horovod_tpu.parallel.ring_attention import _NEG_BIG, full_attention
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -198,6 +198,45 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def auto_block(T: int) -> int:
+    """Largest TPU-tileable flash block for sequence length ``T``: ``T``
+    itself when one block covers the array, else the largest
+    multiple-of-8 divisor of ``T`` up to 128 (Mosaic requires interior
+    blocks' sublane dim divisible by 8).  0 = cannot tile."""
+    if T <= 128:
+        return T
+    return max((d for d in range(8, 129, 8) if T % d == 0), default=0)
+
+
+def flash_attention_auto(q, k, v, *, causal: bool = True,
+                         scale: Optional[float] = None):
+    """:func:`flash_attention` with automatic block sizing and fallbacks —
+    the drop-in local attention kernel for models and for
+    ``ulysses_attention(attn_fn=...)``.
+
+    Block size from :func:`auto_block`; sequences that cannot tile fall
+    back to the dense path **with a warning** — the dense buffer is
+    O(T^2), which at long-context lengths defeats the point of the
+    kernel, so the caller should pad/trim to a tileable length.  Off-TPU
+    the kernel runs in interpret mode so callers stay hermetic.
+    """
+    import warnings
+
+    T = q.shape[1]
+    blk = auto_block(T)
+    if blk == 0:
+        warnings.warn(
+            f"flash_attention_auto: sequence length {T} has no "
+            "multiple-of-8 block divisor <= 128; falling back to dense "
+            "attention with an O(T^2) logits buffer. Pad or trim the "
+            "sequence to a tileable length for the flash kernel.",
+            RuntimeWarning, stacklevel=2)
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           block_q=blk, block_k=blk,
+                           interpret=jax.default_backend() != "tpu")
 
 
 def flash_attention(q, k, v, *, causal: bool = True,

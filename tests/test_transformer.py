@@ -34,12 +34,13 @@ def loss_of(model, params, tokens, labels):
         logits, labels).mean()
 
 
-@pytest.mark.parametrize("attn", ["ring", "ring_zigzag", "ulysses"])
+@pytest.mark.parametrize("attn", ["ring", "ring_zigzag", "ulysses",
+                                  "ulysses_flash"])
 def test_sp_loss_matches_full(hvd, attn):
     """Same params, same tokens: sequence-parallel loss == full loss."""
     n = hvd.size()
     # Ulysses shards heads across ranks, so it needs heads % ranks == 0.
-    heads = n if attn == "ulysses" else HEADS
+    heads = n if attn.startswith("ulysses") else HEADS
     model_full = TransformerLM(vocab=VOCAB, dim=DIM * 2, depth=DEPTH,
                                num_heads=heads, attn="full",
                                dtype=jnp.float32)
