@@ -93,3 +93,35 @@ class TestTransformerFlash:
         got = flash.apply({"params": params}, toks)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestAutoBlock:
+    def test_block_selection(self):
+        from horovod_tpu.ops.flash_attention import auto_block
+
+        # One block covers short sequences regardless of alignment.
+        assert auto_block(6) == 6
+        assert auto_block(127) == 127
+        assert auto_block(128) == 128
+        # Longer: largest multiple-of-8 divisor up to 128 (Mosaic sublane
+        # tiling), never an unaligned divisor like 125 or 43.
+        assert auto_block(2048) == 128
+        assert auto_block(1000) == 40
+        assert auto_block(1032) == 24
+        # Untileable lengths report 0.
+        assert auto_block(9998) == 0
+
+    def test_untileable_warns_and_matches_dense(self, hvd):
+        import warnings
+
+        from horovod_tpu.ops.flash_attention import flash_attention_auto
+
+        q, k, v = make_qkv(jax.random.PRNGKey(9), 1, 254, 1, 4)  # 2*127
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            got = flash_attention_auto(q, k, v, causal=True)
+        assert any("falling back to dense" in str(w.message)
+                   for w in caught)
+        want = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
