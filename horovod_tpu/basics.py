@@ -14,7 +14,6 @@ runtime via JAX (see :mod:`horovod_tpu.topology`).  The background controller
 from __future__ import annotations
 
 import atexit
-import os
 import threading
 from typing import Optional, Sequence
 
@@ -68,24 +67,16 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         if _state.initialized:
             return
         _state.topology = _topology_mod.resolve(ranks)
-        # Multi-controller pods need the TCP control plane for the eager
-        # (negotiated) API: without it each process only submits its local
-        # ranks' requests while size() spans the whole pod, so negotiation
-        # can never complete — a silent 60s-stall deadlock.  Fail fast
-        # instead (the reference got its control plane for free from
-        # ``mpirun``; ``operations.cc:1469-1532``).
-        if (_state.topology.process_count > 1
-                and not os.environ.get("HOROVOD_TPU_COORD_ADDR")):
-            n_proc = _state.topology.process_count
-            _state.topology = None
-            raise RuntimeError(
-                f"horovod_tpu: this job spans {n_proc} processes but no "
-                "control plane is configured, so eager collectives would "
-                "deadlock. Launch with `python -m horovod_tpu.run -np <N> "
-                "...` (which wires the control plane automatically) or "
-                "export HOROVOD_TPU_COORD_ADDR=<host>:<port> plus "
-                "HOROVOD_TPU_{SIZE,RANK,PROCESS_INDEX,PROCESS_COUNT} on "
-                "every process; see docs/running.md.")
+        # Multi-controller pod without a TCP control plane: the in-jit SPMD
+        # path (make_train_step, injit ops, the global mesh) needs no
+        # negotiation at all — XLA's runtime carries the collectives — so
+        # init() succeeds and only the *eager* (negotiated) API is gated:
+        # its first call fails fast with a clear error instead of the
+        # silent 60 s stall-deadlock it would otherwise hit (each process
+        # would submit only its local ranks' requests while size() spans
+        # the whole pod).  The reference initializes unconditionally under
+        # its launcher (``operations.cc:1435-1532``); the control plane is
+        # likewise never optional-but-blocking here.
         from horovod_tpu.parallel import mesh as _mesh_mod
         _state.mesh = _mesh_mod.build_ranks_mesh(_state.topology)
         from horovod_tpu import core as _core_mod

@@ -479,6 +479,12 @@ class Controller:
         self.host_local_rank: Optional[int] = None
         self.host_local_size: Optional[int] = None
         coord_addr = os.environ.get("HOROVOD_TPU_COORD_ADDR", "")
+        # Multi-controller pod with no control plane configured: jit-only
+        # mode.  The SPMD path needs no negotiation (XLA's runtime carries
+        # the in-jit collectives); the eager API is unavailable and fails
+        # fast at enqueue() instead of stall-deadlocking (each process
+        # would submit only its local ranks while `size` spans the pod).
+        self.jit_only = topology.process_count > 1 and not coord_addr
         if coord_addr and topology.process_count > 1:
             if not self._use_cpp:
                 raise RuntimeError(
@@ -492,13 +498,15 @@ class Controller:
                 host or "127.0.0.1", int(port), topology.rank,
                 topology.size, timeout_ms)
             # Exchange the process layout once: (process_index, first_rank,
-            # local_size, hostname) per process -> global rank->process map
-            # plus host grouping (the reference gets both from MPI comm
-            # splits, operations.cc:1499-1532; hostname equality is the
-            # TPU-native stand-in for MPI_Comm_split_type(SHARED)).
-            import socket
+            # local_size, host fingerprint) per process -> global
+            # rank->process map plus host grouping (the reference gets both
+            # from MPI comm splits, operations.cc:1499-1532; boot-id
+            # fingerprint equality is the TPU-native stand-in for
+            # MPI_Comm_split_type(SHARED) — hostname alone is ambiguous,
+            # see topology.host_fingerprint).
             import struct
-            my_host = socket.gethostname().encode()[:64]
+            from horovod_tpu.topology import host_fingerprint
+            my_host = host_fingerprint(warn_truncation=True).encode()[:64]
             mine = struct.pack("<3i64s", topology.process_index,
                                topology.rank, topology.local_size, my_host)
             blob = self._control.allgather(mine)
@@ -511,6 +519,56 @@ class Controller:
                 if host.rstrip(b"\0") == my_host.rstrip(b"\0"):
                     host_procs.append(pidx)
             host_procs.sort()
+            self.host_local_rank = host_procs.index(topology.process_index)
+            self.host_local_size = len(host_procs)
+        elif self.jit_only:
+            # Host grouping without a control plane: the only cross-process
+            # channel in jit-only mode is XLA itself, so allgather each
+            # process's host-fingerprint hash over the pod runtime.  Without
+            # this, every co-located process would silently report
+            # local_rank() == 0 and collide on per-host work (the reference
+            # gets the grouping from MPI_Comm_split_type(SHARED)).
+            import hashlib
+            from jax.experimental import multihost_utils
+            from horovod_tpu.topology import host_fingerprint
+            digest = hashlib.sha256(host_fingerprint().encode()).digest()
+            mine = np.concatenate([
+                np.asarray([topology.process_index], np.uint32),
+                np.frombuffer(digest[:8], np.uint32)])
+            # Bounded like the control-plane exchange: if a peer never
+            # reaches init() (crash, rank-subset mismatch) the collective
+            # would otherwise hang every healthy process forever with no
+            # diagnostic.  The watchdog thread is leaked on timeout — the
+            # process is about to raise out of init() anyway.
+            timeout_s = float(os.environ.get(
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S", "60"))
+            result: list = []
+
+            def _gather():
+                try:
+                    result.append(("ok", np.asarray(
+                        multihost_utils.process_allgather(mine))))
+                except BaseException as exc:   # noqa: BLE001 — re-raised
+                    result.append(("err", exc))
+
+            th = threading.Thread(target=_gather, daemon=True,
+                                  name="horovod_tpu-host-discovery")
+            th.start()
+            th.join(timeout_s)
+            if not result:
+                raise RuntimeError(
+                    f"horovod_tpu: host-grouping allgather did not complete "
+                    f"within {timeout_s:.0f}s — some process in this "
+                    f"{topology.process_count}-process job never reached "
+                    "hvd.init() (init is collective across processes). "
+                    "Raise HOROVOD_TPU_CONTROL_TIMEOUT_S if startup is "
+                    "legitimately slow.")
+            if result[0][0] == "err":
+                raise result[0][1]
+            rows = result[0][1]
+            host_procs = sorted(
+                int(r[0]) for r in rows
+                if r[1] == mine[1] and r[2] == mine[2])
             self.host_local_rank = host_procs.index(topology.process_index)
             self.host_local_size = len(host_procs)
 
@@ -550,6 +608,10 @@ class Controller:
     # ------------------------------------------------------------------ API
 
     def start(self):
+        if self.jit_only:
+            # No negotiation to run: the background tick loop exists only
+            # for the eager data plane, which is gated off in this mode.
+            return
         self._thread = threading.Thread(
             target=self._background_loop, name="horovod_tpu-controller",
             daemon=True)
@@ -586,6 +648,18 @@ class Controller:
         """Framework-thread side: register tensor data and queue one request
         per controlled rank (reference ``EnqueueTensorAllreduce`` et al.,
         ``operations.cc:2025-2141``)."""
+        if self.jit_only:
+            return Status.precondition_error(
+                f"horovod_tpu: eager collective '{entry.name}' needs the "
+                f"TCP control plane, but this job spans "
+                f"{self.topology.process_count} processes with none "
+                "configured (jit-only mode). The in-jit SPMD path "
+                "(make_train_step, horovod_tpu.ops.injit, the global mesh) "
+                "works without it. For eager collectives, launch with "
+                "`python -m horovod_tpu.run -np <N> ...` or export "
+                "HOROVOD_TPU_COORD_ADDR=<host>:<port> plus "
+                "HOROVOD_TPU_{SIZE,RANK,PROCESS_INDEX,PROCESS_COUNT} on "
+                "every process; see docs/running.md.")
         first_rank = self.topology.rank
         requests = []
         for i, contrib in enumerate(entry.per_rank):

@@ -101,6 +101,41 @@ class Topology:
         raise KeyError(f"device {device} not in topology")
 
 
+def host_fingerprint(warn_truncation: bool = False) -> str:
+    """Host-unique identity for grouping processes by physical host — the
+    stand-in for the reference's ``MPI_Comm_split_type(SHARED)``
+    (``operations.cc:1499-1509``).
+
+    Hostname alone is ambiguous both ways: two hosts can collide on a
+    64-byte truncated name, and containers on one host can carry distinct
+    names while sharing the hardware.  The kernel boot id is unique per
+    booted host and shared by every container on it, so when readable it
+    IS the fingerprint (the hostname must not participate in the equality,
+    or distinct-named co-located containers split into separate groups).
+
+    ``warn_truncation``: set by callers that compare only the first 64
+    bytes (the control-plane wire field); the hash-based jit-only path
+    compares the full string and has no truncation risk.
+    """
+    import socket
+    import warnings
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = ""
+    if boot:
+        return boot
+    name = socket.gethostname()
+    if warn_truncation and len(name.encode()) > 64:
+        warnings.warn(
+            "horovod_tpu: hostname exceeds the 64-byte host-grouping field "
+            "and /proc/sys/kernel/random/boot_id is unreadable; hosts "
+            "sharing this 64-byte name prefix would be grouped as one host "
+            "(wrong local_rank/local_size).", RuntimeWarning, stacklevel=2)
+    return name
+
+
 def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
     """Resolve the job topology from the JAX runtime.
 

@@ -1,0 +1,105 @@
+"""Worker for the multi-controller SPMD test (launched as a subprocess).
+
+Usage: python _multicontroller_worker.py <process_id> <num_processes> <port>
+
+``process_id == -1`` runs the single-process baseline (same 4-device job,
+no jax.distributed); otherwise the worker joins a real
+``jax.distributed.initialize`` job — the CPU stand-in for a multi-controller
+TPU pod — and must be able to ``hvd.init()`` and train over the global mesh
+WITHOUT any control-plane env (the jit-only path; the reference initializes
+unconditionally under its launcher, ``operations.cc:1435-1532``).
+
+Prints ``LOSS <repr>`` per step and ``EAGER_GATED OK`` when the eager API
+fails fast with the jit-only error.
+"""
+
+import os
+import sys
+
+process_id = int(sys.argv[1])
+num_processes = int(sys.argv[2])
+port = int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("HOROVOD_TPU_COORD_ADDR", None)
+devices_per_proc = 4 if process_id < 0 else 4 // num_processes
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={devices_per_proc}")
+os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if process_id >= 0:
+    jax.distributed.initialize(f"127.0.0.1:{port}",
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.jax.spmd import make_train_step  # noqa: E402
+
+hvd.init()
+assert hvd.size() == 4, hvd.size()
+if process_id >= 0:
+    assert hvd.process_count() == num_processes
+    assert hvd.rank() == process_id * devices_per_proc
+    # Host grouping is discovered via the XLA-allgathered host fingerprint
+    # even without a control plane: both workers run on this host, so
+    # local_rank must be the index among them, not a silent 0.
+    assert hvd.local_rank() == process_id, hvd.local_rank()
+
+mesh = hvd.ranks_mesh()
+
+# Deterministic toy regression problem, identical on every process.
+rng = np.random.RandomState(0)
+W_TRUE = rng.randn(8, 1).astype(np.float32)
+X = rng.randn(16, 8).astype(np.float32)
+Y = X @ W_TRUE
+params = {"w": jnp.zeros((8, 1), jnp.float32),
+          "b": jnp.zeros((1,), jnp.float32)}
+
+
+def loss_fn(params, aux, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2), aux
+
+
+tx = optax.sgd(0.1)
+opt_state = tx.init(params)
+step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
+
+sharding = NamedSharding(mesh, P("ranks"))
+if process_id >= 0:
+    # Each process contributes only its local rows of the global batch —
+    # the multi-controller input-pipeline contract.
+    rows = 16 // 4 * devices_per_proc
+    lo = process_id * rows
+    x = jax.make_array_from_process_local_data(sharding, X[lo:lo + rows])
+    y = jax.make_array_from_process_local_data(sharding, Y[lo:lo + rows])
+else:
+    x = jax.device_put(X, sharding)
+    y = jax.device_put(Y, sharding)
+
+aux = {}
+for _ in range(5):
+    params, aux, opt_state, loss = step(params, aux, opt_state, (x, y))
+    print(f"LOSS {float(loss)!r}", flush=True)
+
+if process_id >= 0:
+    # The eager (negotiated) API must fail fast with the jit-only error,
+    # not stall: no control plane is configured on this 2-process job.
+    from horovod_tpu.ops import eager
+
+    try:
+        eager.allreduce(np.ones(4, np.float32), name="gated")
+    except eager.CollectiveError as exc:
+        assert "jit-only" in str(exc), str(exc)
+        print("EAGER_GATED OK", flush=True)
+
+print("DONE", flush=True)
