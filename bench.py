@@ -122,7 +122,9 @@ def main():
 
     tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
-    step = make_train_step(loss_fn, tx, mesh, sync_aux_state=(nchips > 1))
+    # batch_stats are computed per-shard from the micro-batch, so they must
+    # be synced (on one chip the pmean over a size-1 axis is free in XLA).
+    step = make_train_step(loss_fn, tx, mesh, sync_aux_state=True)
 
     data = (images, labels)   # already mesh-sharded
     for _ in range(warmup_iters):

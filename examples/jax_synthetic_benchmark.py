@@ -72,8 +72,13 @@ def main():
         from jax import shard_map
 
         def step_body(params, batch_stats, opt_state, data):
+            # Varying view of the params so the cotangents are raw
+            # per-shard gradients (see make_train_step); the explicit
+            # per-tensor pmean below is then the mean, not a double-sum.
+            from horovod_tpu.parallel._vma import ensure_varying_tree
+            params_v = ensure_varying_tree(params, ("ranks",))
             (loss, new_bs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch_stats, data)
+                loss_fn, has_aux=True)(params_v, batch_stats, data)
             leaves, treedef = jax.tree.flatten(grads)
             reduced = []
             for leaf in leaves:
@@ -83,15 +88,16 @@ def main():
             grads = jax.tree.unflatten(treedef, reduced)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            new_bs = jax.tree.map(lambda a: lax.pmean(a, "ranks"), new_bs)
             return params, new_bs, opt_state, lax.pmean(loss, "ranks")
 
         step = jax.jit(shard_map(
             step_body, mesh=mesh,
             in_specs=(P(), P(), P(), P("ranks")),
-            out_specs=(P(), P(), P(), P()), check_vma=False),
+            out_specs=(P(), P(), P(), P()), check_vma=True),
             donate_argnums=(0, 1, 2))
     else:
-        step = make_train_step(loss_fn, tx, mesh, sync_aux_state=(n > 1))
+        step = make_train_step(loss_fn, tx, mesh, sync_aux_state=True)
 
     data = shard_batch((images, labels), mesh)
 

@@ -108,6 +108,11 @@ def main():
         emb_out = sparse.apply_indexed_slices(emb_out, g_out_neg, scale=-lr)
         return emb_in, emb_out, lax.pmean(loss, "ranks")
 
+    # check_vma=False is deliberate here: the sparse path allgathers
+    # (rows, indices) and scatter-adds the identical gathered data on every
+    # rank, so the embedding update is invariant by construction — but an
+    # all_gather output is *tracked* varying, which the checker cannot see
+    # past.  The dense training paths all run checked (make_train_step).
     step = jax.jit(shard_map(
         step_body, mesh=mesh,
         in_specs=(P(), P(), P("ranks"), P("ranks"), P("ranks")),

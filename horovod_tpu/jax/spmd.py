@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from horovod_tpu.compression import Compressor, NoneCompressor
+from horovod_tpu.parallel._vma import ensure_varying_tree
 from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
 from horovod_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS, RANKS_AXIS
 
@@ -88,19 +89,20 @@ def make_train_step(
     axes = tuple(mesh.axis_names)
 
     def spmd_body(params, aux_state, opt_state, batch):
+        # Differentiate w.r.t. a VMA-varying view of the params: the
+        # cotangents are then the raw *per-shard* gradients, which the
+        # explicit reduce below averages with the chosen algorithm and
+        # wire compression.  (Differentiating the invariant params instead
+        # would make jax insert its own transpose-psum, pre-summing the
+        # gradients and bypassing both knobs.)
+        params_v = ensure_varying_tree(params, axes)
         (loss, new_aux), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, aux_state, batch)
+            loss_fn, has_aux=True)(params_v, aux_state, batch)
         grads = reduce_gradients(grads, axes, average=average,
                                  compression=compression)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        if sync_aux_state:
-            # Cross-replica sync of running statistics (each shard saw a
-            # different micro-batch); float leaves only.
-            new_aux = jax.tree.map(
-                lambda a: lax.pmean(a, axes)
-                if jnp.issubdtype(jnp.result_type(a), jnp.floating) else a,
-                new_aux)
+        new_aux = _sync_or_check_aux(new_aux, axes, sync_aux_state)
         loss = lax.pmean(loss, axes)
         return params, new_aux, opt_state, loss
 
@@ -111,10 +113,43 @@ def make_train_step(
         spmd_body, mesh=mesh,
         in_specs=(replicated, replicated, replicated, batch_spec),
         out_specs=(replicated, replicated, replicated, replicated),
-        check_vma=False,
+        check_vma=True,
     )
     donate_argnums = (0, 1, 2) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def _sync_or_check_aux(new_aux, axes, sync_aux_state: bool):
+    """Make the returned aux state provably replicated.
+
+    ``sync_aux_state=True``: cross-replica sync of running statistics
+    (each shard saw a different micro-batch) — float leaves are averaged,
+    non-float leaves (step counters etc., identical by construction) are
+    unified with a max.  ``False``: leaves must already be invariant over
+    the mesh (untouched pass-throughs of the input state); a varying leaf
+    means the model actually updates it per-shard, which would silently
+    diverge — raise at trace time instead.
+    """
+    import jax.tree_util as jtu
+
+    if sync_aux_state:
+        return jax.tree.map(
+            lambda a: lax.pmean(a, axes)
+            if jnp.issubdtype(jnp.result_type(a), jnp.floating)
+            else lax.pmax(a, axes),
+            new_aux)
+
+    def check(path, a):
+        if getattr(jax.typeof(a), "vma", frozenset()):
+            raise ValueError(
+                f"make_train_step(sync_aux_state=False): aux state leaf "
+                f"'{jtu.keystr(path)}' varies across mesh shards (each "
+                "shard computed a different value from its micro-batch). "
+                "Pass sync_aux_state=True to average it across ranks, or "
+                "reduce it inside loss_fn.")
+        return a
+
+    return jtu.tree_map_with_path(check, new_aux)
 
 
 def make_eval_step(apply_fn: Callable, mesh: Mesh):
@@ -129,7 +164,7 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh):
     step = shard_map(
         spmd_body, mesh=mesh,
         in_specs=(P(), P(), P(axes)), out_specs=P(),
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(step)
 

@@ -24,14 +24,18 @@ def _to_varying(v, axis: str):
         return lax.pvary(v, axis)
 
 
-def ensure_varying(v, axis: str):
-    """Mark ``v`` varying over manual ``axis`` if it isn't already."""
-    if axis not in getattr(jax.typeof(v), "vma", frozenset()):
-        v = _to_varying(v, axis)
+def ensure_varying(v, axis):
+    """Mark ``v`` varying over manual ``axis`` (a name or tuple of names)
+    if it isn't already."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    vma = getattr(jax.typeof(v), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in vma)
+    if missing:
+        v = _to_varying(v, missing if len(missing) > 1 else missing[0])
     return v
 
 
-def ensure_varying_tree(tree, axis: str):
+def ensure_varying_tree(tree, axis):
     """:func:`ensure_varying` over every leaf of a pytree."""
     return jax.tree.map(lambda v: ensure_varying(v, axis), tree)
 

@@ -26,6 +26,7 @@ and this is opt-in for multi-slice meshes, exactly as
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -51,8 +52,21 @@ def hierarchical_allreduce(x, *, average: bool = False,
     # Tier 2: each ICI position reduces its shard across slices in parallel —
     # DCN carries 1/ici_size of the payload, the reference's key trick.
     shard = lax.psum(shard, dcn_axis)
-    # Tier 3: allgather the reduced shards back across ICI.
-    full = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    # Tier 3: gather the reduced shards back across ICI.  Under
+    # check_vma=True an all_gather output is tracked as varying over the
+    # gathered axis, which would poison every downstream out_spec; psum of
+    # the shard placed at its own offset in a zero buffer produces the
+    # identical value with a provably-invariant type.  (XLA's allreduce
+    # moves ~2x an allgather's ICI bytes, the price of the static
+    # invariance proof; the unchecked path keeps the cheaper all_gather.)
+    if getattr(jax.typeof(shard), "vma", frozenset()):
+        shard_len = padded // n_ici
+        placed = lax.dynamic_update_slice(
+            jnp.zeros((padded,), shard.dtype), shard,
+            (lax.axis_index(ici_axis) * shard_len,))
+        full = lax.psum(placed, ici_axis)
+    else:
+        full = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
     out = full[:size].reshape(x.shape)
     if average:
         out = out / (lax.axis_size(ici_axis) * lax.axis_size(dcn_axis))

@@ -168,9 +168,19 @@ def _ring_scan(q, k, v, axis_name, hop):
             lambda x: lax.ppermute(x, axis_name, perm=perm), kv)
         return o, new_m, l, kv
 
+    # Constant inits carry no data dependence on the shard index, so VMA
+    # tracking (check_vma=True) classifies them invariant while the loop
+    # body produces varying values — the carry types would mismatch.  Cast
+    # them to the axes the inputs actually vary over (no-op when unchecked).
+    vma = (getattr(jax.typeof(q), "vma", frozenset())
+           | getattr(jax.typeof(k), "vma", frozenset())
+           | getattr(jax.typeof(v), "vma", frozenset()))
     o0 = jnp.zeros((B, T, H, D), jnp.float32)
     m0 = jnp.full((B, H, T), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
+    if vma:
+        from horovod_tpu.parallel._vma import ensure_varying
+        o0, m0, l0 = (ensure_varying(a, tuple(vma)) for a in (o0, m0, l0))
     o, m, l, _ = lax.fori_loop(0, n, body, (o0, m0, l0, (k, v)))
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]

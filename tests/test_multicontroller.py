@@ -41,20 +41,28 @@ def _losses(out: str):
     return [float(m.group(1)) for m in re.finditer(r"LOSS (\S+)", out)]
 
 
-@pytest.mark.timeout(300)
 def test_two_process_spmd_matches_single_process():
     """2-process jax.distributed job: init() with no control-plane env,
     train over the global mesh, loss parity with single-process."""
     port = _free_port()
     procs = [_run_worker(i, 2, port) for i in range(2)]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:   # a wedged rendezvous must not leak live workers
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
         assert "DONE" in out, out
         assert "EAGER_GATED OK" in out, out
 
     single = _run_worker(-1, 1, port)
-    base_out = single.communicate(timeout=240)[0]
+    try:
+        base_out = single.communicate(timeout=240)[0]
+    finally:
+        if single.poll() is None:
+            single.kill()
     assert single.returncode == 0, base_out
     base = _losses(base_out)
     assert len(base) == 5 and base[-1] < base[0], base_out
