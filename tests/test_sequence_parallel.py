@@ -173,12 +173,14 @@ class TestZigzagRingAttention:
                 samples.append(time.perf_counter() - t0)
             # Best-of-N: the min is robust to scheduler noise.
             clock[layout] = min(samples)
-        print(f"ring-attention A/B: {clock} "
-              f"(zigzag/contiguous = "
-              f"{clock['zigzag'] / clock['contiguous']:.2f})")
-        # Real speedup is ~1.5x; the bound only has to catch a regression
-        # to "no better than contiguous", with slack for a loaded host.
-        assert clock["zigzag"] <= clock["contiguous"] * 1.25, clock
+        ratio = clock["zigzag"] / clock["contiguous"]
+        print(f"ring-attention A/B: {clock} (zigzag/contiguous = "
+              f"{ratio:.2f})")
+        # Report-only (advisor r2): wall-clock ratios on a shared CI host
+        # flake under concurrent load no matter how loose the bound — the
+        # correctness of both layouts is asserted by the parity tests
+        # above; the ratio is printed for humans and benchmarked for real
+        # on hardware in docs/benchmarks.md.
 
 
 class TestUlysses:
