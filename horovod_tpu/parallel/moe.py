@@ -6,8 +6,9 @@ token is routed to one expert.  The TPU-first realization runs inside
 ``shard_map`` with tokens sharded over ``ep`` (data parallel within the
 expert group):
 
-* the router is a small replicated dense — top-1 expert + gate probability
-  per token (Switch Transformer routing);
+* the router is a small replicated dense — top-1 (Switch) or top-k
+  (GShard-style, renormalized combined gates) expert choice per token,
+  with an optional ST-MoE router z-loss;
 * dispatch is pure matmul: a ``(tokens, E, capacity)`` one-hot dispatch
   tensor built from a cumulative-sum position assignment — einsums instead
   of scatters, so everything lands on the MXU with static shapes;
@@ -17,9 +18,11 @@ expert group):
   dispatch tensor combines them (weighted by the gate).
 
 Tokens over capacity are dropped (pass through the residual only) — the
-Switch behaviour; size capacity with ``capacity_factor``.  The router's
-load-balancing auxiliary loss (Switch eq. 4: ``E * Σ_e f_e · p_e``) is
-returned alongside the output; add ``aux_weight * aux`` to the loss.
+Switch behaviour, with first choices claiming slots before second
+choices; size capacity with ``capacity_factor``.  The router's
+load-balancing auxiliary loss (Switch eq. 4: ``E * Σ_e f_e · p_e``) plus
+the weighted z-loss is returned alongside the output; add
+``aux_weight * aux`` to the loss.
 
 Training runs under ``shard_map(..., check_vma=True)`` like the other
 model-parallel modules; expert params are VMA-varying over ``ep``.
@@ -40,17 +43,30 @@ EP_AXIS = "ep"
 
 
 class MoELayer(nn.Module):
-    """Top-1 (Switch) MoE feed-forward, one expert per ``axis`` shard.
+    """Top-k MoE feed-forward, one expert per ``axis`` shard.
+
+    ``top_k=1`` is Switch routing (raw gate probability weighting);
+    ``top_k>=2`` is GShard-style: each token goes to its k best experts
+    with the combined gates renormalized over the chosen k.  Capacity is
+    assigned with choice priority — every first choice claims its slot
+    before any second choice — so under pressure second choices drop
+    first.
 
     Input ``(tokens_local, d)`` — this shard's tokens, sharded over
-    ``axis``.  Returns ``(output, aux_loss)``: output ``(tokens_local, d)``
-    (zero rows for dropped tokens — callers keep the residual connection),
-    aux_loss the scalar Switch load-balancing loss for this shard's tokens.
+    ``axis``.  Returns ``(output, aux_loss)``: output ``(tokens_local,
+    d)`` (zero rows for fully-dropped tokens — callers keep the residual
+    connection), aux_loss the scalar per-shard auxiliary loss: the Switch
+    load-balancing term plus ``router_z_weight`` times the router z-loss
+    ``mean(logsumexp(logits)^2)`` (ST-MoE, keeps router logits from
+    drifting into bf16-unfriendly magnitudes).  The components are also
+    ``sow``n as intermediates ``aux_load_balance`` / ``aux_router_z``.
     """
 
     hidden: int
     capacity_factor: float = 1.25
     axis: str = EP_AXIS
+    top_k: int = 1
+    router_z_weight: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -59,25 +75,48 @@ class MoELayer(nn.Module):
         E = lax.axis_size(self.axis)
         T, d = x.shape
         C = max(1, int(self.capacity_factor * T / E))
+        if not 1 <= self.top_k <= E:
+            raise ValueError(f"top_k={self.top_k} out of range for {E} "
+                             "experts")
 
-        # Router (replicated params): top-1 expert and gate prob per token.
+        # Router (replicated params): per-token expert scores.
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           param_dtype=self.param_dtype,
                           name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)           # (T, E)
-        gate = probs.max(axis=-1)                         # (T,)
-        expert = probs.argmax(axis=-1)                    # (T,)
 
-        # Position of each token within its expert's capacity; tokens past
-        # capacity are dropped (Switch semantics).
-        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # (T, E)
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot         # (T, E)
-        pos_in_expert = pos.sum(-1).astype(jnp.int32)             # (T,)
-        keep = (pos_in_expert < C).astype(jnp.float32)
-        # (T, E, C) dispatch tensor: token t -> slot (e, c).
-        disp = (onehot[:, :, None]
-                * jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)[:, None, :]
-                * keep[:, None, None])
+        # Iterated argmax instead of a sort: k one-hot choice masks and
+        # their gate probabilities, all static shapes for the MXU.
+        remaining = probs
+        onehots, gates = [], []
+        for _ in range(self.top_k):
+            expert = remaining.argmax(axis=-1)                    # (T,)
+            oh = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # (T, E)
+            onehots.append(oh)
+            gates.append((remaining * oh).sum(axis=-1))           # (T,)
+            remaining = remaining * (1.0 - oh)
+        if self.top_k == 1:
+            weights = gates                  # Switch: raw gate probability
+        else:
+            denom = jnp.maximum(sum(gates), 1e-9)
+            weights = [g / denom for g in gates]   # GShard: renormalized
+
+        # Capacity slots with choice priority: each choice's tokens are
+        # placed after every earlier choice's claims on that expert.
+        claimed = jnp.zeros((E,), jnp.float32)
+        disp = jnp.zeros((T, E, C), jnp.float32)
+        comb = jnp.zeros((T, E, C), jnp.float32)
+        for oh, w in zip(onehots, weights):
+            pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh             # (T, E)
+            pos_t = (pos.sum(-1) + (oh * claimed).sum(-1)).astype(
+                jnp.int32)                                        # (T,)
+            keep = (pos_t < C).astype(jnp.float32)
+            slot = (oh[:, :, None]
+                    * jax.nn.one_hot(pos_t, C, dtype=jnp.float32)[:, None, :]
+                    * keep[:, None, None])                        # (T, E, C)
+            disp = disp + slot
+            comb = comb + w[:, None, None] * slot
+            claimed = claimed + oh.sum(axis=0)
 
         # Local buffers -> owning experts -> FFN -> back home.
         buffers = jnp.einsum("td,tec->ecd", x.astype(self.dtype),
@@ -96,15 +135,20 @@ class MoELayer(nn.Module):
         h = jnp.dot(h, w2.astype(self.dtype))
         sent = lax.all_to_all(h.reshape(E, C, d), self.axis,
                               split_axis=0, concat_axis=0)        # (E, C, d)
+        # Dropped slots are exactly zero in comb, and the gate weighting
+        # is already folded into it.
         out = jnp.einsum("ecd,tec->td", sent.astype(jnp.float32),
-                         disp)                                    # (T, d)
-        # Dropped rows are already exactly zero (their disp slice is all
-        # zeros); only the gate weighting remains to apply.
-        out = out * gate[:, None]
+                         comb)                                    # (T, d)
 
-        # Switch load-balancing aux loss: E * sum_e f_e * p_e  where f_e is
-        # the fraction of tokens routed to e, p_e the mean router prob.
-        f = onehot.mean(axis=0)
+        # Switch load-balancing aux loss on first choices: E * sum f_e p_e
+        # where f_e is the fraction of tokens whose best expert is e, p_e
+        # the mean router prob.
+        f = onehots[0].mean(axis=0)
         p = probs.mean(axis=0)
-        aux = E * jnp.sum(f * p)
+        balance = E * jnp.sum(f * p)
+        z = jax.scipy.special.logsumexp(logits, axis=-1)          # (T,)
+        z_loss = jnp.mean(z ** 2)
+        self.sow("intermediates", "aux_load_balance", balance)
+        self.sow("intermediates", "aux_router_z", z_loss)
+        aux = balance + self.router_z_weight * z_loss
         return out.astype(x.dtype), aux

@@ -20,7 +20,10 @@ def test_program_caches_bounded_over_100_compositions(hvd):
     """Cycle 100 distinct fusion compositions through both the
     device-resident and host-staged paths; the compiled-program caches must
     hold at most the configured bound."""
-    for i in range(50):
+    # Strictly more distinct compositions than the bound, so an unbounded
+    # cache (the regression this guards) would exceed it and fail.
+    n = _PROGRAM_CACHE_SIZE + 10
+    for i in range(n):
         # Device-resident contribution -> _fused_reduce_fn (distinct
         # lengths tuple per iteration = distinct composition).
         out = eager.allreduce(jnp.ones((i + 1,), jnp.float32),

@@ -23,11 +23,11 @@ def ep_mesh(hvd):
 D, HID = 8, 16
 
 
-def run_moe(hvd, x, capacity_factor):
+def run_moe(hvd, x, capacity_factor, **kw):
     """Returns (out, aux, router_kernel, w1_stack, w2_stack)."""
     mesh = ep_mesh(hvd)
     layer = MoELayer(hidden=HID, capacity_factor=capacity_factor,
-                     dtype=jnp.float32)
+                     dtype=jnp.float32, **kw)
 
     def body(x_local):
         params = layer.init(jax.random.PRNGKey(1), x_local)["params"]
@@ -57,6 +57,53 @@ def dense_oracle(x, rk, w1, w2):
         h = np.asarray(jax.nn.gelu(jnp.asarray(x[t] @ w1[e])))
         out[t] = gate[t] * (h @ w2[e])
     return out, expert
+
+
+def dense_oracle_top2(x, rk, w1, w2):
+    """Every token through its two best experts with renormalized
+    combined gates (no capacity)."""
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(x @ rk), axis=-1))
+    out = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        order = np.argsort(-probs[t])
+        e1, e2 = order[0], order[1]
+        g1, g2 = probs[t, e1], probs[t, e2]
+        w_1, w_2 = g1 / (g1 + g2), g2 / (g1 + g2)
+        for e, w in ((e1, w_1), (e2, w_2)):
+            h = np.asarray(jax.nn.gelu(jnp.asarray(x[t] @ w1[e])))
+            out[t] += w * (h @ w2[e])
+    return out
+
+
+def dense_oracle_top2_capacity(x, rk, w1, w2, n_shards, capacity,
+                               invert_priority=False):
+    """Top-2 with per-shard capacity slots, replicating MoELayer's
+    choice-priority contract: within a shard, every first choice claims
+    its slot (in token order) before any second choice.
+    ``invert_priority=True`` models the buggy opposite ordering, used to
+    prove the real test can fail."""
+    T = x.shape[0]
+    T_local = T // n_shards
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(x @ rk), axis=-1))
+    out = np.zeros_like(x)
+    for s in range(n_shards):
+        toks = range(s * T_local, (s + 1) * T_local)
+        choices = {}
+        for t in toks:
+            order = np.argsort(-probs[t])
+            e1, e2 = int(order[0]), int(order[1])
+            g1, g2 = probs[t, e1], probs[t, e2]
+            choices[t] = [(e1, g1 / (g1 + g2)), (e2, g2 / (g1 + g2))]
+        counts = np.zeros(len(w1), np.int64)
+        order_idx = (1, 0) if invert_priority else (0, 1)
+        for ci in order_idx:
+            for t in toks:
+                e, w = choices[t][ci]
+                if counts[e] < capacity:
+                    counts[e] += 1
+                    h = np.asarray(jax.nn.gelu(jnp.asarray(x[t] @ w1[e])))
+                    out[t] += w * (h @ w2[e])
+    return out
 
 
 class TestMoE:
@@ -94,6 +141,107 @@ class TestMoE:
                                            rtol=1e-4, atol=1e-4)
                 kept += 1
         assert dropped > 0 and kept > 0, (dropped, kept)
+
+    def test_top2_matches_dense_oracle_no_drops(self, hvd):
+        n = hvd.size()
+        if n < 2:
+            pytest.skip("top-2 needs >= 2 experts")
+        T = 4 * n
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (T, D)))
+        # capacity >= 2x all tokens of a shard -> nothing can drop even
+        # with two choices per token.
+        out, aux, rk, w1, w2 = run_moe(hvd, jnp.asarray(x),
+                                       capacity_factor=2.0 * n, top_k=2)
+        want = dense_oracle_top2(x, rk, w1, w2)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+    def test_top2_capacity_drops_second_choices_first(self, hvd):
+        n = hvd.size()
+        if n < 2:
+            pytest.skip("top-2 needs >= 2 experts")
+        T = 8 * n
+        T_local = T // n
+        cf = 0.5
+        C = max(1, int(cf * T_local / n))
+        rng = np.random.RandomState(11)
+        x = rng.randn(T, D).astype(np.float32)
+        out, aux, rk, w1, w2 = run_moe(hvd, jnp.asarray(x),
+                                       capacity_factor=cf, top_k=2)
+        # Exact match with the priority-respecting capacity oracle...
+        want = dense_oracle_top2_capacity(x, rk, w1, w2, n, C)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+        # ...which differs from both the no-drop oracle (so capacity did
+        # bite) and the inverted-priority oracle (so the test would catch
+        # second choices claiming slots before first choices).
+        nodrop = dense_oracle_top2(x, rk, w1, w2)
+        assert not np.allclose(out, nodrop, atol=1e-6)
+        inverted = dense_oracle_top2_capacity(x, rk, w1, w2, n, C,
+                                              invert_priority=True)
+        assert not np.allclose(out, inverted, atol=1e-6)
+
+    def test_top2_grads_flow_to_both_experts_of_a_token(self, hvd):
+        """With capacity for everything, the router grad must see both
+        chosen experts: perturbing either chosen expert's params changes
+        the output (gradient nonzero on >= 2 expert shards)."""
+        n = hvd.size()
+        if n < 2:
+            pytest.skip("top-2 needs >= 2 experts")
+        T = 4 * n
+        mesh = ep_mesh(hvd)
+        x = jax.random.normal(jax.random.PRNGKey(13), (T, D))
+        layer = MoELayer(hidden=HID, capacity_factor=2.0 * n, top_k=2,
+                         router_z_weight=1e-3, dtype=jnp.float32)
+
+        def body(x_local):
+            params = layer.init(jax.random.PRNGKey(14), x_local)["params"]
+
+            def loss_fn(p):
+                (out, aux), _ = layer.apply({"params": p}, x_local,
+                                            mutable=[])
+                return (out ** 2).mean() / lax.axis_size("ep") + 0.01 * aux
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            loss = lax.psum(loss, "ep")
+            return loss, grads["w1"][None], grads["router"]["kernel"]
+
+        loss, gw1, grouter = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("ep"),),
+            out_specs=(P(), P("ep", None, None), P()),
+            check_vma=True))(x)
+        gw1 = np.asarray(gw1)
+        assert np.isfinite(float(loss))
+        # 4n tokens x 2 experts each: essentially every expert shard
+        # receives tokens, so every shard's grad is nonzero.
+        nonzero = sum(bool(np.abs(gw1[e]).max() > 0) for e in range(n))
+        assert nonzero >= max(2, n // 2), nonzero
+        assert np.abs(np.asarray(grouter)).max() > 0
+
+    def test_router_z_loss_component(self, hvd):
+        """aux = load_balance + weight * z_loss, with both components
+        sown as intermediates."""
+        n = hvd.size()
+        T = 4 * n
+        mesh = ep_mesh(hvd)
+        x = jax.random.normal(jax.random.PRNGKey(15), (T, D))
+        layer = MoELayer(hidden=HID, capacity_factor=float(n),
+                         router_z_weight=0.1, dtype=jnp.float32)
+
+        def body(x_local):
+            params = layer.init(jax.random.PRNGKey(16), x_local)["params"]
+            (out, aux), state = layer.apply(
+                {"params": params}, x_local, mutable=["intermediates"])
+            inter = state["intermediates"]
+            return (lax.pmean(aux, "ep"),
+                    lax.pmean(inter["aux_load_balance"][0], "ep"),
+                    lax.pmean(inter["aux_router_z"][0], "ep"))
+
+        aux, balance, z = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("ep"),), out_specs=(P(),) * 3,
+            check_vma=True))(x)
+        aux, balance, z = map(lambda a: float(np.asarray(a)),
+                              (aux, balance, z))
+        assert z > 0
+        np.testing.assert_allclose(aux, balance + 0.1 * z, rtol=1e-5)
 
     def test_grads_reach_all_experts(self, hvd):
         n = hvd.size()
