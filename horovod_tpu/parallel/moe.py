@@ -74,10 +74,12 @@ class MoELayer(nn.Module):
     def __call__(self, x):
         E = lax.axis_size(self.axis)
         T, d = x.shape
-        C = max(1, int(self.capacity_factor * T / E))
         if not 1 <= self.top_k <= E:
             raise ValueError(f"top_k={self.top_k} out of range for {E} "
                              "experts")
+        # GShard convention: capacity scales with top_k, so k*T assignments
+        # fit at capacity_factor >= 1 under balanced routing.
+        C = max(1, int(self.capacity_factor * self.top_k * T / E))
 
         # Router (replicated params): per-token expert scores.
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,

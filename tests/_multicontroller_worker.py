@@ -16,6 +16,8 @@ fails fast with the jit-only error.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 process_id = int(sys.argv[1])
 num_processes = int(sys.argv[2])
 port = int(sys.argv[3])

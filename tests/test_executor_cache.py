@@ -16,10 +16,10 @@ from horovod_tpu.ops.executor import (_PROGRAM_CACHE_SIZE, _fused_reduce_fn,
                                       _stacked_reduce_fn)
 
 
-def test_program_caches_bounded_over_100_compositions(hvd):
-    """Cycle 100 distinct fusion compositions through both the
-    device-resident and host-staged paths; the compiled-program caches must
-    hold at most the configured bound."""
+def test_program_caches_stay_bounded(hvd):
+    """Cycle more distinct fusion compositions than the cache bound through
+    both the device-resident and host-staged paths; the compiled-program
+    caches must hold at most the configured bound."""
     # Strictly more distinct compositions than the bound, so an unbounded
     # cache (the regression this guards) would exceed it and fail.
     n = _PROGRAM_CACHE_SIZE + 10

@@ -162,7 +162,7 @@ class TestMoE:
         T = 8 * n
         T_local = T // n
         cf = 0.5
-        C = max(1, int(cf * T_local / n))
+        C = max(1, int(cf * 2 * T_local / n))   # layer's C for top_k=2
         rng = np.random.RandomState(11)
         x = rng.randn(T, D).astype(np.float32)
         out, aux, rk, w1, w2 = run_moe(hvd, jnp.asarray(x),
