@@ -18,6 +18,7 @@ hierarchical allreduce (``operations.cc:879-1029`` vs ``:1025-1177``):
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Tuple
 
 import jax
@@ -35,12 +36,22 @@ from horovod_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS, RANKS_AXIS
 
 def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
                      average: bool = True,
-                     compression: Compressor = NoneCompressor):
+                     compression: Compressor = NoneCompressor,
+                     fuse: bool = True):
     """Cross-rank gradient reduction inside a shard_map body.
 
     Uses the hierarchical two-tier path when the mesh is ('dcn', 'ici'),
     else a flat psum/pmean.  ``compression`` casts to the wire dtype around
     the collective (reference ``Compression.fp16``).
+
+    ``fuse=True`` reduces every leaf in ONE multi-operand collective
+    primitive (a single combined AllReduce HLO) instead of one per tensor
+    — the in-jit analogue of the reference's fusion buffer
+    (``operations.cc:1807-1842``), with zero gather/scatter copies because
+    XLA's tuple AllReduce takes the leaves in place.  The hierarchical
+    ('dcn', 'ici') path stays per-leaf regardless of ``fuse``: its
+    reduce-scatter/allgather stages need per-tensor padding, and XLA's
+    collective combiner already batches the resulting same-stage ops.
     """
     hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
 
@@ -54,7 +65,17 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
             red = lax.psum(c, axis_names)
         return compression.decompress(red, ctx)
 
-    return jax.tree.map(one, grads)
+    if hierarchical or not fuse:
+        return jax.tree.map(one, grads)
+
+    leaves, treedef = jax.tree.flatten(grads)
+    compressed = [compression.compress(g) for g in leaves]
+    wire = [c for c, _ in compressed]
+    wire = lax.pmean(wire, axis_names) if average else lax.psum(
+        wire, axis_names)
+    return jax.tree.unflatten(treedef, [
+        compression.decompress(r, ctx)
+        for r, (_, ctx) in zip(wire, compressed)])
 
 
 def make_train_step(
@@ -67,6 +88,7 @@ def make_train_step(
     sync_aux_state: bool = True,
     donate: bool = True,
     batch_spec=None,
+    steps_per_call: int = 1,
 ):
     """Build a jitted data-parallel training step over ``mesh``.
 
@@ -85,8 +107,30 @@ def make_train_step(
     (params, aux_state, opt_state, loss)`` — one XLA program containing
     forward, backward, gradient allreduce, and the optimizer update (the
     whole of SURVEY §3.2's multi-thread hot path, statically scheduled).
+
+    ``steps_per_call > 1`` runs that many optimizer steps per dispatch with
+    a ``lax.scan``: every batch leaf gains a leading ``steps_per_call``
+    axis, and the returned loss is the mean over the scanned steps.  Use
+    this to amortize host dispatch latency (measured ~2.4 ms/step on a
+    tunneled v5e — 5% of a ResNet-50 step) when the input pipeline can
+    stage several batches at once.
     """
     axes = tuple(mesh.axis_names)
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got "
+                         f"{steps_per_call}")
+
+    def scan_steps(one_step, params, aux_state, opt_state, batches):
+        def body(carry, batch):
+            params, aux_state, opt_state = carry
+            params, aux_state, opt_state, loss = one_step(
+                params, aux_state, opt_state, batch)
+            return (params, aux_state, opt_state), loss
+
+        (params, aux_state, opt_state), losses = lax.scan(
+            body, (params, aux_state, opt_state), batches,
+            length=steps_per_call)
+        return params, aux_state, opt_state, losses.mean()
 
     def spmd_body(params, aux_state, opt_state, batch):
         # Differentiate w.r.t. a VMA-varying view of the params: the
@@ -109,14 +153,64 @@ def make_train_step(
     replicated = P()
     if batch_spec is None:
         batch_spec = P(axes)   # leading dim split over every mesh axis
+    if steps_per_call > 1:
+        body = functools.partial(scan_steps, spmd_body)
+        # The scan axis leads every batch leaf; shard the dims after it.
+        batch_spec = jax.tree.map(
+            lambda s: P(*([None] + list(s))), batch_spec,
+            is_leaf=lambda s: isinstance(s, P))
+    else:
+        body = spmd_body
     step = shard_map(
-        spmd_body, mesh=mesh,
+        body, mesh=mesh,
         in_specs=(replicated, replicated, replicated, batch_spec),
         out_specs=(replicated, replicated, replicated, replicated),
         check_vma=True,
     )
     donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    spmd_step = jax.jit(step, donate_argnums=donate_argnums)
+    wire_identity = (compression is NoneCompressor
+                     or isinstance(compression, NoneCompressor))
+    if mesh.size > 1 or not wire_identity:
+        return spmd_step
+
+    # Single-chip fast path: on a 1-device mesh every collective is the
+    # identity, but the shard_map wrapper still costs ~2% wall-clock
+    # (measured on v5e ResNet-50, docs/benchmarks.md).  Compile the body
+    # as a plain jit program instead — unless loss_fn itself uses mesh
+    # axis names (e.g. a model with sp_axis modules), detected at first
+    # trace, in which case fall back to the shard_map program.
+    def plain_one(params, aux_state, opt_state, batch):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, aux_state, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_aux, opt_state, loss
+
+    if steps_per_call > 1:
+        plain_body = functools.partial(scan_steps, plain_one)
+    else:
+        plain_body = plain_one
+    plain_step = jax.jit(plain_body, donate_argnums=donate_argnums)
+    chosen = []
+
+    def _resolve(args):
+        if not chosen:
+            try:
+                # Trace without executing or donating: axis-name use
+                # inside loss_fn surfaces here as a NameError.
+                jax.eval_shape(plain_body, *args)
+                chosen.append(plain_step)
+            except NameError:
+                chosen.append(spmd_step)
+        return chosen[0]
+
+    def dispatch(params, aux_state, opt_state, batch):
+        args = (params, aux_state, opt_state, batch)
+        return _resolve(args)(*args)
+
+    dispatch.lower = lambda *args: _resolve(args).lower(*args)
+    return dispatch
 
 
 def _sync_or_check_aux(new_aux, axes, sync_aux_state: bool):
@@ -133,11 +227,23 @@ def _sync_or_check_aux(new_aux, axes, sync_aux_state: bool):
     import jax.tree_util as jtu
 
     if sync_aux_state:
-        return jax.tree.map(
-            lambda a: lax.pmean(a, axes)
-            if jnp.issubdtype(jnp.result_type(a), jnp.floating)
-            else lax.pmax(a, axes),
-            new_aux)
+        # One multi-operand collective per reduction kind (not one per
+        # leaf): float running statistics are averaged, non-float leaves
+        # (step counters etc.) unified with a max.
+        leaves, treedef = jax.tree.flatten(new_aux)
+        float_idx = [i for i, a in enumerate(leaves) if jnp.issubdtype(
+            jnp.result_type(a), jnp.floating)]
+        other_idx = [i for i in range(len(leaves)) if i not in float_idx]
+        out = list(leaves)
+        if float_idx:
+            red = lax.pmean([leaves[i] for i in float_idx], axes)
+            for i, r in zip(float_idx, red):
+                out[i] = r
+        if other_idx:
+            red = lax.pmax([leaves[i] for i in other_idx], axes)
+            for i, r in zip(other_idx, red):
+                out[i] = r
+        return jax.tree.unflatten(treedef, out)
 
     def check(path, a):
         if getattr(jax.typeof(a), "vma", frozenset()):
