@@ -196,6 +196,20 @@ def make_train_step(
 
     def _resolve(args):
         if not chosen:
+            # Run the SPMD program's trace-time diagnostics even when the
+            # plain program will execute: sync_aux_state=False's
+            # varying-aux guard (_sync_or_check_aux) must fire on one
+            # chip exactly as it would on a pod — a model developed
+            # single-chip should not ship an aux bug that only surfaces
+            # at the first multi-chip trace.  Only that diagnostic
+            # propagates; other trace failures (e.g. pallas_call outputs
+            # lacking vma annotations under check_vma) are deferred to
+            # the real trace of whichever program is actually chosen.
+            try:
+                jax.eval_shape(step, *args)
+            except ValueError as exc:
+                if "varies across mesh shards" in str(exc):
+                    raise
             try:
                 # Trace without executing or donating: axis-name use
                 # inside loss_fn surfaces here as a NameError.

@@ -64,7 +64,7 @@ class ResNet(nn.Module):
 
     ``remat=True`` checkpoints each bottleneck block: the backward pass
     recomputes block activations instead of streaming them from HBM —
-    trading MXU FLOPs (abundant at this model's ~15% MFU) for HBM
+    trading MXU FLOPs (abundant at this model's ~32% MFU) for HBM
     bandwidth (the measured bottleneck; see docs/benchmarks.md).
     """
 

@@ -175,6 +175,11 @@ class TransformerLM(nn.Module):
     # inside shard_map with check_vma=True and attn="full".
     tp_axis: Any = None
     dtype: Any = jnp.bfloat16
+    # LM-head matmul compute dtype.  f32 is the safe default; bf16 runs
+    # the (T, d) @ (d, vocab) projection at full MXU rate (measured
+    # ~20% of a d=2048/vocab=32k training step on v5e, docs/benchmarks.md)
+    # — cast the logits back to f32 for the softmax in the loss.
+    head_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
@@ -200,5 +205,5 @@ class TransformerLM(nn.Module):
             attn=self.attn, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
             dtype=self.dtype)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+        return nn.Dense(self.vocab, use_bias=False, dtype=self.head_dtype,
                         param_dtype=jnp.float32, name="head")(x)

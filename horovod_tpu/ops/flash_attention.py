@@ -441,13 +441,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def auto_block(T: int) -> int:
     """Largest TPU-tileable flash block for sequence length ``T``: ``T``
     itself when one multiple-of-8 block covers the array, else the largest
-    multiple-of-8 divisor of ``T`` up to 256 (Mosaic requires blocks'
-    sublane dim divisible by 8 — including a lone block; 256 measured
-    ~2.5x faster than 128 on v5e, see docs/benchmarks.md).  0 = cannot
-    tile; :func:`flash_attention_auto` then pads."""
-    if T <= 256:
+    multiple-of-8 divisor of ``T`` up to 1024 (Mosaic requires blocks'
+    sublane dim divisible by 8 — including a lone block).  Bigger blocks
+    amortize per-grid-step overhead: on v5e at T=2048 the 1024 block
+    measured 2x faster forward and 1.4x faster grad than 256, and
+    1024x1024 is the largest square block whose f32 scores tile fits the
+    16 MB scoped VMEM (2048x1024 exceeds it; docs/benchmarks.md).  0 =
+    cannot tile; :func:`flash_attention_auto` then pads."""
+    if T <= 1024:
         return T if T % 8 == 0 else 0
-    return max((d for d in range(8, 257, 8) if T % d == 0), default=0)
+    return max((d for d in range(8, 1025, 8) if T % d == 0), default=0)
 
 
 def flash_attention_auto(q, k, v, *, causal: bool = True,
@@ -475,10 +478,11 @@ def flash_attention_auto(q, k, v, *, causal: bool = True,
     unit = 256 if T > 256 else 8
     T_pad = -(-T // unit) * unit
     pad = [(0, 0), (0, T_pad - T), (0, 0), (0, 0)]
+    blk = auto_block(T_pad)   # largest block that tiles the padded length
     out = flash_attention(
         jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-        causal=causal, scale=scale, block_q=min(256, T_pad),
-        block_k=min(256, T_pad), interpret=interpret, seq_len=T)
+        causal=causal, scale=scale, block_q=blk,
+        block_k=blk, interpret=interpret, seq_len=T)
     return out[:, :T]
 
 
@@ -493,9 +497,9 @@ def flash_attention(q, k, v, *, causal: bool = True,
     :func:`~horovod_tpu.parallel.ring_attention.full_attention`).
 
     Block sizes default to :func:`auto_block` (the largest multiple-of-8
-    divisor of ``T`` up to 256 — 256 measured fastest on v5e); explicit
-    blocks must divide ``T`` and be multiples of 8 (Mosaic's sublane
-    constraint).  Differentiable via the flash-backward identities
+    divisor of ``T`` up to 1024 — the largest square block whose f32
+    scores tile fits v5e's 16 MB scoped VMEM); explicit blocks must
+    divide ``T`` and be multiples of 8 (Mosaic's sublane constraint).  Differentiable via the flash-backward identities
     (``bwd_impl="pallas"`` — VMEM-resident blockwise kernels; ``"xla"`` —
     the chunked-einsum fallback).  ``seq_len``: real length when the
     inputs are zero-padded to a tileable ``T`` — positions past it are

@@ -107,11 +107,14 @@ class TestAutoBlock:
         # Unaligned short lengths cannot tile (auto pads instead).
         assert auto_block(6) == 0
         assert auto_block(127) == 0
-        # Longer: largest multiple-of-8 divisor up to 256 (256 measured
-        # fastest on v5e), never an unaligned divisor like 125 or 43.
-        assert auto_block(2048) == 256
-        assert auto_block(1000) == 200
-        assert auto_block(1032) == 24
+        # One block up to 1024 when the sublane dim tiles.
+        assert auto_block(1000) == 1000
+        assert auto_block(1024) == 1024
+        # Longer: largest multiple-of-8 divisor up to 1024 (bigger blocks
+        # amortize grid overhead — 1024 measured 2x faster than 256 at
+        # T=2048 on v5e), never an unaligned divisor like 125 or 43.
+        assert auto_block(2048) == 1024
+        assert auto_block(1032) == 344
         # Untileable lengths report 0.
         assert auto_block(9998) == 0
 

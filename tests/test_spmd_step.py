@@ -96,6 +96,26 @@ def single_chip_mesh(hvd):
     return Mesh(np.asarray(jax.devices()[:1]), ("ranks",))
 
 
+def test_single_chip_fast_path_keeps_aux_guard(hvd, single_chip_mesh):
+    """sync_aux_state=False's varying-aux diagnostic must fire on the
+    1-device fast path exactly as on a pod: a model whose aux is computed
+    per-shard from the batch would silently diverge multi-chip, and the
+    error must not wait for the first multi-chip trace to surface."""
+    def bad_loss(params, aux, batch):
+        x, y = batch
+        err = jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+        return err, {"batch_mean": x.mean()}   # per-shard aux
+
+    params, x, y = _problem()
+    tx = optax.sgd(0.05)
+    sh = NamedSharding(single_chip_mesh, P("ranks"))
+    batch = (jax.device_put(x, sh), jax.device_put(y, sh))
+    step = make_train_step(bad_loss, tx, single_chip_mesh,
+                           sync_aux_state=False)
+    with pytest.raises(ValueError, match="varies across mesh shards"):
+        step(params, {"batch_mean": jnp.zeros(())}, tx.init(params), batch)
+
+
 def test_single_chip_fast_path_matches_spmd_program(hvd, single_chip_mesh):
     """On a 1-device mesh the builder compiles a plain jit program.  Its
     trajectory must match the shard_map SPMD program — exercised via a
