@@ -78,6 +78,92 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
         for r, (_, ctx) in zip(wire, compressed)])
 
 
+class _StepSpans:
+    """In-jit hot-path spans for the Horovod-style timeline (SURVEY §7.4
+    item 6): the negotiated path traces itself in the executor, but the
+    jitted train step — the actual hot path — would otherwise be
+    invisible next to those spans.  Per step two lanes are emitted:
+
+    * ``DISPATCH`` — the host call into XLA (trace + cache hit + enqueue;
+      async, returns before the device finishes);
+    * ``EXECUTE``  — dispatch-return until the step's outputs are ready,
+      stamped by a single watcher thread so the training loop never
+      blocks on instrumentation.
+
+    Active only when a timeline is configured (``HOROVOD_TPU_TIMELINE``,
+    rank 0); otherwise the per-call cost is one attribute check.
+    """
+
+    _instances = 0
+
+    def __init__(self, name: str):
+        import queue
+        import types
+        # Unique lane per instance: two instrumented steps sharing a lane
+        # would interleave their B/E pairs into garbage durations.
+        n = _StepSpans._instances
+        _StepSpans._instances += 1
+        suffix = f"[{n}]" if n else ""
+        self._dispatch = types.SimpleNamespace(name=f"{name}{suffix}/dispatch")
+        self._execute = types.SimpleNamespace(name=f"{name}{suffix}/execute")
+        self._queue: "queue.Queue" = queue.Queue()
+        self._watcher = None
+
+    @staticmethod
+    def _timeline():
+        from horovod_tpu import basics
+        controller = basics._state.controller
+        return controller.timeline if controller is not None else None
+
+    def _watch_loop(self):
+        # Both edges of EXECUTE are stamped here so B/E pairs stay
+        # properly nested even though dispatches pipeline ahead: steps are
+        # serially dependent, so "previous step done" ≈ "this one starts".
+        while True:
+            timeline, outputs = self._queue.get()
+            if timeline is None:
+                return
+            timeline.activity_start_all([self._execute], "EXECUTE")
+            try:
+                jax.block_until_ready(outputs)
+            except Exception:   # noqa: BLE001 — step error surfaces to caller
+                pass
+            timeline.activity_end_all([self._execute])
+
+    def instrument(self, fn):
+        import threading
+
+        def wrapped(*args, **kwargs):
+            timeline = self._timeline()
+            if timeline is None:
+                return fn(*args, **kwargs)
+            timeline.activity_start_all([self._dispatch], "DISPATCH")
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                # A raising step must not leave an unbalanced B event.
+                timeline.activity_end_all([self._dispatch])
+            if self._watcher is None:
+                self._watcher = threading.Thread(
+                    target=self._watch_loop, daemon=True,
+                    name="horovod_tpu-step-timeline")
+                self._watcher.start()
+            # Wait on the LOSS only: the other outputs are typically fed
+            # straight back into the next call and donated there — the
+            # watcher racing that donation would see 'Array has been
+            # deleted' and stamp EXECUTE at next-dispatch time instead of
+            # completion.  Outputs of one executable become ready
+            # together, so the loss suffices.
+            watch = out[-1] if isinstance(out, tuple) else out
+            self._queue.put((timeline, watch))
+            return out
+
+        for attr in ("lower", "trace"):   # AOT entry points pass through
+            if hasattr(fn, attr):
+                setattr(wrapped, attr, getattr(fn, attr))
+        return wrapped
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -169,10 +255,11 @@ def make_train_step(
     )
     donate_argnums = (0, 1, 2) if donate else ()
     spmd_step = jax.jit(step, donate_argnums=donate_argnums)
+    spans = _StepSpans("train_step")
     wire_identity = (compression is NoneCompressor
                      or isinstance(compression, NoneCompressor))
     if mesh.size > 1 or not wire_identity:
-        return spmd_step
+        return spans.instrument(spmd_step)
 
     # Single-chip fast path: on a 1-device mesh every collective is the
     # identity, but the shard_map wrapper still costs ~2% wall-clock
@@ -224,7 +311,7 @@ def make_train_step(
         return _resolve(args)(*args)
 
     dispatch.lower = lambda *args: _resolve(args).lower(*args)
-    return dispatch
+    return spans.instrument(dispatch)
 
 
 def _sync_or_check_aux(new_aux, axes, sync_aux_state: bool):

@@ -46,6 +46,19 @@ from horovod_tpu.parallel.mesh import RANKS_AXIS
 _PROGRAM_CACHE_SIZE = int(os.environ.get("HOROVOD_TPU_PROGRAM_CACHE", "64"))
 
 
+def _row(parts):
+    """One rank's fusion row: its contributions flattened + concatenated
+    (traced — the 'memcpy into the fusion buffer' becomes XLA HBM moves)."""
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def _sum_rows(stacked):
+    """Dtype-preserving reduction over the rank axis: MPI_Allreduce keeps
+    the element type (small ints wrap), unlike jnp.sum's default
+    promotion."""
+    return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
+
+
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
 def _fused_reduce_fn(mesh, lengths: tuple, dtype: str):
     """Jitted fused allreduce program: per-rank contribution lists →
@@ -63,12 +76,9 @@ def _fused_reduce_fn(mesh, lengths: tuple, dtype: str):
     out_sharding = NamedSharding(mesh, P())
 
     def fn(per_rank):
-        rows = [r[0] if len(r) == 1 else jnp.concatenate(r)
-                for r in per_rank]
-        stacked = jax.lax.with_sharding_constraint(jnp.stack(rows), sharded)
-        # dtype-preserving sum: MPI_Allreduce keeps the element type
-        # (small ints wrap), unlike jnp.sum's default promotion.
-        return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
+        stacked = jax.lax.with_sharding_constraint(
+            jnp.stack([_row(r) for r in per_rank]), sharded)
+        return _sum_rows(stacked)
 
     return jax.jit(fn, out_shardings=out_sharding)
 
@@ -82,11 +92,29 @@ def _stacked_reduce_fn(mesh, length: int, dtype: str):
     in_sharding = NamedSharding(mesh, P(RANKS_AXIS))
     out_sharding = NamedSharding(mesh, P())
 
-    def fn(stacked):
-        return jnp.sum(stacked, axis=0, dtype=stacked.dtype)
-
-    return jax.jit(fn, in_shardings=in_sharding,
+    return jax.jit(_sum_rows, in_shardings=in_sharding,
                    out_shardings=out_sharding)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _local_prereduce_fn(lengths: tuple, nlocal: int, dtype: str):
+    """Jitted local pre-reduction for the multi-process paths: per-rank
+    contribution lists → flatten/concat into one fusion row per local
+    rank → stack → dtype-preserving sum.  One compiled program replaces
+    the serial host loop the r2 review flagged (the slowest possible
+    reduction for model-sized tensors)."""
+    def fn(per_rank):
+        return _sum_rows(jnp.stack([_row(r) for r in per_rank]))
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _row_build_fn(lengths: tuple, dtype: str):
+    """Jitted flatten/concat of one rank's contributions into its fusion
+    row (device-resident; the mesh data plane places the row on the
+    rank's device afterwards)."""
+    return jax.jit(_row)
 
 
 @functools.lru_cache(maxsize=None)
@@ -303,37 +331,33 @@ class DistributedExecutor(Executor):
         super().__init__(topology, mesh, timeline)
         self._control = control
         self._rank_to_process = rank_to_process
+        # A mesh containing devices of OTHER processes means every process
+        # shares one multi-controller runtime: collectives can ride the
+        # mesh (ICI/DCN via XLA) device-resident instead of staging
+        # through host TCP — the analogue of the reference's accelerator
+        # data plane vs its CPU/MPI one (operations.cc:879-1229 vs
+        # :1232-1327).  Negotiation orders responses identically on every
+        # process, so all processes enter the same jitted program.
+        self._mesh_is_global = any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
 
     def _allreduce(self, response: Response, entries: List[TensorTableEntry]):
         dtype = np.dtype(entries[0].dtype)
         nranks = self.nranks   # GLOBAL rank count (for averaging)
+        lengths = tuple(int(np.prod(e.per_rank[0].shape)) for e in entries)
 
+        if self._mesh_is_global and not _needs_host_path(dtype):
+            reduced = self._mesh_allreduce(entries, lengths, dtype)
+            host_out = False
+        else:
+            reduced = self._tcp_allreduce(entries, lengths, dtype)
+            host_out = True
         if self.timeline:
-            self.timeline.activity_start_all(entries,
-                                             "MEMCPY_IN_FUSION_BUFFER")
-        # Local pre-reduction across this process's ranks, then one fused
-        # buffer for the cross-process exchange.
-        flats = []
-        for e in entries:
-            parts = [np.asarray(p, dtype=dtype).reshape(-1)
-                     for p in e.per_rank]
-            acc = parts[0].copy()
-            for p in parts[1:]:
-                acc = (acc + p).astype(dtype, copy=False)
-            flats.append(acc)
-        buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
-        if self.timeline:
-            self.timeline.activity_end_all(entries)
-            self.timeline.activity_start_all(entries, "TCP_ALLREDUCE")
-        reduced = np.frombuffer(
-            self._control.allreduce(str(dtype), buf.tobytes()), dtype=dtype)
-        if self.timeline:
-            self.timeline.activity_end_all(entries)
             self.timeline.activity_start_all(entries,
                                              "MEMCPY_OUT_FUSION_BUFFER")
         offset = 0
-        for e in entries:
-            n = int(np.prod(e.per_rank[0].shape))
+        for e, n in zip(entries, lengths):
             out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
             offset += n
             if e.average:
@@ -341,9 +365,74 @@ class DistributedExecutor(Executor):
                     out = (out / nranks).astype(dtype)
                 else:
                     out = out // nranks
-            e.callback(Status.OK(), self._to_device(out))
+            e.callback(Status.OK(),
+                       self._to_device(out) if host_out else out)
         if self.timeline:
             self.timeline.activity_end_all(entries)
+
+    def _mesh_allreduce(self, entries, lengths, dtype):
+        """Device-resident cross-process allreduce over the global mesh:
+        build each local rank's fusion row on device, assemble the global
+        (nranks, L) buffer from per-device shards, and run the same jitted
+        sum program as the single-process path — the collective rides
+        ICI/DCN; no payload crosses the TCP plane.
+
+        Ordering contract: a multi-controller runtime requires every
+        process to launch mesh collectives in the same order.  Negotiation
+        makes *eager* ops globally ordered, and synchronous eager calls
+        sit at identical points of the (SPMD-identical) user program, so
+        their order against jitted steps matches too.  What is NOT safe on
+        a shared runtime is dispatching new jitted collective programs
+        between ``*_async`` and its ``synchronize`` — the background
+        execution here could then interleave differently per process (see
+        docs/running.md)."""
+        if self.timeline:
+            self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
+        first_rank = self.topology.rank
+        mesh_devices = list(np.asarray(self.mesh.devices).flat)
+        L = sum(lengths)
+        build = _row_build_fn(lengths, str(dtype))
+        shards = []
+        for local, _ in enumerate(entries[0].per_rank):
+            row = build(tuple(
+                jnp.asarray(e.per_rank[local], dtype=dtype).reshape(-1)
+                for e in entries))
+            dev = mesh_devices[first_rank + local]
+            shards.append(jax.device_put(row.reshape(1, L), dev))
+        global_buf = jax.make_array_from_single_device_arrays(
+            (self.nranks, L),
+            NamedSharding(self.mesh, P(RANKS_AXIS)), shards)
+        reduced = _stacked_reduce_fn(self.mesh, L, str(dtype))(global_buf)
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+        return reduced
+
+    def _tcp_allreduce(self, entries, lengths, dtype):
+        """Host data plane for disjoint runtimes (or 64-bit dtypes): a
+        jitted local pre-reduction (one compiled program — flatten, concat,
+        stack, sum), then the chunked TCP ring."""
+        if self.timeline:
+            self.timeline.activity_start_all(entries,
+                                             "MEMCPY_IN_FUSION_BUFFER")
+        nlocal = len(entries[0].per_rank)
+        if _needs_host_path(dtype):
+            rows = _host_fusion_rows(entries, nlocal, dtype)
+            buf = rows[0].copy() if nlocal == 1 else np.sum(
+                np.stack(rows), axis=0, dtype=dtype)
+        else:
+            fn = _local_prereduce_fn(lengths, nlocal, str(dtype))
+            buf = np.asarray(fn(tuple(
+                tuple(jnp.asarray(e.per_rank[r], dtype=dtype).reshape(-1)
+                      for e in entries)
+                for r in range(nlocal))))
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+            self.timeline.activity_start_all(entries, "TCP_ALLREDUCE")
+        reduced = np.frombuffer(
+            self._control.allreduce(str(dtype), buf.tobytes()), dtype=dtype)
+        if self.timeline:
+            self.timeline.activity_end_all(entries)
+        return reduced
 
     def _allgather(self, response: Response,
                    entries: List[TensorTableEntry]):

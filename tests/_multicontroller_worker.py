@@ -21,9 +21,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 process_id = int(sys.argv[1])
 num_processes = int(sys.argv[2])
 port = int(sys.argv[3])
+# Optional: a coordinator port enables the TCP control plane, so the
+# eager API works — and, because every process shares the one
+# multi-controller runtime, its allreduce payloads must ride the mesh
+# (ICI on hardware), NOT the TCP data plane.
+coord_port = int(sys.argv[4]) if len(sys.argv) > 4 else 0
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("HOROVOD_TPU_COORD_ADDR", None)
+if coord_port:
+    os.environ["HOROVOD_TPU_COORD_ADDR"] = f"127.0.0.1:{coord_port}"
+else:
+    os.environ.pop("HOROVOD_TPU_COORD_ADDR", None)
 devices_per_proc = 4 if process_id < 0 else 4 // num_processes
 os.environ["XLA_FLAGS"] = (
     f"--xla_force_host_platform_device_count={devices_per_proc}")
@@ -93,7 +101,7 @@ for _ in range(5):
     params, aux, opt_state, loss = step(params, aux, opt_state, (x, y))
     print(f"LOSS {float(loss)!r}", flush=True)
 
-if process_id >= 0:
+if process_id >= 0 and not coord_port:
     # The eager (negotiated) API must fail fast with the jit-only error,
     # not stall: no control plane is configured on this 2-process job.
     from horovod_tpu.ops import eager
@@ -103,5 +111,24 @@ if process_id >= 0:
     except eager.CollectiveError as exc:
         assert "jit-only" in str(exc), str(exc)
         print("EAGER_GATED OK", flush=True)
+
+if process_id >= 0 and coord_port:
+    # Eager allreduce on a shared multi-controller runtime: correct sum
+    # over all 4 global ranks, with ZERO payload through the TCP data
+    # plane (device-resident over the global mesh; only negotiation
+    # metadata crosses TCP).
+    from horovod_tpu import basics
+    from horovod_tpu.ops.eager import PerRank
+
+    ctrl = basics._state.controller._control
+    first = hvd.rank()
+    db0 = ctrl.data_bytes()
+    per = PerRank([np.full((4096,), float(first + j + 1), np.float32)
+                   for j in range(devices_per_proc)])
+    out = np.asarray(hvd.allreduce(per, average=False, name="mc.mesh"))
+    want = sum(range(1, 5))          # ranks contribute 1..4
+    np.testing.assert_allclose(out, np.full((4096,), float(want)))
+    assert ctrl.data_bytes() == db0, (db0, ctrl.data_bytes())
+    print("EAGER_MESH OK", flush=True)
 
 print("DONE", flush=True)
