@@ -117,6 +117,34 @@ def _row_build_fn(lengths: tuple, dtype: str):
     return jax.jit(_row)
 
 
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _gather_unpad_fn(mesh, sizes: tuple, row_shape: tuple, dtype: str):
+    """Jitted ragged allgather: reshard the padded rank-sharded
+    (nranks, max_rows, ...) buffer to replicated (XLA all-gather over
+    ICI/DCN) and slice each rank's true rows back out — ONE program per
+    negotiated sizes tuple, bounded by the program LRU (unfenced eager
+    slicing would retain nranks+1 programs per composition forever)."""
+    max_rows = max(sizes)
+
+    def fn(buf):
+        if all(s == max_rows for s in sizes):
+            return buf.reshape((len(sizes) * max_rows,) + row_shape)
+        return jnp.concatenate(
+            [buf[r, :s] for r, s in enumerate(sizes)], axis=0)
+
+    return jax.jit(fn, in_shardings=NamedSharding(mesh, P(RANKS_AXIS)),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _select_row_fn(mesh, length: int, dtype: str, row: int):
+    """Jitted broadcast: pick one rank's row of the rank-sharded buffer
+    and replicate it — XLA generates the cross-process transfer."""
+    return jax.jit(lambda buf: buf[row],
+                   in_shardings=NamedSharding(mesh, P(RANKS_AXIS)),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
 @functools.lru_cache(maxsize=None)
 def _replicate_sharding(mesh):
     return NamedSharding(mesh, P())
@@ -150,7 +178,8 @@ class Executor:
         self.mesh = mesh
         self.timeline = timeline
         self.nranks = topology.size
-        self._mesh_device_set = set(np.asarray(mesh.devices).flat)
+        self._mesh_devices = list(np.asarray(mesh.devices).flat)
+        self._mesh_device_set = set(self._mesh_devices)
 
     def _mesh_safe(self, v) -> "jax.Array":
         """Make a device contribution consumable by the mesh-wide jitted
@@ -319,13 +348,21 @@ class Executor:
 
 
 class DistributedExecutor(Executor):
-    """Multi-process data plane: collective payloads cross processes via the
-    native TCP control plane (:class:`horovod_tpu.cpp_core.CppControlPlane`),
-    replacing the reference's CPU MPI data plane
-    (``operations.cc:1232-1353``).  Local per-rank contributions are
-    pre-reduced / pre-concatenated on this process first — the same two-level
-    structure as the reference's hierarchical path (local first, then
-    cross-node)."""
+    """Multi-process data plane, two transports chosen per runtime shape:
+
+    * **Shared multi-controller runtime** (the mesh spans other
+      processes' devices): allreduce/allgather/broadcast payloads stay
+      device-resident and ride the global mesh — XLA collectives over
+      ICI/DCN, the analogue of the reference's NCCL accelerator path
+      (``operations.cc:879-1229``).  Only negotiation metadata crosses
+      TCP.
+    * **Disjoint runtimes** (launcher-spawned single-host processes):
+      payloads cross via the native TCP ring
+      (:class:`horovod_tpu.cpp_core.CppControlPlane`), replacing the
+      reference's CPU MPI data plane (``operations.cc:1232-1353``), with
+      local per-rank contributions pre-reduced in one jitted program
+      first — the same two-level structure as the reference's
+      hierarchical path."""
 
     def __init__(self, topology, mesh, timeline, control, rank_to_process):
         super().__init__(topology, mesh, timeline)
@@ -388,24 +425,30 @@ class DistributedExecutor(Executor):
         docs/running.md)."""
         if self.timeline:
             self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
-        first_rank = self.topology.rank
-        mesh_devices = list(np.asarray(self.mesh.devices).flat)
         L = sum(lengths)
         build = _row_build_fn(lengths, str(dtype))
-        shards = []
-        for local, _ in enumerate(entries[0].per_rank):
-            row = build(tuple(
+        rows = [
+            build(tuple(
                 jnp.asarray(e.per_rank[local], dtype=dtype).reshape(-1)
                 for e in entries))
-            dev = mesh_devices[first_rank + local]
-            shards.append(jax.device_put(row.reshape(1, L), dev))
-        global_buf = jax.make_array_from_single_device_arrays(
-            (self.nranks, L),
-            NamedSharding(self.mesh, P(RANKS_AXIS)), shards)
+            for local in range(len(entries[0].per_rank))]
+        global_buf = self._global_rows(rows)
         reduced = _stacked_reduce_fn(self.mesh, L, str(dtype))(global_buf)
         if self.timeline:
             self.timeline.activity_end_all(entries)
         return reduced
+
+    def _global_rows(self, rows):
+        """Assemble a global rank-sharded array from this process's
+        per-local-rank rows (device-resident; every process contributes
+        only its addressable shards)."""
+        first_rank = self.topology.rank
+        shards = [
+            jax.device_put(row[None], self._mesh_devices[first_rank + local])
+            for local, row in enumerate(rows)]
+        shape = (self.nranks,) + rows[0].shape
+        return jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(self.mesh, P(RANKS_AXIS)), shards)
 
     def _tcp_allreduce(self, entries, lengths, dtype):
         """Host data plane for disjoint runtimes (or 64-bit dtypes): a
@@ -437,9 +480,12 @@ class DistributedExecutor(Executor):
     def _allgather(self, response: Response,
                    entries: List[TensorTableEntry]):
         for e in entries:
+            dtype = np.dtype(e.dtype)
+            if self._mesh_is_global and not _needs_host_path(dtype):
+                self._mesh_allgather(response, e, dtype)
+                continue
             if self.timeline:
                 self.timeline.activity_start_all([e], "TCP_ALLGATHER")
-            dtype = np.dtype(e.dtype)
             local = np.concatenate(
                 [np.asarray(p, dtype=dtype) for p in e.per_rank], axis=0)
             data = self._control.allgather(local.tobytes())
@@ -451,13 +497,43 @@ class DistributedExecutor(Executor):
                 self.timeline.activity_end_all([e])
             e.callback(Status.OK(), self._to_device(out))
 
+    def _mesh_allgather(self, response: Response, e: TensorTableEntry,
+                        dtype):
+        """Ragged allgather over the global mesh: pad each rank's rows to
+        the negotiated max, replicate the rank-sharded stack (XLA
+        all-gather over ICI/DCN), then concat the true sizes back — all
+        device-resident.  Same ordering contract as _mesh_allreduce."""
+        if self.timeline:
+            self.timeline.activity_start_all([e], "XLA_ALLGATHER")
+        sizes = list(response.tensor_sizes)         # rows per GLOBAL rank
+        first_rank = self.topology.rank
+        max_rows = max(sizes)
+        row_shape = tuple(e.per_rank[0].shape[1:])
+        rows = []
+        for local, part in enumerate(e.per_rank):
+            arr = jnp.asarray(part, dtype=dtype)
+            pad_n = max_rows - sizes[first_rank + local]
+            if pad_n:
+                arr = jnp.concatenate(
+                    [arr, jnp.zeros((pad_n,) + row_shape, dtype)], axis=0)
+            rows.append(arr)
+        buf = self._global_rows(rows)
+        out = _gather_unpad_fn(self.mesh, tuple(sizes), row_shape,
+                               str(dtype))(buf)
+        if self.timeline:
+            self.timeline.activity_end_all([e])
+        e.callback(Status.OK(), out)
+
     def _broadcast(self, response: Response,
                    entries: List[TensorTableEntry]):
         first_rank = self.topology.rank
         for e in entries:
+            dtype = np.dtype(e.dtype)
+            if self._mesh_is_global and not _needs_host_path(dtype):
+                self._mesh_broadcast(e, dtype)
+                continue
             if self.timeline:
                 self.timeline.activity_start_all([e], "TCP_BROADCAST")
-            dtype = np.dtype(e.dtype)
             root_process = self._rank_to_process[e.root_rank]
             root_local = e.root_rank - first_rank
             if 0 <= root_local < len(e.per_rank):
@@ -471,6 +547,31 @@ class DistributedExecutor(Executor):
             if self.timeline:
                 self.timeline.activity_end_all([e])
             e.callback(Status.OK(), self._to_device(out))
+
+    def _mesh_broadcast(self, e: TensorTableEntry, dtype):
+        """Broadcast over the global mesh: every rank contributes its row
+        (only the root's is meaningful — shapes are negotiation-validated
+        equal), and a jitted row-select replicates the root's value (XLA
+        generates the cross-process transfer).  Same ordering contract as
+        _mesh_allreduce."""
+        if self.timeline:
+            self.timeline.activity_start_all([e], "XLA_BROADCAST")
+        shape = tuple(e.per_rank[0].shape)
+        L = int(np.prod(shape))
+        first_rank = self.topology.rank
+        # Only the root's row is read — placeholder zeros for the other
+        # local ranks avoid a full-tensor upload per rank per broadcast.
+        rows = [
+            jnp.asarray(p, dtype=dtype).reshape(-1)
+            if first_rank + local == e.root_rank
+            else jnp.zeros((L,), dtype)
+            for local, p in enumerate(e.per_rank)]
+        buf = self._global_rows(rows)
+        out = _select_row_fn(self.mesh, L, str(dtype),
+                             int(e.root_rank))(buf).reshape(shape)
+        if self.timeline:
+            self.timeline.activity_end_all([e])
+        e.callback(Status.OK(), out)
 
     def _to_device(self, arr: np.ndarray):
         if _needs_host_path(arr.dtype):

@@ -128,6 +128,22 @@ if process_id >= 0 and coord_port:
     out = np.asarray(hvd.allreduce(per, average=False, name="mc.mesh"))
     want = sum(range(1, 5))          # ranks contribute 1..4
     np.testing.assert_allclose(out, np.full((4096,), float(want)))
+
+    # Ragged allgather: global rank r contributes r+1 rows of value r.
+    per = PerRank([np.full((first + j + 1, 2), float(first + j), np.float32)
+                   for j in range(devices_per_proc)])
+    out = np.asarray(hvd.allgather(per, name="mc.mesh.gather"))
+    want_rows = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(4)])
+    np.testing.assert_allclose(out, want_rows)
+
+    # Broadcast from the LAST global rank (lives on the other process for
+    # process 0 — the payload must arrive via the mesh).
+    per = PerRank([np.full((3,), float(first + j), np.float32)
+                   for j in range(devices_per_proc)])
+    out = np.asarray(hvd.broadcast(per, root_rank=3, name="mc.mesh.bcast"))
+    np.testing.assert_allclose(out, np.full((3,), 3.0))
+
     assert ctrl.data_bytes() == db0, (db0, ctrl.data_bytes())
     print("EAGER_MESH OK", flush=True)
 
