@@ -60,7 +60,7 @@ def _sum_rows(stacked):
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _fused_reduce_fn(mesh, lengths: tuple, dtype: str):
+def _fused_reduce_fn(mesh, shapes: tuple, dtype: str):
     """Jitted fused allreduce program: per-rank contribution lists →
     flatten/concat into one fusion row per rank → reshard the (nranks, L)
     buffer over the ``ranks`` axis → sum (XLA AllReduce) → replicated
@@ -76,8 +76,9 @@ def _fused_reduce_fn(mesh, lengths: tuple, dtype: str):
     out_sharding = NamedSharding(mesh, P())
 
     def fn(per_rank):
-        stacked = jax.lax.with_sharding_constraint(
-            jnp.stack([_row(r) for r in per_rank]), sharded)
+        rows = [_row(tuple(p.reshape(-1) for p in parts))
+                for parts in per_rank]
+        stacked = jax.lax.with_sharding_constraint(jnp.stack(rows), sharded)
         return _sum_rows(stacked)
 
     return jax.jit(fn, out_shardings=out_sharding)
@@ -97,24 +98,51 @@ def _stacked_reduce_fn(mesh, length: int, dtype: str):
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _local_prereduce_fn(lengths: tuple, nlocal: int, dtype: str):
+def _local_prereduce_fn(shapes: tuple, nlocal: int, dtype: str):
     """Jitted local pre-reduction for the multi-process paths: per-rank
-    contribution lists → flatten/concat into one fusion row per local
-    rank → stack → dtype-preserving sum.  One compiled program replaces
-    the serial host loop the r2 review flagged (the slowest possible
-    reduction for model-sized tensors)."""
+    contribution lists → cast/flatten/concat into one fusion row per
+    local rank → stack → dtype-preserving sum.  One compiled program
+    replaces the serial host loop the r2 review flagged (the slowest
+    possible reduction for model-sized tensors)."""
     def fn(per_rank):
-        return _sum_rows(jnp.stack([_row(r) for r in per_rank]))
+        rows = [_row(tuple(p.astype(dtype).reshape(-1) for p in parts))
+                for parts in per_rank]
+        return _sum_rows(jnp.stack(rows))
 
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _row_build_fn(lengths: tuple, dtype: str):
-    """Jitted flatten/concat of one rank's contributions into its fusion
-    row (device-resident; the mesh data plane places the row on the
-    rank's device afterwards)."""
-    return jax.jit(_row)
+def _row_build_fn(shapes: tuple, dtype: str):
+    """Jitted cast/flatten/concat of one rank's contributions into its
+    fusion row (device-resident; the mesh data plane places the row on
+    the rank's device afterwards).  Keyed by the contribution shapes so
+    every per-call array op lives inside one LRU-fenced program."""
+    def fn(parts):
+        return _row(tuple(p.astype(dtype).reshape(-1) for p in parts))
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _pad_rows_fn(shape: tuple, pad_n: int, dtype: str):
+    """Jitted cast + zero-pad of one rank's allgather contribution to the
+    negotiated max row count."""
+    def fn(arr):
+        arr = arr.astype(dtype)
+        if pad_n:
+            arr = jnp.concatenate(
+                [arr, jnp.zeros((pad_n,) + shape[1:], dtype)], axis=0)
+        return arr
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _zero_row_fn(length: int, dtype: str):
+    """Jitted placeholder row (broadcast contributions of non-root
+    ranks)."""
+    return jax.jit(lambda: jnp.zeros((length,), dtype))
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
@@ -137,10 +165,11 @@ def _gather_unpad_fn(mesh, sizes: tuple, row_shape: tuple, dtype: str):
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _select_row_fn(mesh, length: int, dtype: str, row: int):
-    """Jitted broadcast: pick one rank's row of the rank-sharded buffer
-    and replicate it — XLA generates the cross-process transfer."""
-    return jax.jit(lambda buf: buf[row],
+def _select_row_fn(mesh, shape: tuple, dtype: str, row: int):
+    """Jitted broadcast: pick one rank's row of the rank-sharded buffer,
+    restore the tensor shape, and replicate — XLA generates the
+    cross-process transfer."""
+    return jax.jit(lambda buf: buf[row].reshape(shape),
                    in_shardings=NamedSharding(mesh, P(RANKS_AXIS)),
                    out_shardings=NamedSharding(mesh, P()))
 
@@ -249,10 +278,10 @@ class Executor:
             # there is no separate MEMCPY_IN span in this mode).
             if self.timeline:
                 self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
-            fn = _fused_reduce_fn(self.mesh, lengths, str(dtype))
+            shapes = tuple(tuple(e.per_rank[0].shape) for e in entries)
+            fn = _fused_reduce_fn(self.mesh, shapes, str(dtype))
             reduced = fn(tuple(
-                tuple(self._mesh_safe(e.per_rank[r]).reshape(-1)
-                      for e in entries)
+                tuple(self._mesh_safe(e.per_rank[r]) for e in entries)
                 for r in range(nranks)))
         else:
             # Host-borne contributions: stage the (nranks, L) fusion buffer
@@ -426,11 +455,10 @@ class DistributedExecutor(Executor):
         if self.timeline:
             self.timeline.activity_start_all(entries, "XLA_ALLREDUCE")
         L = sum(lengths)
-        build = _row_build_fn(lengths, str(dtype))
+        shapes = tuple(tuple(e.per_rank[0].shape) for e in entries)
+        build = _row_build_fn(shapes, str(dtype))
         rows = [
-            build(tuple(
-                jnp.asarray(e.per_rank[local], dtype=dtype).reshape(-1)
-                for e in entries))
+            build(tuple(e.per_rank[local] for e in entries))
             for local in range(len(entries[0].per_rank))]
         global_buf = self._global_rows(rows)
         reduced = _stacked_reduce_fn(self.mesh, L, str(dtype))(global_buf)
@@ -463,10 +491,10 @@ class DistributedExecutor(Executor):
             buf = rows[0].copy() if nlocal == 1 else np.sum(
                 np.stack(rows), axis=0, dtype=dtype)
         else:
-            fn = _local_prereduce_fn(lengths, nlocal, str(dtype))
+            shapes = tuple(tuple(e.per_rank[0].shape) for e in entries)
+            fn = _local_prereduce_fn(shapes, nlocal, str(dtype))
             buf = np.asarray(fn(tuple(
-                tuple(jnp.asarray(e.per_rank[r], dtype=dtype).reshape(-1)
-                      for e in entries)
+                tuple(e.per_rank[r] for e in entries)
                 for r in range(nlocal))))
         if self.timeline:
             self.timeline.activity_end_all(entries)
@@ -511,12 +539,9 @@ class DistributedExecutor(Executor):
         row_shape = tuple(e.per_rank[0].shape[1:])
         rows = []
         for local, part in enumerate(e.per_rank):
-            arr = jnp.asarray(part, dtype=dtype)
+            shape = tuple(part.shape)
             pad_n = max_rows - sizes[first_rank + local]
-            if pad_n:
-                arr = jnp.concatenate(
-                    [arr, jnp.zeros((pad_n,) + row_shape, dtype)], axis=0)
-            rows.append(arr)
+            rows.append(_pad_rows_fn(shape, pad_n, str(dtype))(part))
         buf = self._global_rows(rows)
         out = _gather_unpad_fn(self.mesh, tuple(sizes), row_shape,
                                str(dtype))(buf)
@@ -562,13 +587,13 @@ class DistributedExecutor(Executor):
         # Only the root's row is read — placeholder zeros for the other
         # local ranks avoid a full-tensor upload per rank per broadcast.
         rows = [
-            jnp.asarray(p, dtype=dtype).reshape(-1)
+            _row_build_fn((shape,), str(dtype))((p,))
             if first_rank + local == e.root_rank
-            else jnp.zeros((L,), dtype)
+            else _zero_row_fn(L, str(dtype))()
             for local, p in enumerate(e.per_rank)]
         buf = self._global_rows(rows)
-        out = _select_row_fn(self.mesh, L, str(dtype),
-                             int(e.root_rank))(buf).reshape(shape)
+        out = _select_row_fn(self.mesh, shape, str(dtype),
+                             int(e.root_rank))(buf)
         if self.timeline:
             self.timeline.activity_end_all([e])
         e.callback(Status.OK(), out)

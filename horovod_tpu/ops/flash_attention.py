@@ -409,30 +409,31 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd_impl,
-           seq_len):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, scale, causal, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret, bwd_impl, seq_len):
     out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, interpret=interpret, seq_len=seq_len)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-               bwd_impl, seq_len):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, bwd_block_q,
+               bwd_block_k, interpret, bwd_impl, seq_len):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                     block_k=block_k, interpret=interpret, seq_len=seq_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, bwd_impl,
-               seq_len, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, bwd_block_q, bwd_block_k,
+               interpret, bwd_impl, seq_len, res, do):
     q, k, v, o, lse = res
     if bwd_impl == "pallas":
         return _bwd_pallas(q, k, v, o, lse, do, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k,
+                           block_q=bwd_block_q, block_k=bwd_block_k,
                            interpret=interpret, seq_len=seq_len)
     return _bwd_xla(q, k, v, o, lse, do, scale=scale, causal=causal,
-                    chunk=block_k, seq_len=seq_len)
+                    chunk=bwd_block_k, seq_len=seq_len)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -486,10 +487,24 @@ def flash_attention_auto(q, k, v, *, causal: bool = True,
     return out[:, :T]
 
 
+def bwd_kv_block(T: int, block_q: int) -> int:
+    """Widest backward KV block within the f32 scores-tile budget
+    block_q*block_k <= 2^20 — a helper for EXPLICIT ``bwd_block_k``
+    tuning only.  The default backward blocks equal the forward blocks:
+    standalone the backward compiles up to 1024x2048, but inside a full
+    transformer step that exceeds the 16 MB scoped VMEM (measured on
+    v5e), and the wider blocks' win was within 3%."""
+    budget = (1 << 20) // max(block_q, 1)
+    return max((d for d in range(8, min(budget, T) + 1, 8) if T % d == 0),
+               default=block_q)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None,
                     interpret: bool = False,
                     bwd_impl: str = "pallas",
                     seq_len: Optional[int] = None):
@@ -538,11 +553,25 @@ def flash_attention(q, k, v, *, causal: bool = True,
         raise ValueError(f"seq_len {seq_len} out of range for T={T}")
     if seq_len == T:
         seq_len = None
+    # Backward blocks default to the forward blocks (see bwd_kv_block for
+    # why not wider); explicit values obey the same constraints.
+    if bwd_block_q is None:
+        bwd_block_q = block_q
+    if bwd_block_k is None:
+        bwd_block_k = block_k
+    bwd_block_q = min(bwd_block_q, T)
+    bwd_block_k = min(bwd_block_k, T)
+    if (T % bwd_block_q or T % bwd_block_k
+            or bwd_block_q % 8 or bwd_block_k % 8):
+        raise ValueError(
+            f"flash_attention backward blocks must divide T and be "
+            f"multiples of 8, got T={T}, bwd_block_q={bwd_block_q}, "
+            f"bwd_block_k={bwd_block_k}")
 
     def merge(x):   # (B, T, H, D) -> (B*H, T, D)
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
     out = _flash(merge(q), merge(k), merge(v), float(scale), bool(causal),
-                 int(block_q), int(block_k), bool(interpret), bwd_impl,
-                 seq_len)
+                 int(block_q), int(block_k), int(bwd_block_q),
+                 int(bwd_block_k), bool(interpret), bwd_impl, seq_len)
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
