@@ -1,0 +1,61 @@
+"""End-to-end test of the mpirun replacement (``python -m
+horovod_tpu.run``): the reference's launch story is ``mpirun -np N
+python train.py`` (``docs/running.md:1-46``); ours must spawn N wired
+processes whose collectives agree, with zero manual env."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import cpp_core
+
+pytestmark = pytest.mark.skipif(
+    not cpp_core.available(), reason="native core not built")
+
+_PAYLOAD = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n, r = hvd.size(), hvd.rank()
+    out = np.asarray(hvd.allreduce(np.full((4,), float(r + 1), np.float32),
+                                   average=False, name="launch.sum"))
+    np.testing.assert_allclose(out, np.full((4,), n * (n + 1) / 2.0))
+    print(f"LAUNCH_OK rank={r} size={n}", flush=True)
+""")
+
+
+def test_run_np2_allreduce(tmp_path):
+    script = tmp_path / "payload.py"
+    script.write_text(_PAYLOAD)
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_COORD_ADDR", None)
+    # One virtual device per spawned process (the suite's conftest sets 8,
+    # which would give each 1-rank worker a gapped rank space).
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    # Own session so a hang kills the whole tree (launcher + payload
+    # grandchildren), not just the launcher.
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--",
+         sys.executable, str(script)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, start_new_session=True)
+    try:
+        combined, _ = proc.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        combined, _ = proc.communicate()
+        pytest.fail(f"launcher timed out; output:\n{combined}")
+    assert proc.returncode == 0, combined
+    assert "LAUNCH_OK rank=0 size=2" in combined, combined
+    assert "LAUNCH_OK rank=1 size=2" in combined, combined
