@@ -40,8 +40,9 @@ def test_run_np2_allreduce(tmp_path):
     # One virtual device per spawned process (the suite's conftest sets 8,
     # which would give each 1-rank worker a gapped rank space).
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     # Own session so a hang kills the whole tree (launcher + payload
     # grandchildren), not just the launcher.
     proc = subprocess.Popen(
