@@ -71,6 +71,16 @@ def test_pipeline_transformer_example(hvd, monkeypatch, capsys):
     assert f"pipeline stages={hvd.size()}" in out
 
 
+def test_pod_training_example(hvd, monkeypatch, capsys):
+    """The zero-config multi-controller recipe, degraded to one process
+    over the 8 virtual chips (the real 2-process run lives in
+    tests/test_multicontroller.py)."""
+    monkeypatch.setattr(sys, "argv", ["x", "--steps", "60"])
+    ns = runpy.run_path("examples/jax_pod_training.py")
+    loss0, final = ns["main"]()
+    assert final < 0.05 * loss0, (loss0, final)
+
+
 def test_word2vec_example(hvd, monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "x", "--steps", "30", "--vocab", "300", "--dim", "16",
