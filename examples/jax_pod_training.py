@@ -41,6 +41,8 @@ def main():
     ap.add_argument("--batch-per-rank", type=int, default=32)
     ap.add_argument("--lr", type=float, default=0.05)
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
 
     try:
         # On a pod the runtime env tells every process where the
@@ -50,11 +52,14 @@ def main():
         # Only swallow when NO cluster was configured (plain single-host
         # run).  A configured-but-failing pod must raise: silently
         # degrading to N independent single-host runs would train N
-        # divergent models with no error.
+        # divergent models with no error.  Markers cover explicit
+        # coordinator env plus the launchers JAX auto-detects (Cloud TPU
+        # metadata, Slurm, Open MPI).
         import os
         if any(os.environ.get(v) for v in (
                 "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-                "MEGASCALE_COORDINATOR_ADDRESS")):
+                "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")):
             raise
 
     hvd.init()

@@ -1,5 +1,6 @@
 """Model zoo: TPU-first flax models used by the examples and benchmarks."""
 
+from horovod_tpu.models.inception import InceptionV3, VGG16   # noqa: F401
 from horovod_tpu.models.mlp import MLP, ConvNet          # noqa: F401
 from horovod_tpu.models.resnet import (                   # noqa: F401
     ResNet, ResNet50, ResNet101, ResNet152,

@@ -1,0 +1,210 @@
+"""Inception V3 — the reference's headline scaling-benchmark model.
+
+The reference's published 90%-at-512-GPU scaling number is measured on
+Inception V3 (reference ``docs/benchmarks.md:3-6``, ``README.md:46-52``),
+so the model belongs in the zoo alongside ResNet.  TPU-first like
+:mod:`horovod_tpu.models.resnet`: NHWC layout, bf16 compute / f32 params
+and batch-norm, static shapes, no Python control flow in the forward.
+
+Standard V3 topology (Szegedy et al. 2015, the torchvision/keras layout):
+stem (5 convs + 2 pools) → 3×A(35×35) → B → 4×C(17×17) → D → 2×E(8×8) →
+global pool → dropout-free fc.  The aux classifier head is omitted — it
+exists for a training schedule trick the benchmark never uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv → BN → relu, the V3 building unit (bias-free conv)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    conv: ModuleDef = None
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(self.features, self.kernel, self.strides,
+                      padding=self.padding)(x)
+        x = self.norm()(x)
+        return nn.relu(x)
+
+
+def _pool(x, window=(3, 3), strides=(1, 1), kind="avg"):
+    if kind == "avg":
+        return nn.avg_pool(x, window, strides=strides, padding="SAME")
+    return nn.max_pool(x, window, strides=strides, padding="VALID")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    cb: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cb(64, (1, 1))(x)
+        b5 = self.cb(48, (1, 1))(x)
+        b5 = self.cb(64, (5, 5))(b5)
+        b3 = self.cb(64, (1, 1))(x)
+        b3 = self.cb(96, (3, 3))(b3)
+        b3 = self.cb(96, (3, 3))(b3)
+        bp = _pool(x)
+        bp = self.cb(self.pool_features, (1, 1))(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35×35 → 17×17 grid reduction."""
+
+    cb: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cb(384, (3, 3), (2, 2), padding="VALID")(x)
+        bd = self.cb(64, (1, 1))(x)
+        bd = self.cb(96, (3, 3))(bd)
+        bd = self.cb(96, (3, 3), (2, 2), padding="VALID")(bd)
+        bp = _pool(x, strides=(2, 2), kind="max")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7×7 branches at 17×17."""
+
+    channels_7x7: int
+    cb: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = self.cb(192, (1, 1))(x)
+        b7 = self.cb(c7, (1, 1))(x)
+        b7 = self.cb(c7, (1, 7))(b7)
+        b7 = self.cb(192, (7, 1))(b7)
+        bd = self.cb(c7, (1, 1))(x)
+        bd = self.cb(c7, (7, 1))(bd)
+        bd = self.cb(c7, (1, 7))(bd)
+        bd = self.cb(c7, (7, 1))(bd)
+        bd = self.cb(192, (1, 7))(bd)
+        bp = _pool(x)
+        bp = self.cb(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17×17 → 8×8 grid reduction."""
+
+    cb: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cb(192, (1, 1))(x)
+        b3 = self.cb(320, (3, 3), (2, 2), padding="VALID")(b3)
+        b7 = self.cb(192, (1, 1))(x)
+        b7 = self.cb(192, (1, 7))(b7)
+        b7 = self.cb(192, (7, 1))(b7)
+        b7 = self.cb(192, (3, 3), (2, 2), padding="VALID")(b7)
+        bp = _pool(x, strides=(2, 2), kind="max")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded 3×3 branches at 8×8."""
+
+    cb: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cb(320, (1, 1))(x)
+        b3 = self.cb(384, (1, 1))(x)
+        b3 = jnp.concatenate([self.cb(384, (1, 3))(b3),
+                              self.cb(384, (3, 1))(b3)], axis=-1)
+        bd = self.cb(448, (1, 1))(x)
+        bd = self.cb(384, (3, 3))(bd)
+        bd = jnp.concatenate([self.cb(384, (1, 3))(bd),
+                              self.cb(384, (3, 1))(bd)], axis=-1)
+        bp = _pool(x)
+        bp = self.cb(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 for NHWC images (canonical input 299×299×3; any size
+    ≥ 75 with both dims odd-reducible works thanks to SAME/VALID mix)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=None)
+        cb = partial(ConvBN, conv=conv, norm=norm)
+
+        x = jnp.asarray(x, self.dtype)
+        x = cb(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = cb(32, (3, 3), padding="VALID")(x)
+        x = cb(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cb(80, (1, 1), padding="VALID")(x)
+        x = cb(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+        x = InceptionA(32, cb=cb)(x)
+        x = InceptionA(64, cb=cb)(x)
+        x = InceptionA(64, cb=cb)(x)
+        x = InceptionB(cb=cb)(x)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, cb=cb)(x)
+        x = InceptionD(cb=cb)(x)
+        x = InceptionE(cb=cb)(x)
+        x = InceptionE(cb=cb)(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+class VGG16(nn.Module):
+    """VGG-16 (the reference's 68%-scaling benchmark model,
+    ``docs/benchmarks.md:3-6``): 13 convs in 5 stages + 3 fc.  BN-free
+    like the original; f32 classifier head."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    stage_sizes: Sequence[int] = (2, 2, 3, 3, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train   # no train-time state; signature parity with the zoo
+        x = jnp.asarray(x, self.dtype)
+        features = 64
+        for stage, n in enumerate(self.stage_sizes):
+            for i in range(n):
+                x = nn.Conv(min(features, 512), (3, 3), dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name=f"conv{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            features *= 2
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
