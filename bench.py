@@ -155,15 +155,34 @@ def bench_resnet(jax, hvd, mesh, nchips):
     from horovod_tpu.jax.spmd import make_train_step
     from horovod_tpu.models import ResNet50
 
+    # BENCH_MODEL swaps the convnet under test: the reference's scaling
+    # anchors are Inception V3 / ResNet / VGG-16 (docs/benchmarks.md:3-6);
+    # the judged default stays resnet50.
+    model_name = os.environ.get("BENCH_MODEL", "resnet50")
+    default_size = {"inception_v3": 299}.get(model_name, 224)
     batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "128"))
-    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", str(default_size)))
     warmup_iters = int(os.environ.get("BENCH_WARMUP", "5"))
     timed_batches = int(os.environ.get("BENCH_ITERS", "30"))
     windows = int(os.environ.get("BENCH_WINDOWS", "4"))
     batch = batch_per_chip * nchips
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, remat=remat)
+    if remat and model_name != "resnet50":
+        raise SystemExit(
+            f"BENCH_REMAT=1 is only plumbed for resnet50, not "
+            f"{model_name!r} — running without remat would report memory "
+            "numbers for a configuration you didn't ask for")
+    if model_name == "resnet50":
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, remat=remat)
+    elif model_name == "inception_v3":
+        from horovod_tpu.models import InceptionV3
+        model = InceptionV3(num_classes=1000, dtype=jnp.bfloat16)
+    elif model_name == "vgg16":
+        from horovod_tpu.models import VGG16
+        model = VGG16(num_classes=1000, dtype=jnp.bfloat16)
+    else:
+        raise SystemExit(f"unknown BENCH_MODEL {model_name!r}")
     rng = jax.random.PRNGKey(42)
     # Generate the global batch already sharded over the mesh so no single
     # chip ever holds it (the reference generates per-rank data locally,
@@ -181,22 +200,28 @@ def bench_resnet(jax, hvd, mesh, nchips):
     images, labels = make_batch(rng)
     variables = synth_variables(
         jax, lambda r: model.init(r, images[:1], train=True), rng)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_bn = bool(batch_stats)   # VGG-16 is BN-free
 
     def loss_fn(params, batch_stats, batch):
         imgs, lbls = batch
-        logits, mut = model.apply(
-            {"params": params, "batch_stats": batch_stats}, imgs,
-            train=True, mutable=["batch_stats"])
+        if has_bn:
+            logits, mut = model.apply(
+                {"params": params, "batch_stats": batch_stats}, imgs,
+                train=True, mutable=["batch_stats"])
+            batch_stats = mut["batch_stats"]
+        else:
+            logits = model.apply({"params": params}, imgs, train=True)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, lbls).mean()
-        return loss, mut["batch_stats"]
+        return loss, batch_stats
 
     tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
     # batch_stats are computed per-shard from the micro-batch, so they must
     # be synced (on one chip the pmean over a size-1 axis is free in XLA).
-    sync_aux = os.environ.get("BENCH_SYNC_AUX", "1") == "1"
+    sync_aux = (os.environ.get("BENCH_SYNC_AUX", "1") == "1") and has_bn
     # steps_per_call > 1 scans several optimizer steps inside one XLA
     # program, amortizing the ~2.4 ms/step host-dispatch latency measured
     # on the tunneled chip (docs/benchmarks.md).
@@ -242,7 +267,8 @@ def bench_resnet(jax, hvd, mesh, nchips):
         nbytes *= spc
     if flops is None:
         flops = (3 * 4.1e9 * batch_per_chip * spc
-                 if image_size == 224 else None)
+                 if model_name == "resnet50" and image_size == 224
+                 else None)
     mfu = None
     achieved = None
     if flops:
@@ -255,11 +281,15 @@ def bench_resnet(jax, hvd, mesh, nchips):
     if nbytes and peak_bw:
         hbm_util = (nbytes / (dt / timed_batches)) / peak_bw
 
+    # The Pascal anchor is ResNet-101 throughput; a cross-model ratio
+    # would be meaningless, so only the (comparable) resnet leg reports it.
+    is_resnet = model_name == "resnet50"
     return {
-        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "metric": f"{model_name}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+        "vs_baseline": (round(per_chip / BASELINE_PER_DEVICE, 3)
+                        if is_resnet else None),
         "step_time_ms": round(step_ms, 2),
         "batch_per_chip": batch_per_chip,
         "device_kind": kind,
@@ -272,10 +302,10 @@ def bench_resnet(jax, hvd, mesh, nchips):
         # dominated (some of those accesses are served from VMEM).
         "xla_bytes_over_hbm_peak": (round(hbm_util, 4)
                                     if hbm_util is not None else None),
-        "baseline": "resnet101 103.55 img/s/device (16x Pascal, "
-                    "docs/benchmarks.md:22-39 — the reference's only "
-                    "published absolute throughput; no resnet50 number "
-                    "exists)",
+        "baseline": ("resnet101 103.55 img/s/device (16x Pascal, "
+                     "docs/benchmarks.md:22-39 — the reference's only "
+                     "published absolute throughput; no resnet50 number "
+                     "exists)") if is_resnet else None,
     }
 
 

@@ -56,10 +56,16 @@ def main():
         # coordinator env plus the launchers JAX auto-detects (Cloud TPU
         # metadata, Slurm, Open MPI).
         import os
-        if any(os.environ.get(v) for v in (
+        multi_task = any(
+            int(os.environ.get(v) or 1) > 1
+            for v in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"))
+        # TPU_WORKER_HOSTNAMES exists on single-host TPU VMs too; only
+        # >1 comma-separated workers indicate a pod.
+        multi_host_tpu = len([h for h in os.environ.get(
+            "TPU_WORKER_HOSTNAMES", "").split(",") if h]) > 1
+        if multi_task or multi_host_tpu or any(os.environ.get(v) for v in (
                 "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-                "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
-                "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")):
+                "MEGASCALE_COORDINATOR_ADDRESS")):
             raise
 
     hvd.init()
