@@ -160,7 +160,12 @@ def bench_resnet(jax, hvd, mesh, nchips):
     # the judged default stays resnet50.
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
     default_size = {"inception_v3": 299}.get(model_name, 224)
-    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "128"))
+    # Model-aware default batch: 128 @299 through V3 would OOM a 16 GB
+    # chip (the documented working config is 32, docs/benchmarks.md);
+    # VGG's fc activations similarly cap lower than ResNet's.
+    default_batch = {"inception_v3": 32, "vgg16": 64}.get(model_name, 128)
+    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP",
+                                        str(default_batch)))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", str(default_size)))
     warmup_iters = int(os.environ.get("BENCH_WARMUP", "5"))
     timed_batches = int(os.environ.get("BENCH_ITERS", "30"))
@@ -336,6 +341,9 @@ def bench_transformer(jax, hvd, mesh, nchips):
     attn = os.environ.get("BENCH_TLM_ATTN", "flash")
     batch = batch_per_chip * nchips
 
+    # ln_dtype stays f32: bf16 LN measured no speedup here (XLA already
+    # fuses the dtype converts into neighbouring ops) — keep the
+    # precision.
     model = TransformerLM(vocab=vocab, dim=dim, depth=depth,
                           num_heads=heads, max_len=seq, attn=attn,
                           dtype=jnp.bfloat16, head_dtype=jnp.bfloat16)

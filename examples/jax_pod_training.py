@@ -58,7 +58,8 @@ def main():
         import os
         multi_task = any(
             int(os.environ.get(v) or 1) > 1
-            for v in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"))
+            for v in ("SLURM_NTASKS", "SLURM_NPROCS",
+                      "SLURM_STEP_NUM_TASKS", "OMPI_COMM_WORLD_SIZE"))
         # TPU_WORKER_HOSTNAMES exists on single-host TPU VMs too; only
         # >1 comma-separated workers indicate a pod.
         multi_host_tpu = len([h for h in os.environ.get(

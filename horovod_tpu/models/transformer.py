@@ -93,11 +93,15 @@ class Block(nn.Module):
     sp_axis: Any = RANKS_AXIS
     tp_axis: Any = None
     dtype: Any = jnp.bfloat16
+    # LayerNorm compute dtype: f32 is the safe default; bf16 keeps the
+    # residual stream out of f32 round-trips (~2x LN HBM traffic) at the
+    # usual bf16-training precision trade (stats over d_model elements).
+    ln_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         C = x.shape[-1]
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(dtype=self.ln_dtype, name="ln1")(x)
         if self.tp_axis:
             # Megatron layout: heads and MLP hidden sharded over tp_axis,
             # one psum per sub-block (see parallel/tensor_parallel.py).
@@ -105,12 +109,12 @@ class Block(nn.Module):
                 TPMlp, TPSelfAttention)
             x = x + TPSelfAttention(self.num_heads, axis=self.tp_axis,
                                     dtype=self.dtype, name="attn")(h)
-            h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+            h = nn.LayerNorm(dtype=self.ln_dtype, name="ln2")(x)
             return x + TPMlp(self.mlp_ratio * C, C, axis=self.tp_axis,
                              dtype=self.dtype, name="mlp")(h)
         x = x + Attention(self.num_heads, self.attn, self.sp_axis,
                           self.dtype, name="attn")(h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(dtype=self.ln_dtype, name="ln2")(x)
         h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype,
                      param_dtype=jnp.float32, name="fc1")(h)
         h = nn.gelu(h)
@@ -120,13 +124,13 @@ class Block(nn.Module):
 
 
 def _apply_block_stack(x, *, num_heads, depth, mlp_ratio, attn, sp_axis,
-                       tp_axis, dtype):
+                       tp_axis, dtype, ln_dtype=jnp.float32):
     """Run ``depth`` Blocks named ``block_{i}`` in the caller's flax scope
     (shared by TransformerLM and BlockStack so their param trees agree)."""
     for i in range(depth):
         x = Block(num_heads, mlp_ratio=mlp_ratio, attn=attn,
                   sp_axis=sp_axis, tp_axis=tp_axis, dtype=dtype,
-                  name=f"block_{i}")(x)
+                  ln_dtype=ln_dtype, name=f"block_{i}")(x)
     return x
 
 
@@ -148,13 +152,15 @@ class BlockStack(nn.Module):
     sp_axis: Any = RANKS_AXIS
     tp_axis: Any = None
     dtype: Any = jnp.bfloat16
+    ln_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         return _apply_block_stack(
             x, num_heads=self.num_heads, depth=self.depth,
             mlp_ratio=self.mlp_ratio, attn=self.attn,
-            sp_axis=self.sp_axis, tp_axis=self.tp_axis, dtype=self.dtype)
+            sp_axis=self.sp_axis, tp_axis=self.tp_axis, dtype=self.dtype,
+            ln_dtype=self.ln_dtype)
 
 
 class TransformerLM(nn.Module):
@@ -180,6 +186,8 @@ class TransformerLM(nn.Module):
     # ~20% of a d=2048/vocab=32k training step on v5e, docs/benchmarks.md)
     # — cast the logits back to f32 for the softmax in the loss.
     head_dtype: Any = jnp.float32
+    # LayerNorm compute dtype (see Block.ln_dtype); bf16 for max MFU.
+    ln_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
@@ -203,7 +211,7 @@ class TransformerLM(nn.Module):
         x = _apply_block_stack(
             x, num_heads=self.num_heads, depth=self.depth, mlp_ratio=4,
             attn=self.attn, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
-            dtype=self.dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+            dtype=self.dtype, ln_dtype=self.ln_dtype)
+        x = nn.LayerNorm(dtype=self.ln_dtype, name="ln_f")(x)
         return nn.Dense(self.vocab, use_bias=False, dtype=self.head_dtype,
                         param_dtype=jnp.float32, name="head")(x)
