@@ -45,15 +45,16 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
     else a flat psum/pmean.  ``compression`` casts to the wire dtype around
     the collective (reference ``Compression.fp16``).
 
-    ``fuse=True`` reduces every leaf in ONE multi-operand collective
-    primitive (a single combined AllReduce HLO) instead of one per tensor
-    — the in-jit analogue of the reference's fusion buffer
-    (``operations.cc:1807-1842``), with zero gather/scatter copies because
-    XLA's tuple AllReduce takes the leaves in place.  On the hierarchical
-    ('dcn', 'ici') mesh, fusion concatenates each wire dtype's leaves
-    into one flat buffer and runs the three-stage hierarchy once per
-    dtype (3 collectives instead of 3 per tensor — one HBM copy each way
-    buys fewer DCN launches, the tier the hierarchy exists to spare).
+    Fusion story (the in-jit analogue of the reference's fusion buffer,
+    ``operations.cc:1807-1842``): on a FLAT mesh, one pmean/psum
+    primitive binds per leaf and XLA's AllReduce-combiner pass batches
+    the adjacent collectives itself — explicit concat staging would only
+    add copies, so ``fuse`` is a no-op there.  On the hierarchical
+    ('dcn', 'ici') mesh the three staged collectives per tensor defeat
+    that combiner, so ``fuse=True`` concatenates each wire dtype's
+    leaves into bounded flat buckets and runs the three-stage hierarchy
+    once per bucket (one HBM copy each way buys far fewer DCN launches,
+    the tier the hierarchy exists to spare).
     """
     hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
 
@@ -101,6 +102,9 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
                         red[offset:offset + n].reshape(c.shape), ctx)
                     offset += n
         return jax.tree.unflatten(treedef, out)
+    # Flat mesh: per-leaf collectives; XLA's AllReduce combiner batches
+    # them (an explicit concat here measured as a wash on v5e and would
+    # add two full-gradient copies).
     wire = [c for c, _ in compressed]
     wire = lax.pmean(wire, axis_names) if average else lax.psum(
         wire, axis_names)
@@ -402,7 +406,7 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh):
 
     def spmd_body(params, aux_state, batch):
         metrics = apply_fn(params, aux_state, batch)
-        return jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
+        return lax.pmean(metrics, axes)   # pmean maps over the pytree
 
     step = shard_map(
         spmd_body, mesh=mesh,

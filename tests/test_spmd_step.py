@@ -3,8 +3,9 @@ and the single-chip plain-jit fast path.
 
 The reference's hot path is one optimizer step per launch; the TPU-native
 builder adds ``steps_per_call`` (scan several steps into one XLA program
-to amortize host dispatch) and reduces every gradient in one multi-operand
-collective (the in-jit analogue of the fusion buffer,
+to amortize host dispatch) and a fusion story for gradient reduction
+(XLA's AllReduce combiner on flat meshes; explicit bounded buckets on
+the hierarchical mesh — the analogue of the fusion buffer,
 ``operations.cc:1807-1842``).  All variants must be trajectory-exact
 against the base configuration.
 """
@@ -69,7 +70,7 @@ def test_steps_per_call_matches_one_step_loop(hvd):
 
 
 def test_fused_reduce_matches_per_leaf(hvd):
-    """One multi-operand pmean over all leaves == per-leaf pmean."""
+    """Tree-level pmean binding over all leaves == per-leaf pmean."""
     mesh = hvd.ranks_mesh()
     n = hvd.size()
     rng = np.random.RandomState(1)
