@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 import horovod_tpu.jax as hvd_jax
@@ -99,14 +99,13 @@ def main():
     step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
 
     # Multi-controller input contract: each process supplies only the rows
-    # owned by ITS ranks; the global array spans the pod.
-    sharding = NamedSharding(mesh, P("ranks"))
+    # owned by ITS ranks; shard_for_process assembles the global array
+    # (plain sharded device_put when single-controller).
+    from horovod_tpu.data import shard_for_process
     rows = batch // hvd.process_count()
     lo = hvd.process_index() * rows
-    x = jax.make_array_from_process_local_data(
-        sharding, x_global[lo:lo + rows])
-    y = jax.make_array_from_process_local_data(
-        sharding, y_global[lo:lo + rows])
+    x, y = shard_for_process(
+        (x_global[lo:lo + rows], y_global[lo:lo + rows]), mesh)
 
     aux = {}
     loss0 = loss = None

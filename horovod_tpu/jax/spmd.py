@@ -418,7 +418,8 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh):
 
 def shard_batch(batch, mesh: Mesh):
     """Device-put a host batch with its leading dim sharded over all mesh
-    axes (the input-pipeline side of the data-parallel contract)."""
-    spec = P(tuple(mesh.axis_names))
-    return jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), batch)
+    axes (the input-pipeline side of the data-parallel contract).
+    Delegates to :func:`horovod_tpu.data.shard_for_process`, which also
+    handles the multi-controller per-process-shard assembly."""
+    from horovod_tpu.data import shard_for_process
+    return shard_for_process(batch, mesh)

@@ -48,7 +48,7 @@ if process_id >= 0:
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
-from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu.jax.spmd import make_train_step  # noqa: E402
@@ -84,17 +84,18 @@ tx = optax.sgd(0.1)
 opt_state = tx.init(params)
 step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
 
-sharding = NamedSharding(mesh, P("ranks"))
+# Each process contributes only its local rows of the global batch —
+# the multi-controller input-pipeline contract, packaged by
+# horovod_tpu.data.shard_for_process (plain sharded device_put when
+# single-controller).
+from horovod_tpu.data import shard_for_process  # noqa: E402
+
 if process_id >= 0:
-    # Each process contributes only its local rows of the global batch —
-    # the multi-controller input-pipeline contract.
     rows = 16 // 4 * devices_per_proc
     lo = process_id * rows
-    x = jax.make_array_from_process_local_data(sharding, X[lo:lo + rows])
-    y = jax.make_array_from_process_local_data(sharding, Y[lo:lo + rows])
+    x, y = shard_for_process((X[lo:lo + rows], Y[lo:lo + rows]), mesh)
 else:
-    x = jax.device_put(X, sharding)
-    y = jax.device_put(Y, sharding)
+    x, y = shard_for_process((X, Y), mesh)
 
 aux = {}
 for _ in range(5):
