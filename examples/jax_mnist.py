@@ -22,7 +22,8 @@ import optax
 
 import horovod_tpu as hvd
 from horovod_tpu import callbacks as hvd_callbacks
-from horovod_tpu.jax.spmd import make_train_step, shard_batch
+from horovod_tpu.data import ShardedLoader, epoch_batches
+from horovod_tpu.jax.spmd import make_train_step
 from horovod_tpu.models import ConvNet
 
 
@@ -95,16 +96,25 @@ def main():
         state, params={"steps": steps_per_epoch})
 
     cbs.on_train_begin()
-    rng_np = np.random.RandomState(1234)
+    train_y32 = train_y.astype(np.int32)
     for epoch in range(args.epochs):
         cbs.on_epoch_begin(epoch)
-        perm = rng_np.permutation(len(train_x))
+        # Step 2 of the recipe: DistributedSampler-style epoch shard —
+        # identical shuffle everywhere, process-strided rows, equal batch
+        # counts (horovod_tpu.data; reference pytorch_mnist.py:98-103).
+        # Each process stages its share of the global batch;
+        # shard_for_process (inside ShardedLoader) assembles the global
+        # sharded array, and the prefetch thread stays a step ahead.
+        loader = ShardedLoader(
+            lambda e=epoch: epoch_batches(
+                train_x, train_y32,
+                global_batch // hvd.process_count(),
+                rank=hvd.process_index(), size=hvd.process_count(),
+                seed=1234 + e),
+            mesh)
         losses = []
-        for b in range(steps_per_epoch):
+        for b, batch in enumerate(loader):
             cbs.on_batch_begin(b)
-            idx = perm[b * global_batch:(b + 1) * global_batch]
-            batch = shard_batch(
-                (train_x[idx], train_y[idx].astype(np.int32)), mesh)
             state.params, _, state.opt_state, loss = train_step(
                 state.params, {}, state.opt_state, batch)
             losses.append(loss)

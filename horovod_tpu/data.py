@@ -39,6 +39,11 @@ def shard_for_process(batch, mesh: Mesh, spec=None):
     shard of the global batch (the pod input contract —
     ``docs/running.md``), assembled with
     ``jax.make_array_from_process_local_data``.
+
+    Contract warning: on a pod every process must pass its OWN rows; if
+    every process holds the identical GLOBAL batch instead, use
+    :func:`horovod_tpu.jax.spmd.shard_batch` — mixing the two contracts
+    silently duplicates rows into an inflated global batch.
     """
     if spec is None:
         spec = P(tuple(mesh.axis_names))

@@ -339,10 +339,16 @@ def make_train_step(
                     raise
             try:
                 # Trace without executing or donating: axis-name use
-                # inside loss_fn surfaces here as a NameError.
+                # inside loss_fn surfaces as a NameError, and e.g.
+                # DistributedOptimizer's SPMD-context detection surfaces
+                # as a TracerArrayConversionError (it falls back to its
+                # eager path when no mesh axis is bound).  ANY plain-
+                # trace failure routes to the shard_map program — a
+                # genuine user bug reproduces there and surfaces with
+                # its real traceback at the call.
                 jax.eval_shape(plain_body, *args)
                 chosen.append(plain_step)
-            except NameError:
+            except Exception:   # noqa: BLE001 — see comment above
                 chosen.append(spmd_step)
         return chosen[0]
 
@@ -419,7 +425,15 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh):
 def shard_batch(batch, mesh: Mesh):
     """Device-put a host batch with its leading dim sharded over all mesh
     axes (the input-pipeline side of the data-parallel contract).
-    Delegates to :func:`horovod_tpu.data.shard_for_process`, which also
-    handles the multi-controller per-process-shard assembly."""
-    from horovod_tpu.data import shard_for_process
-    return shard_for_process(batch, mesh)
+
+    Contract: ``batch`` is the GLOBAL batch, identical on every process —
+    ``device_put`` slices out each process's addressable shards, so this
+    works unchanged on a multi-controller pod where all processes hold
+    the same host value.  When each process instead holds only ITS OWN
+    rows (the scalable pod input pipeline), use
+    :func:`horovod_tpu.data.shard_for_process` — passing a global batch
+    to that helper (or local rows to this one) silently corrupts the
+    global batch composition."""
+    spec = P(tuple(mesh.axis_names))
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), batch)

@@ -245,6 +245,25 @@ def test_single_chip_fast_path_keeps_aux_guard(hvd, single_chip_mesh):
         step(params, {"batch_mean": jnp.zeros(())}, tx.init(params), batch)
 
 
+def test_single_chip_distributed_optimizer_falls_back(hvd,
+                                                      single_chip_mesh):
+    """DistributedOptimizer detects the SPMD context by the bound mesh
+    axis; the plain-jit fast path has none, so its trace fails with a
+    TracerArrayConversionError (its eager fallback on tracers).  The
+    dispatcher must route such configs to the shard_map program — the
+    exact mnist-on-one-chip setup that broke in round 3's verify drive."""
+    import horovod_tpu.jax as hvd_jax
+
+    params, x, y = _problem()
+    tx = hvd_jax.DistributedOptimizer(optax.sgd(0.05), axis_name="ranks")
+    sh = NamedSharding(single_chip_mesh, P("ranks"))
+    batch = (jax.device_put(x, sh), jax.device_put(y, sh))
+    step = make_train_step(_loss_fn, tx, single_chip_mesh,
+                           sync_aux_state=False, donate=False)
+    p, losses = _train(step, params, batch, tx, calls=3)
+    assert losses[-1] < losses[0], losses
+
+
 def test_single_chip_fast_path_matches_spmd_program(hvd, single_chip_mesh):
     """On a 1-device mesh the builder compiles a plain jit program.  Its
     trajectory must match the shard_map SPMD program — exercised via a
