@@ -27,7 +27,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 import horovod_tpu.jax as hvd_jax

@@ -18,7 +18,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 

@@ -20,7 +20,7 @@ import os
 import subprocess
 import threading
 import warnings
-from typing import List, Optional
+from typing import List
 
 from horovod_tpu import wire
 from horovod_tpu.core import Request, Response, env_flag

@@ -31,7 +31,7 @@ from jax import shard_map
 from horovod_tpu.compression import Compressor, NoneCompressor
 from horovod_tpu.parallel._vma import ensure_varying_tree
 from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
-from horovod_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS, RANKS_AXIS
+from horovod_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS
 
 
 def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
