@@ -177,21 +177,29 @@ def single_chip_mesh(hvd):
     return Mesh(np.asarray(jax.devices()[:1]), ("ranks",))
 
 
-def test_train_step_emits_timeline_spans(hvd, tmp_path):
+@pytest.mark.parametrize("backend", ["python", "cpp"])
+def test_train_step_emits_timeline_spans(hvd, tmp_path, backend):
     """The jitted hot path must appear in the Horovod-style timeline next
     to the negotiated spans (VERDICT r2 missing #4): per step a DISPATCH
     span (host call into XLA) and an EXECUTE span (dispatch-return until
-    outputs ready, stamped by the watcher thread)."""
+    outputs ready, stamped by the watcher thread).  Both trace writers
+    (Python and the native CppTimeline) must produce the same span/lane
+    structure."""
     import json
     import time as _time
 
-    from horovod_tpu import basics
+    from horovod_tpu import basics, cpp_core
     from horovod_tpu.timeline import Timeline
 
     path = tmp_path / "timeline.json"
     controller = basics._state.controller
     assert controller.timeline is None
-    controller.timeline = Timeline(str(path))
+    if backend == "cpp":
+        if not cpp_core.available():
+            pytest.skip("native core not built")
+        controller.timeline = cpp_core.CppTimeline(str(path))
+    else:
+        controller.timeline = Timeline(str(path))
     try:
         mesh = hvd.ranks_mesh()
         params, x, y = _problem()
