@@ -341,12 +341,17 @@ def bench_transformer(jax, hvd, mesh, nchips):
     attn = os.environ.get("BENCH_TLM_ATTN", "flash")
     batch = batch_per_chip * nchips
 
-    # ln_dtype stays f32: bf16 LN measured no speedup here (XLA already
-    # fuses the dtype converts into neighbouring ops) — keep the
-    # precision.
+    # f32 vs bf16 LayerNorm: the per-op device profile attributes ~50
+    # ms/step to the f32 LN converts+stats at this shape
+    # (convert_reduce_fusion, docs/benchmarks.md) — bf16 LN is the bench
+    # default; set BENCH_TLM_LN_DTYPE=f32 for the conservative config.
+    ln_dtype = (jnp.float32
+                if os.environ.get("BENCH_TLM_LN_DTYPE", "bf16") == "f32"
+                else jnp.bfloat16)
     model = TransformerLM(vocab=vocab, dim=dim, depth=depth,
                           num_heads=heads, max_len=seq, attn=attn,
-                          dtype=jnp.bfloat16, head_dtype=jnp.bfloat16)
+                          dtype=jnp.bfloat16, head_dtype=jnp.bfloat16,
+                          ln_dtype=ln_dtype)
     from jax.sharding import NamedSharding, PartitionSpec as P
     sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
@@ -360,11 +365,25 @@ def bench_transformer(jax, hvd, mesh, nchips):
         jax, lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32)),
         jax.random.PRNGKey(1))["params"]
 
+    # Memory-efficient fused CE head (default): never holds the (N, vocab)
+    # f32 logits as residuals, which otherwise pushes peak HBM past the
+    # chip and makes XLA auto-rematerialize one convolution per layer
+    # (~40 ms/step measured; docs/benchmarks.md).
+    fused_head = os.environ.get("BENCH_TLM_FUSED_XENT", "1") == "1"
+
     def loss_fn(params, aux, batch):
-        # bf16 head matmul (full MXU rate), f32 softmax for stability.
-        logits = model.apply({"params": params}, batch[:, :-1])
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), batch[:, 1:]).mean()
+        if fused_head:
+            from horovod_tpu.ops.losses import fused_softmax_xent
+            h = model.apply({"params": params}, batch[:, :-1],
+                            return_hidden=True)
+            loss = fused_softmax_xent(
+                h.reshape(-1, dim), params["head"]["kernel"],
+                batch[:, 1:].reshape(-1)).mean()
+        else:
+            # bf16 head matmul (full MXU rate), f32 softmax for stability.
+            logits = model.apply({"params": params}, batch[:, :-1])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch[:, 1:]).mean()
         return loss, aux
 
     tx = optax.sgd(0.01, momentum=0.9)
@@ -387,24 +406,29 @@ def bench_transformer(jax, hvd, mesh, nchips):
     tok_per_sec = batch * seq * timed_batches / dt
     step_ms = dt / timed_batches * 1e3
     kind, peak = peak_flops_per_chip(jax)
-    if flops is None:
-        # Analytic: 6 FLOPs per param per token (fwd+bwd) over the matmul
-        # params + attention's 12*T*d per token, per chip.
-        n_matmul = 12 * depth * dim * dim + vocab * dim
-        flops = (6 * n_matmul + 12 * depth * seq * dim) * (
-            batch_per_chip * seq)
-    mfu = achieved = None
-    if flops:
-        achieved = flops / (dt / timed_batches)
-        if peak:
-            mfu = achieved / peak
+    # MFU by the standard model-FLOPs convention (PaLM appendix B /
+    # Megatron): 6 FLOPs per matmul param per token (fwd+bwd) plus
+    # attention's 12*T*d per token per layer — no credit for recompute,
+    # no causal discount.  XLA's cost model is reported alongside as the
+    # executed-FLOPs view (it counts rematerialization and the fused-CE
+    # backward recompute, but not the Pallas kernels' matmuls, so the
+    # two can land on either side of each other).
+    n_matmul = 12 * depth * dim * dim + vocab * dim
+    model_flops = (6 * n_matmul + 12 * depth * seq * dim) * (
+        batch_per_chip * seq)
+    achieved = model_flops / (dt / timed_batches)
+    mfu = achieved / peak if peak else None
+    mfu_xla = None
+    if flops and peak:
+        mfu_xla = flops / (dt / timed_batches) / peak
     return {
         "transformer_lm": {
             "tokens_per_sec_per_chip": round(tok_per_sec / nchips, 1),
             "step_time_ms": round(step_ms, 2),
             "mfu": (round(mfu, 4) if mfu is not None else None),
-            "achieved_tflops_per_chip": (round(achieved / 1e12, 2)
-                                         if achieved else None),
+            "mfu_xla_cost_model": (round(mfu_xla, 4)
+                                   if mfu_xla is not None else None),
+            "achieved_model_tflops_per_chip": round(achieved / 1e12, 2),
             "dim": dim, "depth": depth, "seq_len": seq,
             "batch_per_chip": batch_per_chip, "attn": attn,
         }
@@ -555,6 +579,9 @@ def main():
     mesh = hvd.ranks_mesh()
     nchips = hvd.size()
 
+    if os.environ.get("BENCH_ONLY") == "transformer":
+        print(json.dumps(bench_transformer(jax, hvd, mesh, nchips)))
+        return
     report = bench_resnet(jax, hvd, mesh, nchips)
     if not args.no_transformer and os.environ.get(
             "BENCH_TRANSFORMER", "1") == "1":

@@ -84,7 +84,13 @@ def main():
     train_step = make_train_step(loss_fn, tx, mesh)
 
     state = hvd_callbacks.TrainingState(params=params, opt_state=opt_state)
-    steps_per_epoch = len(train_x) // global_batch
+    # Derive steps_per_epoch exactly as the loader batches: per-process
+    # rows (n // P) over per-process batch (global_batch // P).  The
+    # naive len(train_x) // global_batch drifts from the real step count
+    # whenever P does not divide global_batch or n, and the warmup
+    # schedule would follow the wrong clock.
+    per_proc_batch = global_batch // hvd.process_count()
+    steps_per_epoch = (len(train_x) // hvd.process_count()) // per_proc_batch
     cbs = hvd_callbacks.CallbackList(
         [
             # Step 4: broadcast initial state from rank 0.

@@ -55,8 +55,15 @@ def main():
         # coordinator env plus the launchers JAX auto-detects (Cloud TPU
         # metadata, Slurm, Open MPI).
         import os
+        def _ntasks(v):
+            # Values like Slurm's "2(x2)" are not plain ints; treat
+            # anything unparseable as not-configured rather than crash
+            # inside this except handler.
+            raw = (os.environ.get(v) or "").strip()
+            return int(raw) if raw.isdigit() else 1
+
         multi_task = any(
-            int(os.environ.get(v) or 1) > 1
+            _ntasks(v) > 1
             for v in ("SLURM_NTASKS", "SLURM_NPROCS",
                       "SLURM_STEP_NUM_TASKS", "OMPI_COMM_WORLD_SIZE"))
         # TPU_WORKER_HOSTNAMES exists on single-host TPU VMs too; only

@@ -79,6 +79,54 @@ def restore(directory: str, epoch: int, like: Any) -> Any:
         restore_args=ocp.checkpoint_utils.construct_restore_args(like))
 
 
+def save_model(directory: str, params: Any, opt_state: Any,
+               epoch: int) -> Optional[str]:
+    """Save a full training state (params + optimizer state) under the
+    ``{"params", "opt_state"}`` convention :func:`load_model` restores.
+    Rank-0-only like :func:`save`."""
+    return save(directory, {"params": params, "opt_state": opt_state},
+                epoch)
+
+
+def load_model(directory: str, optimizer, params_like: Any, *,
+               root_rank: int = 0, average: bool = True,
+               compression=None):
+    """One-call resume with the optimizer re-wrapped distributed — the
+    reference's ``hvd.load_model`` (``horovod/keras/__init__.py:115-148``,
+    ``_impl.py:93-109``: restore the saved model, wrap its optimizer in
+    DistributedOptimizer, broadcast).
+
+    Args:
+      directory: checkpoint directory written by :func:`save_model`.
+      optimizer: the PLAIN optax optimizer (any chain, custom or not) —
+        it is wrapped in :func:`horovod_tpu.jax.DistributedOptimizer`
+        here, exactly like the reference rewraps the deserialized
+        optimizer class.
+      params_like: a params pytree of the right structure/shapes (e.g.
+        from ``model.init``) used both as the restore skeleton and as
+        the fresh state when no checkpoint exists.
+      average / compression: forwarded to ``DistributedOptimizer``.
+
+    Returns ``(params, distributed_tx, opt_state, resume_epoch)``;
+    ``resume_epoch`` is -1 (fresh params/opt_state, still broadcast from
+    ``root_rank``) when the directory holds no checkpoint.  The returned
+    ``opt_state`` preserves the optimizer's own pytree structure through
+    the round trip, custom chains included (the reference round-trips
+    custom optimizers in ``test/test_keras.py:60-183``).
+    """
+    from horovod_tpu.compression import NoneCompressor
+    from horovod_tpu.jax import DistributedOptimizer
+
+    if compression is None:
+        compression = NoneCompressor
+    tx = DistributedOptimizer(optimizer, average=average,
+                              compression=compression)
+    like = {"params": params_like, "opt_state": optimizer.init(params_like)}
+    state, epoch = restore_and_broadcast(directory, like,
+                                         root_rank=root_rank)
+    return state["params"], tx, state["opt_state"], epoch
+
+
 def restore_and_broadcast(directory: str, like: Any,
                           root_rank: int = 0) -> Tuple[Any, int]:
     """Resume protocol (conventions 2+3): the resume epoch is agreed by

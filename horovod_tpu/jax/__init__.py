@@ -98,6 +98,15 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
         return jax.tree.map(one, grads)
     # Eager path: compression is applied per-leaf around the negotiated op.
     leaves, treedef = jax.tree.flatten(grads)
+    if any(isinstance(l, jax.core.Tracer) for l in leaves):
+        axis = axis_name if isinstance(axis_name, str) else tuple(axis_name)
+        raise RuntimeError(
+            f"DistributedOptimizer/allreduce_gradients was traced inside "
+            f"jit without the mesh axis {axis!r} in scope: the eager "
+            f"fallback cannot run on tracers.  Run the update step via "
+            f"horovod_tpu.jax.spmd.make_train_step (or your own "
+            f"jax.shard_map over hvd.ranks_mesh()), or use the in-jit "
+            f"collectives in horovod_tpu.ops.injit inside a plain jit.")
     handles, ctxs = [], []
     for i, leaf in enumerate(leaves):
         c, ctx = compression.compress(jnp.asarray(leaf))

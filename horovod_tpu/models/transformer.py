@@ -36,11 +36,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.ops.flash_attention import flash_attention_auto
+from horovod_tpu.ops.flash_attention import (
+    auto_block, flash_attention_auto, flash_qkv_proj)
 from horovod_tpu.parallel.mesh import RANKS_AXIS
 from horovod_tpu.parallel.ring_attention import (
     full_attention, ring_attention, zigzag_shard_positions)
 from horovod_tpu.parallel.ulysses import ulysses_attention
+
+
+class _QKVKernel(nn.Module):
+    """Declares the same ``kernel`` param an ``nn.Dense(features,
+    use_bias=False)`` would (name, shape, lecun-normal init) and returns
+    it raw — used when the matmul itself lives inside a fused op, so the
+    param tree stays interchangeable with the plain-Dense path."""
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          (in_features, self.features), jnp.float32)
 
 
 class Attention(nn.Module):
@@ -53,6 +67,21 @@ class Attention(nn.Module):
     def __call__(self, x):
         B, T, C = x.shape
         D = C // self.num_heads
+        blk = auto_block(T)
+        if (self.attn == "flash" and D % 128 == 0
+                and (blk == T or blk >= 64)):
+            # Fused-projection fast path: one op computes qkv and runs
+            # the kernels straight off it through head-offset BlockSpecs
+            # — no split slice, no (B, T, H, D) transpose (measured ~25
+            # ms/step of layout copies at the bench shape), and the
+            # (B, T, 3C) projection is recomputed in the backward rather
+            # than held as a residual (docs/benchmarks.md).
+            w = _QKVKernel(3 * C, name="qkv")(C)
+            out = flash_qkv_proj(
+                x.astype(self.dtype), w, self.num_heads, causal=True,
+                interpret=jax.default_backend() != "tpu")
+            return nn.Dense(C, use_bias=False, dtype=self.dtype,
+                            param_dtype=jnp.float32, name="proj")(out)
         qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -190,7 +219,13 @@ class TransformerLM(nn.Module):
     ln_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
+        """``return_hidden=True`` skips the LM-head matmul and returns the
+        final-LN hidden states — pair it with
+        :func:`horovod_tpu.ops.losses.fused_softmax_xent` on
+        ``params["head"]["kernel"]`` so the (T, vocab) logits are never
+        materialized as autodiff residuals (init still uses the default
+        call so the param tree always contains the head)."""
         if self.tp_axis and self.attn != "full":
             raise ValueError(
                 "tp_axis composes with attn='full' only (TP attention "
@@ -213,5 +248,7 @@ class TransformerLM(nn.Module):
             attn=self.attn, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
             dtype=self.dtype, ln_dtype=self.ln_dtype)
         x = nn.LayerNorm(dtype=self.ln_dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         return nn.Dense(self.vocab, use_bias=False, dtype=self.head_dtype,
                         param_dtype=jnp.float32, name="head")(x)

@@ -139,6 +139,32 @@ def _pad_rows_fn(shape: tuple, pad_n: int, dtype: str):
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _unpack_fn(shapes: tuple, avg: tuple, nranks: int, dtype: str):
+    """Jitted slice/reshape/average unpack of the replicated reduced
+    fusion row — the completion-side twin of :func:`_row_build_fn`.
+    Keyed by (entry shapes, average flags) so each per-entry slice/divide
+    lives inside ONE LRU-fenced program instead of retaining a small
+    compiled program per entry per composition forever (and, on the
+    shared-runtime path, costing an extra cross-process dispatch each)."""
+    lengths = tuple(int(np.prod(s)) for s in shapes)
+    floating = np.issubdtype(np.dtype(dtype), np.floating)
+
+    def fn(reduced):
+        outs = []
+        off = 0
+        for s, n, a in zip(shapes, lengths, avg):
+            out = reduced[off:off + n].reshape(s)
+            off += n
+            if a:
+                out = ((out / nranks).astype(dtype) if floating
+                       else out // nranks)
+            outs.append(out)
+        return tuple(outs)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
 def _zero_row_fn(length: int, dtype: str):
     """Jitted placeholder row (broadcast contributions of non-root
     ranks)."""
@@ -301,20 +327,30 @@ class Executor:
             self.timeline.activity_end_all(entries)
             self.timeline.activity_start_all(entries,
                                              "MEMCPY_OUT_FUSION_BUFFER")
-        offset = 0
-        for e, n in zip(entries, lengths):
-            out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
-            offset += n
-            if e.average:
-                # Per-tensor division in the completion layer, like the
-                # reference's callback (mpi_ops_v2.cc:65-71); float divides,
-                # ints floor-divide (torch div_ semantics on old int types).
-                if np.issubdtype(np.dtype(e.dtype), np.floating):
-                    out = (out / nranks).astype(e.dtype) \
-                        if isinstance(out, np.ndarray) else out / nranks
-                else:
-                    out = out // nranks
-            e.callback(Status.OK(), out)
+        if isinstance(reduced, jax.Array):
+            # Per-tensor division in the completion layer, like the
+            # reference's callback (mpi_ops_v2.cc:65-71) — but the whole
+            # slice/reshape/average unpack is ONE LRU-fenced program.
+            outs = _unpack_fn(
+                tuple(tuple(e.per_rank[0].shape) for e in entries),
+                tuple(bool(e.average) for e in entries), nranks,
+                str(dtype))(reduced)
+            for e, out in zip(entries, outs):
+                e.callback(Status.OK(), out)
+        else:
+            offset = 0
+            for e, n in zip(entries, lengths):
+                out = reduced[offset:offset + n].reshape(
+                    e.per_rank[0].shape)
+                offset += n
+                if e.average:
+                    # Float divides; ints floor-divide (torch div_
+                    # semantics on old int types).
+                    if np.issubdtype(np.dtype(e.dtype), np.floating):
+                        out = (out / nranks).astype(e.dtype)
+                    else:
+                        out = out // nranks
+                e.callback(Status.OK(), out)
         if self.timeline:
             self.timeline.activity_end_all(entries)
 
@@ -422,17 +458,25 @@ class DistributedExecutor(Executor):
         if self.timeline:
             self.timeline.activity_start_all(entries,
                                              "MEMCPY_OUT_FUSION_BUFFER")
-        offset = 0
-        for e, n in zip(entries, lengths):
-            out = reduced[offset:offset + n].reshape(e.per_rank[0].shape)
-            offset += n
-            if e.average:
-                if np.issubdtype(dtype, np.floating):
-                    out = (out / nranks).astype(dtype)
-                else:
-                    out = out // nranks
-            e.callback(Status.OK(),
-                       self._to_device(out) if host_out else out)
+        if not host_out:
+            outs = _unpack_fn(
+                tuple(tuple(e.per_rank[0].shape) for e in entries),
+                tuple(bool(e.average) for e in entries), nranks,
+                str(dtype))(reduced)
+            for e, out in zip(entries, outs):
+                e.callback(Status.OK(), out)
+        else:
+            offset = 0
+            for e, n in zip(entries, lengths):
+                out = reduced[offset:offset + n].reshape(
+                    e.per_rank[0].shape)
+                offset += n
+                if e.average:
+                    if np.issubdtype(dtype, np.floating):
+                        out = (out / nranks).astype(dtype)
+                    else:
+                        out = out // nranks
+                e.callback(Status.OK(), self._to_device(out))
         if self.timeline:
             self.timeline.activity_end_all(entries)
 

@@ -47,6 +47,19 @@ from jax.experimental.pallas import tpu as pltpu
 from horovod_tpu.parallel.ring_attention import _NEG_BIG, full_attention
 
 
+def _struct(shape, dtype, *like):
+    """ShapeDtypeStruct for a pallas output, inheriting the union of the
+    inputs' varying-manual-axes: under ``shard_map(check_vma=True)`` the
+    kernel outputs vary over exactly the axes the inputs do, and jax
+    requires that declared explicitly."""
+    vma = frozenset()
+    for l in like:
+        vma |= getattr(jax.typeof(l), "vma", None) or frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _block_mask(qi, kj, block_q, block_k, causal, seq_len):
     """(BQ, BK) validity mask for this block pair, or None when every
     position is valid.  ``seq_len``: real sequence length when the array
@@ -115,10 +128,13 @@ def _live_block(qi, kj, block_q, block_k, causal, seq_len):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                seq_len):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+                seq_len, axes=(1, 2)):
+    qi = pl.program_id(axes[0])
+    kj = pl.program_id(axes[1])
+    nk = pl.num_programs(axes[1])
+    # Packed layout: refs are 4-D blocks (1, 1, block, w) with the head
+    # as its own grid axis; legacy merged layout is 3-D (1, block, w).
+    row8 = (0, 0) if lse_ref.ndim == 4 else (0,)
 
     @pl.when(kj == 0)
     def _init():
@@ -165,8 +181,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
         # lse laid out (BQ, 8) — the minimal last-dim tile the TPU block
         # constraints allow for this narrow per-row scalar.
-        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
-                                      (block_q, 8))
+        lse_ref[row8] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                         (block_q, 8))
 
 
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
@@ -191,8 +207,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, block_q, 8), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T, 8), jnp.float32),
+            _struct((BH, T, D), q.dtype, q, k, v),
+            _struct((BH, T, 8), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -201,6 +217,154 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _fwd_kernel_unrollkv(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         m_scr, l_scr, acc_scr, *, scale, causal,
+                         block_q, block_k, seq_len, nk):
+    """Forward with the WHOLE K/V row resident in VMEM and the KV loop
+    unrolled inside one grid step (grid is (B, H, nq)).  The online
+    softmax makes each KV step's accumulator update depend on the last,
+    but the s = q k^T matmul of step j+1 depends only on the (invariant)
+    q and k tiles — unrolling exposes that to Mosaic's scheduler, which
+    overlaps step j's VPU softmax with step j+1's MXU matmul.  The
+    grid-per-KV-block variant cannot (its per-step bodies serialize) and
+    measured ~51% MXU on v5e; this form measured ~70%+
+    (docs/benchmarks.md).  K/V are also fetched once per (b, h) instead
+    of once per Q block."""
+    qi = pl.program_id(2)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute_for(kj):
+        def _compute(masked: bool):
+            q = q_ref[0]                                   # (BQ, D)
+            k = k_ref[0, kj * block_k:(kj + 1) * block_k, :]
+            v = v_ref[0, kj * block_k:(kj + 1) * block_k, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            ok = (_block_mask(qi, kj, block_q, block_k, causal, seq_len)
+                  if masked else None)
+            if ok is not None:
+                s = jnp.where(ok, s, _NEG_BIG)
+            m_prev = m_scr[...]
+            block_max = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(block_max,
+                                                         m_prev.shape))
+            alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+            p = jnp.exp(s - m_new[:, :1])
+            if ok is not None:
+                p = jnp.where(ok, p, 0.0)
+            l_new = l_scr[...] * alpha + jnp.broadcast_to(
+                jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+            acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[...] = m_new
+            l_scr[...] = l_new
+        return _compute
+
+    for kj in range(nk):
+        live = _live_block(qi, kj, block_q, block_k, causal, seq_len)
+        _masked_dispatch(compute_for(kj), live, qi, kj, block_q,
+                         block_k, causal, seq_len)
+
+    l = jnp.maximum(l_scr[:, :1], 1e-30)
+    o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                     (block_q, 8))
+
+
+# The unrolled-KV forward needs the whole (T, D) K and V rows resident
+# in VMEM (2 x T*D*itemsize) and emits nk copies of the body; beyond
+# these bounds the grid-per-KV-block form takes over.
+_UNROLL_KV_MAX_BYTES = 2 << 20
+_UNROLL_KV_MAX_NK = 16
+
+
+def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
+                interpret, seq_len=None, head_base=(0, 0, 0)):
+    """Forward on head-packed (B, T, C) views (C = H*D): the head is a
+    grid axis and every BlockSpec offsets its last dim by ``h*D``, so no
+    (B, T, H, D) -> (B*H, T, D) transpose copy ever materializes in HBM
+    (measured ~25 ms/step of pure layout copies at the bench shape —
+    docs/benchmarks.md).  ``head_base`` shifts each operand's head-block
+    offset, letting q/k/v be three regions of ONE fused (B, T, 3*H*D)
+    projection (so the qkv split never copies either).  lse comes back
+    as (B, H, T)."""
+    B, T, _ = q.shape
+    nq = T // block_q
+    nk = T // block_k
+    oq, ok_, ov = head_base
+    if (nk <= _UNROLL_KV_MAX_NK
+            and T * D * q.dtype.itemsize <= _UNROLL_KV_MAX_BYTES):
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_unrollkv, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, seq_len=seq_len, nk=nk),
+            grid=(B, H, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D),
+                             lambda b, h, i: (b, i, h + oq)),
+                pl.BlockSpec((1, T, D), lambda b, h, i: (b, 0, h + ok_)),
+                pl.BlockSpec((1, T, D), lambda b, h, i: (b, 0, h + ov)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, h, i: (b, i, h)),
+                pl.BlockSpec((1, 1, block_q, 8),
+                             lambda b, h, i: (b, h, i, 0)),
+            ],
+            out_shape=[
+                _struct((B, T, H * D), q.dtype, q, k, v),
+                _struct((B, H, T, 8), jnp.float32, q, k, v),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel")),
+            interpret=interpret,
+        )(q, k, v)
+        return out, lse[..., 0]
+    grid = (B, H, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               seq_len=seq_len, axes=(2, 3))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, h, i, j: (b, i, h + oq)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, h, i, j: (b, j, h + ok_)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, h, i, j: (b, j, h + ov)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, h, i, j: (b, i, h)),
+            pl.BlockSpec((1, 1, block_q, 8),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            _struct((B, T, H * D), q.dtype, q, k, v),
+            _struct((B, H, T, 8), jnp.float32, q, k, v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
     )(q, k, v)
     return out, lse[..., 0]
@@ -254,14 +418,15 @@ def _bwd_xla(q, k, v, o, lse, do, *, scale, causal, chunk, seq_len=None):
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
                  dk_ref, dv_ref, dk_scr, dv_scr, *,
-                 scale, causal, block_q, block_k, seq_len):
+                 scale, causal, block_q, block_k, seq_len, axes=(1, 2)):
     """Accumulate dk/dv for one KV block while Q blocks stream through
     (grid innermost axis).  The flash-backward identities:
     p = exp(s - lse);  dv += p^T dO;  dS = p * (dO V^T - delta) * scale;
     dk += dS^T Q."""
-    kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    kj = pl.program_id(axes[0])
+    qi = pl.program_id(axes[1])
+    nq = pl.num_programs(axes[1])
+    row8 = (0, 0) if lse_ref.ndim == 4 else (0,)
 
     @pl.when(qi == 0)
     def _init():
@@ -273,8 +438,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
         k = k_ref[0]                                   # (BK, D)
         v = v_ref[0]                                   # (BK, D)
         do = do_ref[0]                                 # (BQ, D)
-        lse = lse_ref[0][:, :1]                        # (BQ, 1)
-        delta = dta_ref[0][:, :1]                      # (BQ, 1)
+        lse = lse_ref[row8][:, :1]                     # (BQ, 1)
+        delta = dta_ref[row8][:, :1]                   # (BQ, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # (BQ, BK)
@@ -308,12 +473,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
                dq_ref, dq_scr, *, scale, causal, block_q, block_k,
-               seq_len):
+               seq_len, axes=(1, 2)):
     """Accumulate dq for one Q block while KV blocks stream through:
     dq += dS @ K with dS = p * (dO V^T - delta) * scale."""
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+    qi = pl.program_id(axes[0])
+    kj = pl.program_id(axes[1])
+    nk = pl.num_programs(axes[1])
+    row8 = (0, 0) if lse_ref.ndim == 4 else (0,)
 
     @pl.when(kj == 0)
     def _init():
@@ -324,8 +490,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = dta_ref[0][:, :1]
+        lse = lse_ref[row8][:, :1]
+        delta = dta_ref[row8][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -349,6 +515,127 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
     @pl.when(kj == nk - 1)
     def _finalize():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                      dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr, *,
+                      scale, causal, block_q, block_k, seq_len):
+    """Single-pass flash backward: dk/dv accumulate per KV block while Q
+    blocks stream (inner grid axis), and dq accumulates into a
+    full-sequence f32 VMEM scratch, so the ``s``/``p``/``dp`` recompute
+    the two-kernel split pays twice is computed once — 5 block matmuls
+    per pair instead of 7:
+    p = exp(s - lse);  dv += p^T dO;  dp = dO V^T;
+    dS = p * (dp - delta) * scale;  dk += dS^T Q;  dq += dS K."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # dq scratch is (nq, block_q, D) — dynamic indexing stays on the
+    # leading (tile) dim, which Mosaic lowers to plain tile addressing
+    # (a dynamic sublane slice of a flat (T, D) scratch lowered ~2x
+    # slower on v5e).
+    # The dq slice for this Q block is zeroed on the first KV pass even
+    # when the block pair is dead (padding tail), so the unconditional
+    # output write below never flushes stale scratch.
+    @pl.when(kj == 0)
+    def _init_dq():
+        dq_scr[qi] = jnp.zeros_like(dq_scr[qi])
+
+    def _compute(masked: bool):
+        q = q_ref[0]                                   # (BQ, D)
+        k = k_ref[0]                                   # (BK, D)
+        v = v_ref[0]                                   # (BK, D)
+        do = do_ref[0]                                 # (BQ, D)
+        lse = lse_ref[0][:, :1]                        # (BQ, 1)
+        delta = dta_ref[0][:, :1]                      # (BQ, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (BQ, BK)
+        p = jnp.exp(s - lse)
+        ok = (_block_mask(qi, kj, block_q, block_k, causal, seq_len)
+              if masked else None)
+        if ok is not None:
+            p = jnp.where(ok, p, 0.0)
+        # Operands cast to the input dtype so the MXU runs at native
+        # rate; every accumulator stays f32.
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (BQ, BK)
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_scr[qi] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _live_block(qi, kj, block_q, block_k, causal, seq_len)
+    _masked_dispatch(_compute, live, qi, kj, block_q, block_k, causal,
+                     seq_len)
+
+    @pl.when(qi == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    # dq is only complete after the last KV pass; earlier writes flush
+    # partial sums that the final pass overwrites (a (BQ, D) VMEM copy
+    # per step — noise next to the block matmuls).
+    dq_ref[0] = dq_scr[qi].astype(dq_ref.dtype)
+
+
+# Widest dq scratch the fused backward may allocate: f32 full-sequence
+# accumulator.  4 MB = T 8192 at D=128 — past that the split two-kernel
+# path takes over (ring/Ulysses shard T across chips long before then).
+_FUSED_DQ_SCRATCH_BYTES = 4 << 20
+
+
+def _bwd_pallas_fused(q, k, v, o, lse, do, *, scale, causal, block_q,
+                      block_k, interpret, seq_len=None):
+    """Fused one-pass flash backward (see :func:`_bwd_fused_kernel`)."""
+    BH, T, D = q.shape
+    nq = T // block_q
+    nk = T // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                   # (BH, T)
+    lse8 = jnp.broadcast_to(lse[..., None], (BH, T, 8))
+    delta8 = jnp.broadcast_to(delta[..., None], (BH, T, 8))
+
+    specs = dict(
+        q=pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+        kv=pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        row8=pl.BlockSpec((1, block_q, 8), lambda b, j, i: (b, i, 0)),
+    )
+    dk, dv, dq = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len),
+        grid=(BH, nk, nq),
+        in_specs=[specs["q"], specs["kv"], specs["kv"],
+                  specs["q"], specs["row8"], specs["row8"]],
+        out_specs=[specs["kv"], specs["kv"], specs["q"]],
+        out_shape=[_struct((BH, T, D), k.dtype, q, k, v, do),
+                   _struct((BH, T, D), v.dtype, q, k, v, do),
+                   _struct((BH, T, D), q.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((nq, block_q, D), jnp.float32)],
+        # The KV axis carries the dq accumulator across steps, so it is
+        # "arbitrary" here (it was "parallel" in the split dkdv kernel).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse8, delta8)
+    return dq, dk, dv
 
 
 def _bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
@@ -378,8 +665,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         in_specs=[row_specs["q"], row_specs["kv"], row_specs["kv"],
                   row_specs["q"], row_specs["row8"], row_specs["row8"]],
         out_specs=[row_specs["kv"], row_specs["kv"]],
-        out_shape=[jax.ShapeDtypeStruct((BH, T, D), k.dtype),
-                   jax.ShapeDtypeStruct((BH, T, D), v.dtype)],
+        out_shape=[_struct((BH, T, D), k.dtype, q, k, v, do),
+                   _struct((BH, T, D), v.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -400,13 +687,244 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         in_specs=[q_specs["q"], q_specs["kv"], q_specs["kv"],
                   q_specs["q"], q_specs["row8"], q_specs["row8"]],
         out_specs=[q_specs["q"]],
-        out_shape=[jax.ShapeDtypeStruct((BH, T, D), q.dtype)],
+        out_shape=[_struct((BH, T, D), q.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse8, delta8)
     return dq, dk, dv
+
+
+def _bwd_pallas_packed(q, k, v, o, lse, do, H, D, *, scale, causal,
+                       block_q, block_k, interpret, seq_len=None,
+                       head_base=(0, 0, 0)):
+    """Split flash backward on head-packed (B, T, C) views (see
+    :func:`_fwd_packed`); ``lse`` arrives as (B, H, T) and ``o``/``do``
+    are head-merged (B, T, H*D)."""
+    B, T, _ = q.shape
+    C = H * D
+    nq = T // block_q
+    nk = T // block_k
+    oq, ok_, ov = head_base
+    # Per-head delta = rowsum(dO * O): reduce D inside each head.
+    delta = jnp.sum((do.astype(jnp.float32)
+                     * o.astype(jnp.float32)).reshape(B, T, H, D),
+                    axis=-1).transpose(0, 2, 1)               # (B, H, T)
+    lse8 = jnp.broadcast_to(lse[..., None], (B, H, T, 8))
+    delta8 = jnp.broadcast_to(delta[..., None], (B, H, T, 8))
+
+    kv_specs = dict(
+        q=pl.BlockSpec((1, block_q, D),
+                       lambda b, h, j, i: (b, i, h + oq)),
+        k=pl.BlockSpec((1, block_k, D),
+                       lambda b, h, j, i: (b, j, h + ok_)),
+        v=pl.BlockSpec((1, block_k, D),
+                       lambda b, h, j, i: (b, j, h + ov)),
+        do=pl.BlockSpec((1, block_q, D), lambda b, h, j, i: (b, i, h)),
+        out=pl.BlockSpec((1, block_k, D), lambda b, h, j, i: (b, j, h)),
+        row8=pl.BlockSpec((1, 1, block_q, 8),
+                          lambda b, h, j, i: (b, h, i, 0)),
+    )
+    sem4 = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, axes=(2, 3)),
+        grid=(B, H, nk, nq),
+        in_specs=[kv_specs["q"], kv_specs["k"], kv_specs["v"],
+                  kv_specs["do"], kv_specs["row8"], kv_specs["row8"]],
+        out_specs=[kv_specs["out"], kv_specs["out"]],
+        out_shape=[_struct((B, T, C), k.dtype, q, k, v, do),
+                   _struct((B, T, C), v.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=sem4,
+        interpret=interpret,
+    )(q, k, v, do, lse8, delta8)
+
+    q_specs = dict(
+        q=pl.BlockSpec((1, block_q, D),
+                       lambda b, h, i, j: (b, i, h + oq)),
+        k=pl.BlockSpec((1, block_k, D),
+                       lambda b, h, i, j: (b, j, h + ok_)),
+        v=pl.BlockSpec((1, block_k, D),
+                       lambda b, h, i, j: (b, j, h + ov)),
+        do=pl.BlockSpec((1, block_q, D), lambda b, h, i, j: (b, i, h)),
+        out=pl.BlockSpec((1, block_q, D), lambda b, h, i, j: (b, i, h)),
+        row8=pl.BlockSpec((1, 1, block_q, 8),
+                          lambda b, h, i, j: (b, h, i, 0)),
+    )
+    dq, = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, axes=(2, 3)),
+        grid=(B, H, nq, nk),
+        in_specs=[q_specs["q"], q_specs["k"], q_specs["v"],
+                  q_specs["do"], q_specs["row8"], q_specs["row8"]],
+        out_specs=[q_specs["out"]],
+        out_shape=[_struct((B, T, C), q.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=sem4,
+        interpret=interpret,
+    )(q, k, v, do, lse8, delta8)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_packed(q, k, v, H, scale, causal, block_q, block_k,
+                  bwd_block_q, bwd_block_k, interpret, seq_len):
+    D = q.shape[2] // H
+    out, _ = _fwd_packed(q, k, v, H, D, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, seq_len=seq_len)
+    return out
+
+
+def _flash_packed_fwd(q, k, v, H, scale, causal, block_q, block_k,
+                      bwd_block_q, bwd_block_k, interpret, seq_len):
+    D = q.shape[2] // H
+    out, lse = _fwd_packed(q, k, v, H, D, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret, seq_len=seq_len)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_packed_bwd(H, scale, causal, block_q, block_k, bwd_block_q,
+                      bwd_block_k, interpret, seq_len, res, do):
+    q, k, v, o, lse = res
+    D = q.shape[2] // H
+    return _bwd_pallas_packed(q, k, v, o, lse, do, H, D, scale=scale,
+                              causal=causal, block_q=bwd_block_q,
+                              block_k=bwd_block_k, interpret=interpret,
+                              seq_len=seq_len)
+
+
+_flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _flash_qkv(qkv, H, scale, causal, block_q, block_k, bwd_block_q,
+               bwd_block_k, interpret, seq_len):
+    D = qkv.shape[2] // (3 * H)
+    out, _ = _fwd_packed(qkv, qkv, qkv, H, D, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, seq_len=seq_len,
+                         head_base=(0, H, 2 * H))
+    return out
+
+
+def _flash_qkv_fwd(qkv, H, scale, causal, block_q, block_k, bwd_block_q,
+                   bwd_block_k, interpret, seq_len):
+    D = qkv.shape[2] // (3 * H)
+    out, lse = _fwd_packed(qkv, qkv, qkv, H, D, scale=scale,
+                           causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret,
+                           seq_len=seq_len, head_base=(0, H, 2 * H))
+    return out, (qkv, out, lse)
+
+
+def _flash_qkv_bwd(H, scale, causal, block_q, block_k, bwd_block_q,
+                   bwd_block_k, interpret, seq_len, res, do):
+    qkv, o, lse = res
+    D = qkv.shape[2] // (3 * H)
+    dq, dk, dv = _bwd_pallas_packed(
+        qkv, qkv, qkv, o, lse, do, H, D, scale=scale, causal=causal,
+        block_q=bwd_block_q, block_k=bwd_block_k, interpret=interpret,
+        seq_len=seq_len, head_base=(0, H, 2 * H))
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flash_qkv.defvjp(_flash_qkv_fwd, _flash_qkv_bwd)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _flash_qkv_proj(x, w, H, scale, causal, block_q, block_k,
+                    bwd_block_q, bwd_block_k, interpret, seq_len):
+    out, _ = _flash_qkv_proj_fwd(x, w, H, scale, causal, block_q,
+                                 block_k, bwd_block_q, bwd_block_k,
+                                 interpret, seq_len)
+    return out
+
+
+def _flash_qkv_proj_fwd(x, w, H, scale, causal, block_q, block_k,
+                        bwd_block_q, bwd_block_k, interpret, seq_len):
+    D = w.shape[1] // (3 * H)
+    qkv = jax.lax.dot_general(
+        x, w.astype(x.dtype), (((2,), (0,)), ((), ())))   # (B, T, 3C)
+    out, lse = _fwd_packed(qkv, qkv, qkv, H, D, scale=scale,
+                           causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret,
+                           seq_len=seq_len, head_base=(0, H, 2 * H))
+    # qkv is NOT saved: the backward recomputes it from (x, w) — one
+    # extra (B*T, C) @ (C, 3C) matmul in exchange for never holding the
+    # (B, T, 3C) projection as a residual (201 MB/layer at the bench
+    # shape; the dropped ~2.4 GB is what keeps XLA's auto-remat from
+    # re-deriving a convolution per layer, docs/benchmarks.md).
+    return out, (x, w, out, lse)
+
+
+def _flash_qkv_proj_bwd(H, scale, causal, block_q, block_k, bwd_block_q,
+                        bwd_block_k, interpret, seq_len, res, do):
+    x, w, o, lse = res
+    D = w.shape[1] // (3 * H)
+    wc = w.astype(x.dtype)
+    qkv = jax.lax.dot_general(x, wc, (((2,), (0,)), ((), ())))
+    dq, dk, dv = _bwd_pallas_packed(
+        qkv, qkv, qkv, o, lse, do, H, D, scale=scale, causal=causal,
+        block_q=bwd_block_q, block_k=bwd_block_k, interpret=interpret,
+        seq_len=seq_len, head_base=(0, H, 2 * H))
+    dqkv = jnp.concatenate([dq, dk, dv], axis=-1)          # (B, T, 3C)
+    dx = jax.lax.dot_general(
+        dqkv, wc, (((2,), (1,)), ((), ()))).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        x, dqkv, (((0, 1), (0, 1)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+_flash_qkv_proj.defvjp(_flash_qkv_proj_fwd, _flash_qkv_proj_bwd)
+
+
+def flash_qkv_proj(x, w, num_heads: int, *, causal: bool = True,
+                   scale: Optional[float] = None,
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None,
+                   bwd_block_q: Optional[int] = None,
+                   bwd_block_k: Optional[int] = None,
+                   interpret: bool = False,
+                   seq_len: Optional[int] = None):
+    """Fused qkv-projection + flash attention: ``x @ w`` -> causal flash
+    -> head-merged (B, T, C) output, with the projection RECOMPUTED in
+    the backward instead of saved (see ``_flash_qkv_proj_fwd``).  ``w``
+    is the (C, 3C) no-bias qkv kernel (q | k | v, head-major); matmuls
+    run in ``x.dtype``.  Same lane-aligned-head constraint as
+    :func:`flash_attention_qkv`."""
+    B, T, _ = x.shape
+    C3 = w.shape[1]
+    if w.shape[0] != x.shape[2] or C3 % (3 * num_heads):
+        raise ValueError(
+            f"flash_qkv_proj: w must be (C, 3*num_heads*D), got "
+            f"{w.shape} for x {x.shape}, num_heads={num_heads}")
+    D = C3 // (3 * num_heads)
+    if D % 128:
+        raise ValueError(
+            f"flash_qkv_proj needs lane-aligned heads (D % 128 == 0), "
+            f"got D={D}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q, block_k, bwd_block_q, bwd_block_k, seq_len = _resolve_blocks(
+        T, "flash_qkv_proj", block_q, block_k, bwd_block_q, bwd_block_k,
+        seq_len, "pad the sequence to a tileable length")
+    return _flash_qkv_proj(x, w, int(num_heads), float(scale),
+                           bool(causal), block_q, block_k,
+                           bwd_block_q, bwd_block_k,
+                           bool(interpret), seq_len)
 
 
 @functools.partial(jax.custom_vjp,
@@ -429,6 +947,18 @@ def _flash_bwd(scale, causal, block_q, block_k, bwd_block_q, bwd_block_k,
                interpret, bwd_impl, seq_len, res, do):
     q, k, v, o, lse = res
     if bwd_impl == "pallas":
+        # The split pair is the measured default on v5e: its shorter
+        # kernel bodies software-pipeline to ~96% MXU on their 7 block
+        # matmuls, while the fused kernel's loop-carried dq scratch
+        # (dynamic per-step slice) defeats Mosaic's cross-step overlap —
+        # 5 matmuls at ~49% lost to 7 at ~96% (docs/benchmarks.md).
+        bwd_impl = "pallas_split"
+    if bwd_impl == "pallas_fused":
+        return _bwd_pallas_fused(q, k, v, o, lse, do, scale=scale,
+                                 causal=causal, block_q=bwd_block_q,
+                                 block_k=bwd_block_k, interpret=interpret,
+                                 seq_len=seq_len)
+    if bwd_impl == "pallas_split":
         return _bwd_pallas(q, k, v, o, lse, do, scale=scale, causal=causal,
                            block_q=bwd_block_q, block_k=bwd_block_k,
                            interpret=interpret, seq_len=seq_len)
@@ -441,17 +971,65 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def auto_block(T: int) -> int:
     """Largest TPU-tileable flash block for sequence length ``T``: ``T``
-    itself when one multiple-of-8 block covers the array, else the largest
-    multiple-of-8 divisor of ``T`` up to 1024 (Mosaic requires blocks'
-    sublane dim divisible by 8 — including a lone block).  Bigger blocks
-    amortize per-grid-step overhead: on v5e at T=2048 the 1024 block
-    measured 2x faster forward and 1.4x faster grad than 256, and
-    1024x1024 is the largest square block whose f32 scores tile fits the
-    16 MB scoped VMEM (2048x1024 exceeds it; docs/benchmarks.md).  0 =
-    cannot tile; :func:`flash_attention_auto` then pads."""
+    itself when one multiple-of-8 block covers the array, else the
+    largest lane-aligned (multiple-of-128) divisor of ``T`` up to 1024,
+    falling back to the largest multiple-of-8 divisor (Mosaic requires
+    blocks' sublane dim divisible by 8 — including a lone block; 128
+    fills whole lanes, so when a choice exists the aligned block avoids
+    padded-lane waste on the scores tile).  Bigger blocks amortize
+    per-grid-step overhead: on v5e at T=2048 the 1024 block measured 2x
+    faster forward and 1.4x faster grad than 256, and 1024x1024 is the
+    largest square block whose f32 scores tile fits the 16 MB scoped
+    VMEM (2048x1024 exceeds it; docs/benchmarks.md).  0 = cannot tile;
+    :func:`flash_attention_auto` then pads."""
     if T <= 1024:
         return T if T % 8 == 0 else 0
-    return max((d for d in range(8, 1025, 8) if T % d == 0), default=0)
+    aligned = max((d for d in range(128, 1025, 128) if T % d == 0),
+                  default=0)
+    any8 = max((d for d in range(8, 1025, 8) if T % d == 0), default=0)
+    # Alignment saves ~15% padded-lane waste; block size amortizes
+    # per-step overhead (1024 measured 2x faster than 256).  Only take
+    # the aligned divisor when it doesn't shrink the block by more than
+    # 2x (e.g. T=2176: prefer 544 over the aligned 128).
+    if aligned and aligned * 2 >= any8:
+        return aligned
+    return any8
+
+
+def _resolve_blocks(T: int, fn_name: str, block_q, block_k, bwd_block_q,
+                    bwd_block_k, seq_len, pad_hint: str):
+    """Shared block defaulting + validation for the three entry points:
+    auto-size missing blocks, clamp to T, enforce divide-T/multiple-of-8
+    (Mosaic's sublane constraint) and the seq_len range.  Returns the
+    four resolved blocks and the normalized seq_len."""
+    if block_q is None or block_k is None:
+        blk = auto_block(T)
+        if blk == 0:
+            raise ValueError(
+                f"{fn_name}: sequence length {T} has no multiple-of-8 "
+                f"block divisor; {pad_hint}")
+        block_q = blk if block_q is None else block_q
+        block_k = blk if block_k is None else block_k
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # Backward blocks default to the forward blocks (see bwd_kv_block
+    # for why not wider); explicit values obey the same constraints.
+    bwd_block_q = block_q if bwd_block_q is None else min(bwd_block_q, T)
+    bwd_block_k = block_k if bwd_block_k is None else min(bwd_block_k, T)
+    for name, b in (("block_q", block_q), ("block_k", block_k),
+                    ("bwd_block_q", bwd_block_q),
+                    ("bwd_block_k", bwd_block_k)):
+        if T % b or b % 8:
+            raise ValueError(
+                f"{fn_name}: {name}={b} must divide T={T} and be a "
+                f"multiple of 8 (Mosaic sublane tiling); {pad_hint}")
+    if seq_len is not None and not 0 < seq_len <= T:
+        raise ValueError(f"{fn_name}: seq_len {seq_len} out of range "
+                         f"for T={T}")
+    if seq_len == T:
+        seq_len = None
+    return (int(block_q), int(block_k), int(bwd_block_q),
+            int(bwd_block_k), seq_len)
 
 
 def flash_attention_auto(q, k, v, *, causal: bool = True,
@@ -524,49 +1102,27 @@ def flash_attention(q, k, v, *, causal: bool = True,
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    if block_q is None or block_k is None:
-        blk = auto_block(T)
-        if blk == 0:
-            raise ValueError(
-                f"flash_attention: sequence length {T} has no "
-                "multiple-of-8 block divisor; use flash_attention_auto "
-                "(pads and masks) or full_attention")
-        block_q = blk if block_q is None else block_q
-        block_k = blk if block_k is None else block_k
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"flash_attention needs T divisible by the block sizes, got "
-            f"T={T}, block_q={block_q}, block_k={block_k}; use "
-            f"flash_attention_auto (pads) or full_attention for ragged "
-            f"lengths")
-    if block_q % 8 or block_k % 8:
-        raise ValueError(
-            f"flash_attention blocks must be multiples of 8 (Mosaic "
-            f"sublane tiling), got block_q={block_q}, block_k={block_k}; "
-            f"use flash_attention_auto (pads) for unaligned lengths")
-    if bwd_impl not in ("pallas", "xla"):
-        raise ValueError(f"bwd_impl must be 'pallas' or 'xla', got "
+    if bwd_impl not in ("pallas", "pallas_fused", "pallas_split", "xla"):
+        raise ValueError(f"bwd_impl must be 'pallas' (auto fused/split), "
+                         f"'pallas_fused', 'pallas_split' or 'xla', got "
                          f"{bwd_impl!r}")
-    if seq_len is not None and not 0 < seq_len <= T:
-        raise ValueError(f"seq_len {seq_len} out of range for T={T}")
-    if seq_len == T:
-        seq_len = None
-    # Backward blocks default to the forward blocks (see bwd_kv_block for
-    # why not wider); explicit values obey the same constraints.
-    if bwd_block_q is None:
-        bwd_block_q = block_q
-    if bwd_block_k is None:
-        bwd_block_k = block_k
-    bwd_block_q = min(bwd_block_q, T)
-    bwd_block_k = min(bwd_block_k, T)
-    if (T % bwd_block_q or T % bwd_block_k
-            or bwd_block_q % 8 or bwd_block_k % 8):
-        raise ValueError(
-            f"flash_attention backward blocks must divide T and be "
-            f"multiples of 8, got T={T}, bwd_block_q={bwd_block_q}, "
-            f"bwd_block_k={bwd_block_k}")
+    block_q, block_k, bwd_block_q, bwd_block_k, seq_len = _resolve_blocks(
+        T, "flash_attention", block_q, block_k, bwd_block_q, bwd_block_k,
+        seq_len, "T divisible by the blocks is required — use "
+        "flash_attention_auto (pads and masks) or full_attention for "
+        "ragged lengths")
+
+    # Head-packed path: lane-aligned head dims run the kernels directly
+    # on (B, T, H*D) views via head-offset BlockSpecs — the reshape is
+    # free (contiguous), so no transpose copy ever hits HBM.  Unaligned
+    # D (or the opt-in fused/xla backwards) use the legacy merged layout.
+    if D % 128 == 0 and bwd_impl in ("pallas", "pallas_split"):
+        out = _flash_packed(
+            q.reshape(B, T, H * D), k.reshape(B, T, H * D),
+            v.reshape(B, T, H * D), int(H), float(scale), bool(causal),
+            int(block_q), int(block_k), int(bwd_block_q),
+            int(bwd_block_k), bool(interpret), seq_len)
+        return out.reshape(B, T, H, D)
 
     def merge(x):   # (B, T, H, D) -> (B*H, T, D)
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
@@ -575,3 +1131,45 @@ def flash_attention(q, k, v, *, causal: bool = True,
                  int(block_q), int(block_k), int(bwd_block_q),
                  int(bwd_block_k), bool(interpret), bwd_impl, seq_len)
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_qkv(qkv, num_heads: int, *, causal: bool = True,
+                        scale: Optional[float] = None,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None,
+                        bwd_block_q: Optional[int] = None,
+                        bwd_block_k: Optional[int] = None,
+                        interpret: bool = False,
+                        seq_len: Optional[int] = None):
+    """Flash attention straight off a fused qkv projection.
+
+    Takes the ``(B, T, 3*C)`` output of one ``Dense(3*C)`` (q | k | v
+    concatenated, each head-major with head dim ``D = C // num_heads``)
+    and returns the head-merged ``(B, T, C)`` attention output.  The
+    kernels read q/k/v via head-offset BlockSpecs into the SAME array,
+    so neither the qkv split nor any (B, T, H, D) transpose ever copies
+    in HBM — at the bench shape those layout copies were ~25 ms/step
+    (docs/benchmarks.md).  Requires lane-aligned heads (``D % 128 ==
+    0``); use :func:`flash_attention` otherwise.  Backward is always the
+    split Pallas pair; the qkv cotangent is one concatenate.
+    """
+    B, T, C3 = qkv.shape
+    if C3 % (3 * num_heads):
+        raise ValueError(
+            f"flash_attention_qkv: last dim {C3} must be 3*num_heads*D, "
+            f"got num_heads={num_heads}")
+    D = C3 // (3 * num_heads)
+    if D % 128:
+        raise ValueError(
+            f"flash_attention_qkv needs lane-aligned heads (D % 128 == "
+            f"0), got D={D}; split the projection and use "
+            f"flash_attention instead")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q, block_k, bwd_block_q, bwd_block_k, seq_len = _resolve_blocks(
+        T, "flash_attention_qkv", block_q, block_k, bwd_block_q,
+        bwd_block_k, seq_len, "pad, or split and use "
+        "flash_attention_auto")
+    return _flash_qkv(qkv, int(num_heads), float(scale), bool(causal),
+                      block_q, block_k, bwd_block_q,
+                      bwd_block_k, bool(interpret), seq_len)

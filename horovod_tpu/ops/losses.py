@@ -1,0 +1,150 @@
+"""Memory-efficient fused softmax cross-entropy for large-vocab LM heads.
+
+The straightforward ``logits = hidden @ W; optax.softmax_cross_entropy``
+materializes an ``(N, vocab)`` f32 logits tensor *and* keeps it (plus
+softmax intermediates) alive as autodiff residuals — at the benchmark
+shape (N = 16384, vocab = 32768) that is ~2 GB of f32 logits and enough
+peak-HBM pressure that XLA auto-rematerializes one convolution per layer
+(measured ~40 ms/step of recompute on v5e, docs/benchmarks.md).
+
+This op computes the same loss with the classic streamed-head schedule
+(public pattern in every large-LM codebase):
+
+* forward: scan over row chunks; each chunk computes its logits tile,
+  reduces it to ``lse`` and the label logit, and DISCARDS the tile —
+  residuals are just ``(hidden, W, labels, lse)``;
+* backward: rescan the chunks, recompute the logits tile, form
+  ``softmax - onehot`` in place and contract it immediately into
+  ``d hidden`` and ``dW``.
+
+Cost: one extra head matmul (the backward recompute) in exchange for
+never holding O(N x vocab) residuals.  All matmuls run in the input
+dtype (bf16 on TPU) with f32 accumulation, so precision matches the
+f32-logits reference within bf16 rounding.
+
+No reference analogue (the reference's models predate large-vocab LM
+heads); cited by SURVEY §5.7's long-context mandate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pick_chunk(n: int, target: int) -> int:
+    """Largest divisor of ``n`` that is <= target (so the scan tiles
+    exactly; callers flatten (B, T) so n is composite in practice)."""
+    if n <= target:
+        return n
+    return max(d for d in range(1, target + 1) if n % d == 0)
+
+
+def _chunk_fwd(h_c, w, labels_c):
+    """One chunk's (loss, lse) from its logits tile; the tile dies here."""
+    logits = jax.lax.dot_general(
+        h_c, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (C, V) f32
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    lse = (m[:, 0] + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)))
+    correct = jnp.take_along_axis(logits, labels_c[:, None], axis=-1)[:, 0]
+    return lse - correct, lse
+
+
+def _chunk_bwd(h_c, w, labels_c, lse_c, g_c):
+    """Recompute one chunk's logits tile and contract ``softmax - onehot``
+    straight into (dh_c, dw_c)."""
+    logits = jax.lax.dot_general(
+        h_c, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (C, V) f32
+    p = jnp.exp(logits - lse_c[:, None])
+    cols = lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    dlogits = ((p - (cols == labels_c[:, None]))
+               * g_c[:, None]).astype(h_c.dtype)         # (C, V)
+    dh_c = jax.lax.dot_general(
+        dlogits, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (C, d)
+    dw_c = jax.lax.dot_general(
+        h_c, dlogits, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (d, V)
+    return dh_c, dw_c
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_softmax_xent(hidden, w, labels, chunk=16384):
+    """Per-token softmax cross-entropy of a linear head, never holding the
+    full logits as a residual.
+
+    Args:
+      hidden: (N, d) activations (any float dtype; matmuls run in this
+        dtype with f32 accumulation).
+      w: (d, V) head weight (cast to ``hidden.dtype`` for the matmuls).
+      labels: (N,) int32 target ids in [0, V).
+      chunk: target rows per logits tile (clamped to the largest divisor
+        of N, so any N works); peak transient is chunk x V f32.  The
+        default keeps the bench shape (16384 x 32k vocab = 2 GB tile) in
+        ONE tile: the tile is transient (never a residual), and a scanned
+        loop measured slower on v5e than one big tile (the while-loop +
+        dh-stacking overhead outweighed the smaller transient,
+        docs/benchmarks.md) — lower it only when chunk x V f32 itself
+        cannot fit.
+
+    Returns: (N,) f32 per-token losses (``lse - logit[label]``) — take
+    ``.mean()`` for the usual reduction.
+    """
+    loss, _ = _xent_fwd_impl(hidden, w, labels, chunk)
+    return loss
+
+
+def _xent_fwd_impl(hidden, w, labels, chunk):
+    n = hidden.shape[0]
+    c = _pick_chunk(n, chunk)
+    wc = w.astype(hidden.dtype)
+    if c == n:
+        loss, lse = _chunk_fwd(hidden, wc, labels)
+        return loss, lse
+    hs = hidden.reshape(n // c, c, -1)
+    ls = labels.reshape(n // c, c)
+
+    def body(_, hl):
+        h_c, l_c = hl
+        return None, _chunk_fwd(h_c, wc, l_c)
+
+    _, (loss, lse) = lax.scan(body, None, (hs, ls))
+    return loss.reshape(n), lse.reshape(n)
+
+
+def _xent_fwd(hidden, w, labels, chunk):
+    loss, lse = _xent_fwd_impl(hidden, w, labels, chunk)
+    return loss, (hidden, w, labels, lse)
+
+
+def _xent_bwd(chunk, res, g):
+    hidden, w, labels, lse = res
+    n, d = hidden.shape
+    c = _pick_chunk(n, chunk)
+    wc = w.astype(hidden.dtype)
+    g = g.astype(jnp.float32)
+    if c == n:
+        dh, dw = _chunk_bwd(hidden, wc, labels, lse, g)
+    else:
+        hs = hidden.reshape(n // c, c, d)
+        ls = labels.reshape(n // c, c)
+        lses = lse.reshape(n // c, c)
+        gs = g.reshape(n // c, c)
+
+        def body(dw_acc, args):
+            h_c, l_c, lse_c, g_c = args
+            dh_c, dw_c = _chunk_bwd(h_c, wc, l_c, lse_c, g_c)
+            return dw_acc + dw_c, dh_c
+
+        dw, dhs = lax.scan(body, jnp.zeros_like(w, jnp.float32),
+                           (hs, ls, lses, gs))
+        dh = dhs.reshape(n, d)
+    return dh.astype(hidden.dtype), dw.astype(w.dtype), None
+
+
+fused_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

@@ -75,7 +75,130 @@ class TestFlashAttention:
             rtol=3e-2, atol=3e-2)
 
 
+class TestPackedLayout:
+    """D % 128 == 0 routes through the head-packed (B, T, C) kernels
+    (head-offset BlockSpecs, no transpose copies) — outputs and grads
+    must match the dense oracle exactly like the merged layout does."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_fwd_matches_oracle(self, hvd, causal):
+        q, k, v = make_qkv(jax.random.PRNGKey(21), 2, 64, 2, 128)
+        got = flash_attention(q, k, v, causal=causal, block_q=16,
+                              block_k=16, interpret=True)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_oracle(self, hvd):
+        q, k, v = make_qkv(jax.random.PRNGKey(22), 1, 32, 2, 128)
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=8,
+                                    block_k=8, interpret=True) ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_padded_seq_len_grads(self, hvd):
+        T, T_pad = 24, 32
+        q, k, v = make_qkv(jax.random.PRNGKey(23), 1, T, 2, 128)
+        pad = [(0, 0), (0, T_pad - T), (0, 0), (0, 0)]
+
+        def loss(q, k, v):
+            out = flash_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, block_q=8, block_k=8, interpret=True,
+                seq_len=T)
+            return (out[:, :T] ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
+
+class TestQkvFused:
+    """flash_attention_qkv reads q/k/v out of one packed (B, T, 3C)
+    tensor via head-offset BlockSpecs; outputs and the qkv cotangent
+    must match splitting first."""
+
+    def _make(self, B=1, T=32, H=2, D=128):
+        qkv = jax.random.normal(jax.random.PRNGKey(31), (B, T, 3 * H * D))
+        return qkv, H, D
+
+    def test_matches_split_path(self, hvd):
+        from horovod_tpu.ops.flash_attention import flash_attention_qkv
+
+        qkv, H, D = self._make()
+        B, T, _ = qkv.shape
+        got = flash_attention_qkv(qkv, H, causal=True, block_q=8,
+                                  block_k=8, interpret=True)
+        q, k, v = (x.reshape(B, T, H, D)
+                   for x in jnp.split(qkv, 3, axis=-1))
+        want = full_attention(q, k, v, causal=True).reshape(B, T, H * D)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_qkv_cotangent_matches_oracle(self, hvd):
+        from horovod_tpu.ops.flash_attention import flash_attention_qkv
+
+        qkv, H, D = self._make(T=24)
+        B, T, _ = qkv.shape
+
+        def loss(qkv):
+            return (flash_attention_qkv(qkv, H, causal=True, block_q=8,
+                                        block_k=8, interpret=True)
+                    ** 2).sum()
+
+        def loss_full(qkv):
+            q, k, v = (x.reshape(B, T, H, D)
+                       for x in jnp.split(qkv, 3, axis=-1))
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss)(qkv)
+        want = jax.grad(loss_full)(qkv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_unaligned_head_raises(self, hvd):
+        from horovod_tpu.ops.flash_attention import flash_attention_qkv
+
+        qkv = jnp.zeros((1, 16, 3 * 2 * 64))
+        with pytest.raises(ValueError, match="lane-aligned"):
+            flash_attention_qkv(qkv, 2, interpret=True)
+
+
 class TestTransformerFlash:
+    def test_model_flash_qkv_path_matches_full(self, hvd):
+        """dim/heads giving D=128 routes Attention through
+        flash_attention_qkv — must equal the attn='full' twin."""
+        from horovod_tpu.models import TransformerLM
+
+        vocab, dim, heads = 64, 256, 2
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, vocab, (2, 32)), jnp.int32)
+        full = TransformerLM(vocab=vocab, dim=dim, depth=1,
+                             num_heads=heads, attn="full",
+                             dtype=jnp.float32)
+        flash = TransformerLM(vocab=vocab, dim=dim, depth=1,
+                              num_heads=heads, attn="flash",
+                              dtype=jnp.float32)
+        params = full.init(jax.random.PRNGKey(0), toks)["params"]
+        want = full.apply({"params": params}, toks)
+        got = flash.apply({"params": params}, toks)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_model_flash_matches_full(self, hvd):
         from horovod_tpu.models import TransformerLM
 
@@ -149,7 +272,8 @@ class TestAutoBlock:
 
 class TestPallasBackward:
     @pytest.mark.parametrize("causal", [True, False])
-    @pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+    @pytest.mark.parametrize("bwd_impl",
+                             ["pallas_fused", "pallas_split", "xla"])
     def test_grads_match_dense_oracle(self, hvd, causal, bwd_impl):
         q, k, v = make_qkv(jax.random.PRNGKey(11), 2, 64, 2, 16)
 
@@ -188,12 +312,14 @@ class TestPallasBackward:
                 np.asarray(g, np.float32), np.asarray(w, np.float32),
                 rtol=1e-2, atol=1e-2)
 
-    def test_uneven_blocks_pallas_bwd(self, hvd):
+    @pytest.mark.parametrize("bwd_impl", ["pallas_fused", "pallas_split"])
+    def test_uneven_blocks_pallas_bwd(self, hvd, bwd_impl):
         q, k, v = make_qkv(jax.random.PRNGKey(13), 1, 48, 2, 8)
 
         def loss(q, k, v):
             return (flash_attention(q, k, v, causal=True, block_q=16,
-                                    block_k=8, interpret=True) ** 2).sum()
+                                    block_k=8, interpret=True,
+                                    bwd_impl=bwd_impl) ** 2).sum()
 
         def loss_full(q, k, v):
             return (full_attention(q, k, v, causal=True) ** 2).sum()
@@ -203,3 +329,70 @@ class TestPallasBackward:
         for g, w in zip(got, want):
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("bwd_impl", ["pallas_fused", "pallas_split"])
+    def test_padded_seq_len_grads(self, hvd, bwd_impl):
+        """Zero-padded inputs with seq_len masking: fused and split
+        backward must both mask the padding tail (the fused kernel's
+        unconditional dq write must flush zeros, not stale scratch)."""
+        T, T_pad = 40, 64
+        q, k, v = make_qkv(jax.random.PRNGKey(14), 1, T, 2, 8)
+        pad = [(0, 0), (0, T_pad - T), (0, 0), (0, 0)]
+
+        def loss(q, k, v):
+            out = flash_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, block_q=16, block_k=16, interpret=True,
+                bwd_impl=bwd_impl, seq_len=T)
+            return (out[:, :T] ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestFlashUnderShardMap:
+    def test_flash_model_trains_under_make_train_step(self, hvd):
+        """attn='flash' (qkv-proj fused path) inside the multi-device
+        shard_map program: pallas outputs must declare vma under
+        check_vma=True (regression — this exact combination failed until
+        the kernels' out_shapes inherited the inputs' vma)."""
+        import optax
+
+        from horovod_tpu.jax.spmd import make_train_step
+        from horovod_tpu.models import TransformerLM
+        from horovod_tpu.ops.losses import fused_softmax_xent
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = hvd.ranks_mesh()
+        n = hvd.size()
+        vocab, dim, T = 64, 256, 32   # D=128 -> packed kernels
+        model = TransformerLM(vocab=vocab, dim=dim, depth=1, num_heads=2,
+                              max_len=T, attn="flash", dtype=jnp.float32)
+        toks = jax.random.randint(jax.random.PRNGKey(0), (n, T + 1), 0,
+                                  vocab, dtype=jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), toks[:1, :T])["params"]
+
+        def loss_fn(params, aux, batch):
+            h = model.apply({"params": params}, batch[:, :-1],
+                            return_hidden=True)
+            loss = fused_softmax_xent(
+                h.reshape(-1, dim), params["head"]["kernel"],
+                batch[:, 1:].reshape(-1)).mean()
+            return loss, aux
+
+        tx = optax.sgd(0.1)
+        step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
+        toks = jax.device_put(
+            toks, NamedSharding(mesh, P(tuple(mesh.axis_names))))
+        opt_state = tx.init(params)
+        losses = []
+        for _ in range(3):
+            params, _, opt_state, loss = step(params, {}, opt_state, toks)
+            losses.append(float(np.asarray(loss)))
+        assert losses[-1] < losses[0]
