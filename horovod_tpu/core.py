@@ -376,12 +376,18 @@ class HandleManager:
         self._cv = threading.Condition(self._lock)
         self._next = 0
         self._results: Dict[int, Optional[Tuple[Status, object]]] = {}
+        # Handles whose payload may launch programs on a shared mesh
+        # runtime (everything except host-path 64-bit dtypes) — the set
+        # the ordering guard counts.
+        self._mesh_hazard: set = set()
 
-    def allocate(self) -> int:
+    def allocate(self, mesh_hazard: bool = False) -> int:
         with self._lock:
             h = self._next
             self._next += 1
             self._results[h] = None
+            if mesh_hazard:
+                self._mesh_hazard.add(h)
             return h
 
     def mark_done(self, handle: int, status: Status, result=None) -> None:
@@ -390,6 +396,7 @@ class HandleManager:
             # caller abandoned a timed-out handle.
             if handle in self._results:
                 self._results[handle] = (status, result)
+                self._mesh_hazard.discard(handle)
                 self._cv.notify_all()
 
     def abandon(self, handle: int) -> None:
@@ -397,6 +404,7 @@ class HandleManager:
         hits the unknown-handle no-op in ``mark_done`` and is discarded."""
         with self._lock:
             self._results.pop(handle, None)
+            self._mesh_hazard.discard(handle)
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -414,6 +422,20 @@ class HandleManager:
     def release(self, handle: int):
         with self._lock:
             self._results.pop(handle, None)
+            self._mesh_hazard.discard(handle)
+
+    def outstanding(self) -> int:
+        """Handles allocated but not yet completed (still in flight)."""
+        with self._lock:
+            return sum(1 for v in self._results.values() if v is None)
+
+    def outstanding_mesh_hazard(self) -> int:
+        """In-flight handles flagged as possibly launching mesh programs
+        (host-path 64-bit ops are excluded — they never touch the shared
+        runtime, so dispatching jitted steps around them is safe)."""
+        with self._lock:
+            return sum(1 for h in self._mesh_hazard
+                       if self._results.get(h) is None)
 
     def _check_known(self, handle: int):
         if handle not in self._results:
@@ -606,6 +628,20 @@ class Controller:
             self._executor = Executor(topology, mesh, self.timeline)
 
     # ------------------------------------------------------------------ API
+
+    def mesh_async_hazard(self) -> int:
+        """Outstanding async eager handles whose collective programs ride
+        the SHARED multi-controller runtime — the count that makes
+        launching another jitted collective program unsafe (each process
+        could interleave the background programs differently; the
+        ordering invariant the reference's coordinator enforces,
+        ``operations.cc:1414-1433``).  0 on disjoint runtimes (TCP data
+        plane) and single-process jobs, where background execution is
+        process-local."""
+        ex = getattr(self, "_executor", None)
+        if ex is None or not getattr(ex, "_mesh_is_global", False):
+            return 0
+        return self.handle_manager.outstanding_mesh_hazard()
 
     def start(self):
         if self.jit_only:

@@ -84,7 +84,9 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
             root_rank: int = -1) -> int:
     ctrl = basics.controller()
     per_rank, resolved = _normalize(tensor, name_prefix, name)
-    handle = ctrl.handle_manager.allocate()
+    from horovod_tpu.ops.executor import _needs_host_path
+    handle = ctrl.handle_manager.allocate(
+        mesh_hazard=not _needs_host_path(per_rank[0].dtype))
 
     def callback(status: Status, result):
         ctrl.handle_manager.mark_done(handle, status, result)

@@ -281,9 +281,11 @@ def _fwd_kernel_unrollkv(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 # The unrolled-KV forward needs the whole (T, D) K and V rows resident
-# in VMEM (2 x T*D*itemsize) and emits nk copies of the body; beyond
-# these bounds the grid-per-KV-block form takes over.
-_UNROLL_KV_MAX_BYTES = 2 << 20
+# in VMEM (2 x T*D*itemsize, double-buffered) and emits nk copies of the
+# body; beyond these bounds the grid-per-KV-block form takes over.  1 MB
+# (T=4096 at D=128 bf16) is the measured limit: at 2 MB rows the full
+# model's VMEM budget fails to compile on v5e.
+_UNROLL_KV_MAX_BYTES = 1 << 20
 _UNROLL_KV_MAX_NK = 16
 
 

@@ -1,0 +1,85 @@
+"""Worker for the jit-only mid-step peer-crash test.
+
+Usage: python _crash_worker.py <process_id> <num_processes> <port>
+
+Joins a 2-process ``jax.distributed`` job (jit-only: no TCP control
+plane), trains a few steps over the global mesh, then process 1 hard-
+crashes MID-TRAINING while process 0 keeps dispatching steps with
+``HOROVOD_TPU_STEP_TIMEOUT_S`` armed.  The survivor must TERMINATE
+promptly — either the runtime surfaces a distributed error, or the step
+watchdog aborts with exit code 83 — never hang indefinitely inside the
+collective.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+process_id = int(sys.argv[1])
+num_processes = int(sys.argv[2])
+port = int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("HOROVOD_TPU_COORD_ADDR", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+os.environ.setdefault("HOROVOD_TPU_STEP_TIMEOUT_S", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"127.0.0.1:{port}",
+                           num_processes=num_processes,
+                           process_id=process_id)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.data import shard_for_process  # noqa: E402
+from horovod_tpu.jax.spmd import make_train_step  # noqa: E402
+
+hvd.init()
+mesh = hvd.ranks_mesh()
+
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+Y = X @ rng.randn(8, 1).astype(np.float32)
+params = {"w": jnp.zeros((8, 1), jnp.float32)}
+
+
+def loss_fn(params, aux, batch):
+    x, y = batch
+    return jnp.mean((x @ params["w"] - y) ** 2), aux
+
+
+tx = optax.sgd(0.1)
+opt_state = tx.init(params)
+step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
+rows = 16 // num_processes
+lo = process_id * rows
+x, y = shard_for_process((X[lo:lo + rows], Y[lo:lo + rows]), mesh)
+
+for i in range(3):
+    params, _, opt_state, loss = step(params, {}, opt_state, (x, y))
+    print(f"STEP {i} LOSS {float(loss)!r}", flush=True)
+
+if process_id == 1:
+    print("CRASHING", flush=True)
+    sys.stdout.flush()
+    os._exit(17)   # hard mid-training crash: no shutdown, sockets drop
+
+# Survivor: keep dispatching.  The collective can never complete; the
+# step watchdog (or a runtime distributed error) must end the process.
+print("SURVIVOR_CONTINUES", flush=True)
+try:
+    for i in range(3, 40):
+        params, _, opt_state, loss = step(params, {}, opt_state, (x, y))
+        print(f"STEP {i} LOSS {float(np.asarray(loss))!r}", flush=True)
+except Exception as exc:   # noqa: BLE001 — a surfaced error is a PASS
+    print(f"SURVIVOR_ERROR {type(exc).__name__}: {str(exc)[:200]}",
+          flush=True)
+    sys.exit(3)
+print("SURVIVOR_FINISHED", flush=True)

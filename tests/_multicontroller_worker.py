@@ -148,4 +148,22 @@ if process_id >= 0 and coord_port:
     assert ctrl.data_bytes() == db0, (db0, ctrl.data_bytes())
     print("EAGER_MESH OK", flush=True)
 
+    # Ordering contract: dispatching the jitted train step while an
+    # async eager collective is outstanding on this SHARED runtime must
+    # raise the guard error (not risk per-process interleaving); after
+    # synchronize() the step must work again.
+    from horovod_tpu.ops import eager
+
+    h = eager.allreduce_async(
+        np.ones((8,), np.float32), name="mc.hazard")
+    try:
+        step(params, aux, opt_state, (x, y))
+        print("ASYNC_GUARD MISSED", flush=True)
+    except RuntimeError as exc:
+        assert "outstanding" in str(exc), str(exc)
+        print("ASYNC_GUARD OK", flush=True)
+    eager.synchronize(h)
+    params, aux, opt_state, loss = step(params, aux, opt_state, (x, y))
+    print(f"POST_GUARD LOSS {float(loss)!r}", flush=True)
+
 print("DONE", flush=True)

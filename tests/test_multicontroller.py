@@ -90,4 +90,51 @@ def test_eager_rides_mesh_on_shared_runtime():
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
         assert "EAGER_MESH OK" in out, out
+        # Misusing *_async (jitted step dispatched with the handle still
+        # outstanding) raises the ordering-contract error on the shared
+        # runtime instead of risking divergent program interleaving —
+        # and the step works again once synchronized (VERDICT r3 #5).
+        assert "ASYNC_GUARD OK" in out, out
+        assert "ASYNC_GUARD MISSED" not in out, out
+        assert "POST_GUARD LOSS" in out, out
         assert "DONE" in out, out
+
+
+def test_jit_only_mid_step_peer_crash_is_bounded():
+    """Jit-only mode, peer dies MID-STEP: the survivor must terminate
+    promptly (step watchdog abort, exit 83, or a surfaced runtime
+    error) rather than block in the XLA collective forever (VERDICT r3
+    #8; the eager path's analogue is the coordinated-abort/stall scan,
+    reference operations.cc:1366-1412)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_crash_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["HOROVOD_TPU_STEP_TIMEOUT_S"] = "8"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    t0 = _time.monotonic()
+    try:
+        out1, _ = procs[1].communicate(timeout=120)
+        out0, _ = procs[0].communicate(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    elapsed = _time.monotonic() - t0
+    assert procs[1].returncode == 17, out1          # the simulated crash
+    assert "CRASHING" in out1, out1
+    # Survivor terminated (not hung), within a bounded window, with a
+    # recognizable diagnostic: watchdog abort (83) or a surfaced error.
+    assert "SURVIVOR_CONTINUES" in out0, out0
+    assert "SURVIVOR_FINISHED" not in out0, out0
+    assert procs[0].returncode in (83, 3), (procs[0].returncode, out0)
+    if procs[0].returncode == 83:
+        assert "step watchdog" in out0, out0
+    assert elapsed < 110, elapsed

@@ -1,0 +1,86 @@
+"""Trace-parsing tests for horovod_tpu.profiling against a fabricated
+Chrome trace (the CPU platform emits no device spans, so the parsers are
+exercised on synthetic data shaped exactly like a real TPU trace)."""
+
+import gzip
+import json
+import os
+
+from horovod_tpu import profiling
+
+
+def write_trace(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return str(tmp_path)
+
+
+def make_events():
+    meta = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 701, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+    ]
+    spans = [
+        # Module span: 10 ms over 2 reps.
+        {"ph": "X", "pid": 3, "tid": 2, "name": "jit_step(123)",
+         "dur": 10_000.0, "ts": 0},
+        # Two instances of one fusion: 1e9 flops, 1e6 bytes in 1 ms each.
+        {"ph": "X", "pid": 3, "tid": 3, "name": "multiply_add_fusion.7",
+         "dur": 1_000.0, "ts": 0,
+         "args": {"model_flops": "1000000000", "bytes_accessed": "1000000",
+                  "source": "/x/site-packages/flax/linear.py:1"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "multiply_add_fusion.9",
+         "dur": 1_000.0, "ts": 2,
+         "args": {"model_flops": "1000000000", "bytes_accessed": "1000000",
+                  "source": "/x/site-packages/flax/linear.py:1"}},
+        # A host span that must be ignored.
+        {"ph": "X", "pid": 701, "tid": 1, "name": "jit_step(123)",
+         "dur": 99_000.0, "ts": 0},
+    ]
+    return meta + spans
+
+
+def test_device_time_ms(tmp_path):
+    d = write_trace(tmp_path, make_events())
+    assert profiling.device_time_ms(d, per=2) == 5.0
+
+
+def test_device_time_none_without_device(tmp_path):
+    evts = [e for e in make_events() if e.get("pid") != 3]
+    d = write_trace(tmp_path, evts)
+    assert profiling.device_time_ms(d) is None
+
+
+def test_per_op_rooflines(tmp_path):
+    d = write_trace(tmp_path, make_events())
+    rows = profiling.per_op_rooflines(d, peak_flops=2e12, peak_bytes=1e9)
+    assert len(rows) == 1
+    r = rows[0]
+    # .N suffix stripped, both instances aggregated.
+    assert r["op"] == "multiply_add_fusion"
+    assert r["count"] == 2
+    assert r["ms"] == 2.0
+    # 2e9 flops / 2e-3 s = 1e12 FLOP/s = 50% of the 2e12 peak.
+    assert r["tflops_per_sec"] == 1.0
+    assert r["pct_of_peak_flops"] == 50.0
+    # 2e6 bytes / 2e-3 s = 1e9 B/s = 100% of peak bw.
+    assert r["pct_of_peak_bw"] == 100.0
+    assert r["source"] == "flax/linear.py:1"
+
+
+def test_capture_returns_dir():
+    import jax.numpy as jnp
+
+    log_dir = profiling.capture(
+        lambda: jnp.ones((8,)).sum().block_until_ready(), iters=1)
+    assert os.path.isdir(log_dir)
+    # CPU platform: parsers must degrade gracefully, not crash.
+    assert profiling.per_op_rooflines(log_dir) == []
