@@ -72,3 +72,72 @@ def test_single_ragged_block_small_T():
         q, k, v, causal=True, block_q=120, block_k=120))(q, k, v)
     assert out.shape == q.shape
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_packed_layout_compiles_and_matches():
+    """D=128 routes through the head-packed kernels (head-offset
+    BlockSpecs + unrolled-KV forward) — hardware Mosaic compile of the
+    round-4 layout, checked against the dense oracle."""
+    from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = make_qkv(jax.random.PRNGKey(4), 1, 1024, 2, 128)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
+        q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_qkv_proj_fused_compiles_and_trains():
+    """flash_qkv_proj (projection recomputed in backward) on hardware:
+    value matches projecting then attending; gradient is finite."""
+    from horovod_tpu.ops.flash_attention import flash_qkv_proj
+    from horovod_tpu.parallel.ring_attention import full_attention
+
+    B, T, H, D = 1, 512, 2, 128
+    C = H * D
+    x = jax.random.normal(jax.random.PRNGKey(5), (B, T, C), jnp.bfloat16)
+    w = (jax.random.normal(jax.random.PRNGKey(6), (C, 3 * C), jnp.float32)
+         * 0.05)
+
+    out = jax.jit(lambda x, w: flash_qkv_proj(x, w, H))(x, w)
+    qkv = (x @ w.astype(x.dtype))
+    q, k, v = (t.reshape(B, T, H, D) for t in jnp.split(qkv, 3, axis=-1))
+    want = full_attention(q, k, v, causal=True).reshape(B, T, C)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+    def loss(x, w):
+        return (flash_qkv_proj(x, w, H).astype(jnp.float32) ** 2).sum()
+
+    dx, dw = jax.jit(jax.grad(loss, argnums=(0, 1)))(x, w)
+    assert np.isfinite(np.asarray(dx, np.float32)).all()
+    assert np.isfinite(np.asarray(dw)).all()
+
+
+def test_unaligned_lane_block_T1000():
+    """T=1000 runs as ONE 1000-wide (8-aligned, non-128-aligned) block —
+    the configuration the round-3 advisor flagged as CI-only; compile
+    and match the oracle on real Mosaic."""
+    from horovod_tpu.ops.flash_attention import auto_block, flash_attention
+    from horovod_tpu.parallel.ring_attention import full_attention
+
+    assert auto_block(1000) == 1000
+    q, k, v = make_qkv(jax.random.PRNGKey(7), 1, 1000, 2, 64)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
+        q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
