@@ -391,7 +391,7 @@ def bench_transformer(jax, hvd, mesh, nchips):
     # steps_per_call scans k optimizer steps inside one XLA program,
     # amortizing the ~2.4 ms host-dispatch gap (same knob as the resnet
     # leg; ~7 ms/step of wall-vs-device gap measured at spc=1).
-    spc = int(os.environ.get("BENCH_TLM_STEPS_PER_CALL", "2"))
+    spc = int(os.environ.get("BENCH_TLM_STEPS_PER_CALL", "4"))
     step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False,
                            steps_per_call=spc)
     if spc > 1:

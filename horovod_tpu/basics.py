@@ -180,3 +180,30 @@ def mpi_threads_supported() -> bool:
     """
     _require_init()
     return True
+
+
+def check_mesh_async_ordering(what: str) -> None:
+    """Raise when launching a jitted collective program would race
+    outstanding async eager collectives on a SHARED multi-controller
+    runtime.
+
+    On such a runtime every process must launch mesh programs in the
+    same order; an ``*_async`` op whose program is still executing in
+    the background can interleave differently per process with a newly
+    dispatched jitted step — the cross-process deadlock/corruption the
+    reference's coordinator exists to prevent
+    (``operations.cc:1414-1433``).  No-op before init, on disjoint
+    runtimes (TCP data plane), and single-process jobs.
+    """
+    c = _state.controller
+    if c is None:
+        return
+    n = c.mesh_async_hazard()
+    if n:
+        raise RuntimeError(
+            f"{what} would dispatch a jitted collective program while "
+            f"{n} async eager collective(s) are still outstanding on a "
+            f"shared multi-controller runtime.  Call synchronize() (or "
+            f"poll() until done) on every *_async handle before "
+            f"dispatching jitted steps, so all processes launch mesh "
+            f"programs in the same order (see docs/running.md).")

@@ -34,6 +34,7 @@ fall back to ``full_attention``).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -289,6 +290,81 @@ _UNROLL_KV_MAX_BYTES = 1 << 20
 _UNROLL_KV_MAX_NK = 16
 
 
+def _fwd_kernel_fullunroll(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                           scale, causal, block, seq_len, nq, nk):
+    """Forward with BOTH loops unrolled inside one (B, H) grid step:
+    every (qi, kj) index is a python int, so dead causal/padding blocks
+    are skipped at trace time (zero code, zero compute — better than
+    ``pl.when``, which still emits and fetches), boundary masks are
+    static, and the per-Q-block online-softmax chains are independent
+    SSA values with no scratch — Mosaic's scheduler is free to
+    interleave one chain's VPU softmax with another's MXU matmul.
+    Measured the fastest forward form on v5e for T <= 4k
+    (docs/benchmarks.md)."""
+    # Whole rows read/written ONCE; per-block tiles are value-level
+    # static slices (ref-level partial slices trip the interpreter's vma
+    # tracking under shard_map, and a single store is also the friendlier
+    # form for Mosaic).
+    qfull = q_ref[0]
+    kfull = k_ref[0]
+    vfull = v_ref[0]
+    outs = []
+    lses = []
+    for qi in range(nq):
+        q = lax.slice_in_dim(qfull, qi * block, (qi + 1) * block, axis=0)
+        m = jnp.full((block, 1), _NEG_BIG, jnp.float32)
+        l = jnp.zeros((block, 1), jnp.float32)
+        acc = jnp.zeros((block, qfull.shape[1]), jnp.float32)
+        for kj in range(nk):
+            if causal and kj * block > (qi + 1) * block - 1:
+                continue                       # statically dead (future)
+            if seq_len is not None and (kj * block >= seq_len
+                                        or qi * block >= seq_len):
+                continue                       # fully in the padding tail
+            k = lax.slice_in_dim(kfull, kj * block, (kj + 1) * block,
+                                 axis=0)
+            v = lax.slice_in_dim(vfull, kj * block, (kj + 1) * block,
+                                 axis=0)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            interior = ((not causal
+                         or (kj + 1) * block - 1 <= qi * block)
+                        and (seq_len is None
+                             or (max(qi, kj) + 1) * block <= seq_len))
+            if not interior:
+                ok = _block_mask(qi, kj, block, block, causal, seq_len)
+                s = jnp.where(ok, s, _NEG_BIG)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            if not interior:
+                p = jnp.where(ok, p, 0.0)
+            l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m = m_new
+        l_safe = jnp.maximum(l, 1e-30)
+        outs.append((acc / l_safe).astype(o_ref.dtype))
+        lses.append(jnp.broadcast_to(m + jnp.log(l_safe), (block, 8)))
+    o_ref[0] = outs[0] if nq == 1 else jnp.concatenate(outs, axis=0)
+    lse_ref[0, 0] = (lses[0] if nq == 1
+                     else jnp.concatenate(lses, axis=0))
+
+
+# Full unrolling emits ~nq*nk/2 bodies and holds whole Q/K/V/O rows in
+# VMEM; past these bounds the unrolled-KV and grid forms take over.
+# 512-wide tiles measured best (0.625 T^2 executed area vs 0.75 at 1024,
+# with enough independent chains to hide the softmax VPU latency).  The
+# nq cap bounds code size: small EXPLICIT user blocks would otherwise
+# unroll (T/block)^2/2 bodies (T=4096 at block 8 is ~131k dot bodies —
+# minutes-to-hours of Mosaic compile); such configs take the grid forms.
+_FULL_UNROLL_MAX_T = 4096
+_FULL_UNROLL_BLOCK = 512
+_FULL_UNROLL_MAX_NQ = 8
+
+
 def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
                 interpret, seq_len=None, head_base=(0, 0, 0)):
     """Forward on head-packed (B, T, C) views (C = H*D): the head is a
@@ -303,6 +379,43 @@ def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
     nq = T // block_q
     nk = T // block_k
     oq, ok_, ov = head_base
+    # The fully-unrolled form re-tiles internally (the tile size is a
+    # schedule detail — flash results are block-size independent up to
+    # f32 reassociation); fb divides T whenever T is a multiple of 8
+    # beyond the tile, else fall through to the other forms.  Under
+    # shard_map manual axes IN INTERPRET MODE the generic HLO
+    # interpreter cannot discharge this kernel's loads (its vma check
+    # rejects the block dynamic_slices), so CPU tests take the
+    # unrolled-KV form there; compiled Mosaic is unaffected.
+    in_vma = getattr(jax.typeof(q), "vma", None) or frozenset()
+    fb = min(_FULL_UNROLL_BLOCK, block_q, block_k, T)
+    if (T <= _FULL_UNROLL_MAX_T and T % fb == 0
+            and T // fb <= _FULL_UNROLL_MAX_NQ
+            and not (interpret and in_vma)
+            and T * D * q.dtype.itemsize <= _UNROLL_KV_MAX_BYTES):
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_fullunroll, scale=scale,
+                              causal=causal, block=fb, seq_len=seq_len,
+                              nq=T // fb, nk=T // fb),
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + oq)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + ok_)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + ov)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h)),
+                pl.BlockSpec((1, 1, T, 8), lambda b, h: (b, h, 0, 0)),
+            ],
+            out_shape=[
+                _struct((B, T, H * D), q.dtype, q, k, v),
+                _struct((B, H, T, 8), jnp.float32, q, k, v),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(q, k, v)
+        return out, lse[..., 0]
     if (nk <= _UNROLL_KV_MAX_NK
             and T * D * q.dtype.itemsize <= _UNROLL_KV_MAX_BYTES):
         out, lse = pl.pallas_call(
@@ -703,8 +816,34 @@ def _bwd_pallas_packed(q, k, v, o, lse, do, H, D, *, scale, causal,
                        head_base=(0, 0, 0)):
     """Split flash backward on head-packed (B, T, C) views (see
     :func:`_fwd_packed`); ``lse`` arrives as (B, H, T) and ``o``/``do``
-    are head-merged (B, T, H*D)."""
+    are head-merged (B, T, H*D).
+
+    The packed kernels read strided 256-byte rows (measured ~+1 ms/layer
+    over contiguous tiles on v5e at the bench shape, vs ~+0.8 ms/layer
+    of transpose copies for the merged layout) — the strided form stays
+    the default; ``HOROVOD_TPU_FLASH_PACKED_BWD=0`` switches to
+    transpose-to-merged + the contiguous kernel pair for A/B."""
     B, T, _ = q.shape
+    if os.environ.get("HOROVOD_TPU_FLASH_PACKED_BWD", "1") == "0":
+        oq, ok_, ov = head_base
+
+        def pick(x, off):   # (B, T, C*) head range -> merged (B*H, T, D)
+            x = x[..., off * D:(off + H) * D]
+            return (x.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                    .reshape(B * H, T, D))
+
+        qm, km, vm = pick(q, oq), pick(k, ok_), pick(v, ov)
+        om, dom = pick(o, 0), pick(do, 0)
+        dqm, dkm, dvm = _bwd_pallas(
+            qm, km, vm, om, lse.reshape(B * H, T), dom, scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret, seq_len=seq_len)
+
+        def unpick(g):
+            return (g.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+                    .reshape(B, T, H * D))
+
+        return unpick(dqm), unpick(dkm), unpick(dvm)
     C = H * D
     nq = T // block_q
     nk = T // block_k
