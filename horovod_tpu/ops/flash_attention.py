@@ -114,6 +114,26 @@ def _masked_dispatch(compute, live, qi, kj, block_q, block_k, causal,
         functools.partial(compute, masked=True))
 
 
+def _static_dead(qi: int, kj: int, block: int, causal, seq_len) -> bool:
+    """Trace-time dead test for the fully-unrolled kernels (python-int
+    block pair): causal-future pairs and pairs entirely inside the
+    padding tail emit no code at all."""
+    if causal and kj * block > (qi + 1) * block - 1:
+        return True
+    return seq_len is not None and (kj * block >= seq_len
+                                    or qi * block >= seq_len)
+
+
+def _static_interior(qi: int, kj: int, block: int, causal,
+                     seq_len) -> bool:
+    """Trace-time interior test (python-int block pair): True when no
+    element of the pair can be masked, so the where/iota path is
+    skipped statically."""
+    return ((not causal or (kj + 1) * block - 1 <= qi * block)
+            and (seq_len is None
+                 or (max(qi, kj) + 1) * block <= seq_len))
+
+
 def _live_block(qi, kj, block_q, block_k, causal, seq_len):
     """Whether this block pair contributes at all: causal-future KV
     blocks and block rows/columns entirely inside the padding tail are
@@ -316,11 +336,8 @@ def _fwd_kernel_fullunroll(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         l = jnp.zeros((block, 1), jnp.float32)
         acc = jnp.zeros((block, qfull.shape[1]), jnp.float32)
         for kj in range(nk):
-            if causal and kj * block > (qi + 1) * block - 1:
-                continue                       # statically dead (future)
-            if seq_len is not None and (kj * block >= seq_len
-                                        or qi * block >= seq_len):
-                continue                       # fully in the padding tail
+            if _static_dead(qi, kj, block, causal, seq_len):
+                continue
             k = lax.slice_in_dim(kfull, kj * block, (kj + 1) * block,
                                  axis=0)
             v = lax.slice_in_dim(vfull, kj * block, (kj + 1) * block,
@@ -328,10 +345,7 @@ def _fwd_kernel_fullunroll(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-            interior = ((not causal
-                         or (kj + 1) * block - 1 <= qi * block)
-                        and (seq_len is None
-                             or (max(qi, kj) + 1) * block <= seq_len))
+            interior = _static_interior(qi, kj, block, causal, seq_len)
             if not interior:
                 ok = _block_mask(qi, kj, block, block, causal, seq_len)
                 s = jnp.where(ok, s, _NEG_BIG)
@@ -352,6 +366,10 @@ def _fwd_kernel_fullunroll(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = (lses[0] if nq == 1
                      else jnp.concatenate(lses, axis=0))
 
+
+# VMEM row bound for the opt-in fully-unrolled BACKWARD (see the
+# selection comment in _bwd_pallas_packed).
+_FULL_UNROLL_BWD_MAX_BYTES = 512 << 10
 
 # Full unrolling emits ~nq*nk/2 bodies and holds whole Q/K/V/O rows in
 # VMEM; past these bounds the unrolled-KV and grid forms take over.
@@ -811,6 +829,72 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
+def _bwd_kernel_fullunroll(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                           dq_ref, dk_ref, dv_ref, *, scale, causal,
+                           block, seq_len, nq, nk):
+    """One-pass flash backward with BOTH loops unrolled on a (B, H)
+    grid: every (qi, kj) is a python int, so each live pair's
+    s/p/dp/ds are computed ONCE and contracted into dq AND dk/dv — the
+    5-matmul fused schedule that the grid-looped fused kernel could not
+    make fast (its loop-carried dq scratch serialized Mosaic's
+    pipeline; here everything is independent SSA, nothing carries).
+    Dead causal/padding pairs are skipped at trace time and boundary
+    masks are static, like :func:`_fwd_kernel_fullunroll`."""
+    qfull = q_ref[0]
+    kfull = k_ref[0]
+    vfull = v_ref[0]
+    dofull = do_ref[0]
+    lse_rows = lse_ref[0, 0][:, :1]                       # (T, 1)
+    dta_rows = dta_ref[0, 0][:, :1]                       # (T, 1)
+    D = qfull.shape[1]
+    dq_parts = [jnp.zeros((block, D), jnp.float32) for _ in range(nq)]
+    dk_parts = [jnp.zeros((block, D), jnp.float32) for _ in range(nk)]
+    dv_parts = [jnp.zeros((block, D), jnp.float32) for _ in range(nk)]
+    for kj in range(nk):
+        k = lax.slice_in_dim(kfull, kj * block, (kj + 1) * block, axis=0)
+        v = lax.slice_in_dim(vfull, kj * block, (kj + 1) * block, axis=0)
+        for qi in range(nq):
+            if _static_dead(qi, kj, block, causal, seq_len):
+                continue
+            q = lax.slice_in_dim(qfull, qi * block, (qi + 1) * block,
+                                 axis=0)
+            do = lax.slice_in_dim(dofull, qi * block, (qi + 1) * block,
+                                  axis=0)
+            lse = lax.slice_in_dim(lse_rows, qi * block,
+                                   (qi + 1) * block, axis=0)
+            delta = lax.slice_in_dim(dta_rows, qi * block,
+                                     (qi + 1) * block, axis=0)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            p = jnp.exp(s - lse)
+            interior = _static_interior(qi, kj, block, causal, seq_len)
+            if not interior:
+                ok = _block_mask(qi, kj, block, block, causal, seq_len)
+                p = jnp.where(ok, p, 0.0)
+            dv_parts[kj] = dv_parts[kj] + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            dk_parts[kj] = dk_parts[kj] + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dq_parts[qi] = dq_parts[qi] + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    def cat(parts, dtype):
+        parts = [p.astype(dtype) for p in parts]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
+
+    dq_ref[0] = cat(dq_parts, dq_ref.dtype)
+    dk_ref[0] = cat(dk_parts, dk_ref.dtype)
+    dv_ref[0] = cat(dv_parts, dv_ref.dtype)
+
+
 def _bwd_pallas_packed(q, k, v, o, lse, do, H, D, *, scale, causal,
                        block_q, block_k, interpret, seq_len=None,
                        head_base=(0, 0, 0)):
@@ -854,6 +938,50 @@ def _bwd_pallas_packed(q, k, v, o, lse, do, H, D, *, scale, causal,
                     axis=-1).transpose(0, 2, 1)               # (B, H, T)
     lse8 = jnp.broadcast_to(lse[..., None], (B, H, T, 8))
     delta8 = jnp.broadcast_to(delta[..., None], (B, H, T, 8))
+
+    # The fused one-pass form (5 matmuls/pair instead of the split
+    # pair's 7) measured a WASH on v5e (5.24 vs 5.19 ms f+b at the
+    # bench shape) — whatever binds the backward, it isn't matmul
+    # count.  Kept behind an env knob so the recorded A/B stays
+    # reproducible; the split pair stays the measured default.
+    in_vma = getattr(jax.typeof(q), "vma", None) or frozenset()
+    fbb = min(_FULL_UNROLL_BLOCK, block_q, block_k, T)
+    # Tighter VMEM bound than the forward's: this kernel holds 4 input
+    # + 3 output full rows PLUS three full-sequence f32 accumulator
+    # part-sets, several times the forward's residency — 512 KB rows
+    # (T=2048 at D=128 bf16, the measured-working shape) is the limit.
+    if (os.environ.get("HOROVOD_TPU_FLASH_BWD") == "fullunroll"
+            and T <= _FULL_UNROLL_MAX_T and T % fbb == 0
+            and T // fbb <= _FULL_UNROLL_MAX_NQ
+            and not (interpret and in_vma)
+            and T * D * q.dtype.itemsize <= _FULL_UNROLL_BWD_MAX_BYTES):
+        n = T // fbb
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_kernel_fullunroll, scale=scale,
+                              causal=causal, block=fbb, seq_len=seq_len,
+                              nq=n, nk=n),
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + oq)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + ok_)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h + ov)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h)),
+                pl.BlockSpec((1, 1, T, 8), lambda b, h: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, T, 8), lambda b, h: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h)),
+                pl.BlockSpec((1, T, D), lambda b, h: (b, 0, h)),
+            ],
+            out_shape=[_struct((B, T, C), q.dtype, q, k, v, do),
+                       _struct((B, T, C), k.dtype, q, k, v, do),
+                       _struct((B, T, C), v.dtype, q, k, v, do)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(q, k, v, do, lse8, delta8)
+        return dq, dk, dv
 
     kv_specs = dict(
         q=pl.BlockSpec((1, block_q, D),

@@ -105,6 +105,48 @@ class TestPackedLayout:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=2e-4, atol=2e-4)
 
+    def test_fullunroll_bwd_ab_matches_oracle(self, hvd, monkeypatch):
+        """HOROVOD_TPU_FLASH_BWD=fullunroll selects the fused one-pass
+        backward (5 matmuls/pair, SSA, (B, H) grid) — oracle-exact
+        gradients through the packed path."""
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD", "fullunroll")
+        q, k, v = make_qkv(jax.random.PRNGKey(27), 2, 32, 2, 128)
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=8,
+                                    block_k=8, interpret=True) ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_fullunroll_bwd_ab_padded_seq_len(self, hvd, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD", "fullunroll")
+        T, T_pad = 24, 32
+        q, k, v = make_qkv(jax.random.PRNGKey(28), 1, T, 2, 128)
+        pad = [(0, 0), (0, T_pad - T), (0, 0), (0, 0)]
+
+        def loss(q, k, v):
+            out = flash_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, block_q=8, block_k=8, interpret=True,
+                seq_len=T)
+            return (out[:, :T] ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
     def test_merged_bwd_ab_matches_oracle(self, hvd, monkeypatch):
         """HOROVOD_TPU_FLASH_PACKED_BWD=0 routes the packed backward
         through the contiguous merged-layout kernel pair (the recorded
