@@ -1,0 +1,107 @@
+"""Device-time microbench via jax.profiler trace spans (the tunneled
+chip's wall clock is dominated by dispatch; the trace's device-side
+'while' span is the honest number)."""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import functools
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.ops.flash_attention import flash_attention
+
+B, T, H, D = 8, 2048, 16, 128
+REPS = 16
+
+
+def device_ms(make_scan, *args):
+    """Compile make_scan(*args) (a jitted scan program), run under the
+    profiler, return device ms per rep from the top-level module span."""
+    out = make_scan(*args)
+    jax.block_until_ready(out)
+    tmp = tempfile.mkdtemp(prefix="devtime")
+    with jax.profiler.trace(tmp):
+        out = make_scan(*args)
+        jax.block_until_ready(out)
+    path = sorted(glob.glob(os.path.join(
+        tmp, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(path) as fh:
+        trace = json.load(fh)
+    evts = trace.get("traceEvents", [])
+    pids = {e["pid"]: e["args"].get("name", "") for e in evts
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev = {p for p, n in pids.items() if "TPU" in n}
+    best = 0.0
+    for e in evts:
+        if (e.get("ph") == "X" and e.get("pid") in dev
+                and e.get("name", "").startswith("jit_")):
+            best = max(best, e.get("dur", 0.0))
+    return best / 1e3 / REPS
+
+
+def bench_fwd(bq, bk, q, k, v):
+    @jax.jit
+    def many(q, k, v):
+        def body(c, _):
+            return flash_attention(c, k, v, causal=True, block_q=bq,
+                                   block_k=bk), None
+        out, _ = lax.scan(body, q, None, length=REPS)
+        return out
+    return device_ms(many, q, k, v)
+
+
+def bench_bwd(bq, bk, impl, q, k, v, do):
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=bq,
+                                block_k=bk, bwd_impl=impl)
+                .astype(jnp.float32) * do.astype(jnp.float32)).sum()
+    gfn = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v):
+        def body(c, _):
+            dq, dk, dv = gfn(c, k, v)
+            return dq.astype(c.dtype), None
+        out, _ = lax.scan(body, q, None, length=REPS)
+        return out
+    return device_ms(many, q, k, v)
+
+
+def main():
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv_, kd = jax.random.split(rng, 4)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv_, (B, T, H, D), jnp.bfloat16)
+    do = jax.random.normal(kd, (B, T, H, D), jnp.bfloat16)
+
+    causal_area = T * T / 2
+    fwd_flops = B * H * 2 * 2 * causal_area * D
+    bwd_flops = B * H * 5 * 2 * causal_area * D
+
+    for spec in sys.argv[1:]:
+        parts = spec.split(",")
+        kind = parts[0]
+        if kind == "fwd":
+            bq, bk = int(parts[1]), int(parts[2])
+            t = bench_fwd(bq, bk, q, k, v)
+            print(f"fwd  bq={bq:5d} bk={bk:5d}: {t:7.3f} ms/rep "
+                  f"({fwd_flops/t/1e9:6.1f} TF/s useful)", flush=True)
+        else:
+            bq, bk, impl = int(parts[1]), int(parts[2]), parts[3]
+            t = bench_bwd(bq, bk, impl, q, k, v, do)
+            print(f"f+b  bq={bq:5d} bk={bk:5d} {impl:13s}: {t:7.3f} ms/rep "
+                  f"({(fwd_flops+bwd_flops)/t/1e9:6.1f} TF/s eff)",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

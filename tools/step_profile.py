@@ -1,0 +1,176 @@
+"""Profile the exact bench transformer (or resnet) train step on the
+real chip and aggregate device-side per-op spans — the attribution
+VERDICT r3 asked for (weak #1, next #4)."""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import functools
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.jax.spmd import make_train_step
+from bench import synth_variables
+
+
+def profile_and_dump(run, label, topn=40):
+    run()   # warm/compile
+    run()
+    tmp = tempfile.mkdtemp(prefix="stepprof")
+    with jax.profiler.trace(tmp):
+        run()
+        run()
+        import time as _t
+        _t.sleep(1.0)   # let the remote device profiler flush
+    path = sorted(glob.glob(os.path.join(
+        tmp, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(path) as fh:
+        trace = json.load(fh)
+    evts = trace.get("traceEvents", [])
+    pids = {e["pid"]: e["args"].get("name", "") for e in evts
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev = {p for p, n in pids.items() if "TPU" in n}
+    tids = {(e["pid"], e["tid"]): e["args"].get("name", "") for e in evts
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    # Aggregate ops on the "XLA Ops" thread by canonical name (strip
+    # .NNN suffixes and fusion numbering).
+    tot = defaultdict(float)
+    cnt = defaultdict(int)
+    total = 0.0
+    module = 0.0
+    for e in evts:
+        if e.get("ph") != "X" or e.get("pid") not in dev:
+            continue
+        tname = tids.get((e["pid"], e["tid"]), "")
+        if tname == "XLA Modules":
+            module = max(module, e.get("dur", 0.0))
+        if tname != "XLA Ops":
+            continue
+        name = re.sub(r"\.\d+$", "", e.get("name", ""))
+        tot[name] += e.get("dur", 0.0)
+        cnt[name] += 1
+        total += e.get("dur", 0.0)
+    print(f"== {label}: module {module/1e3:.2f} ms, XLA-ops total "
+          f"{total/1e3:.2f} ms ==")
+    for n, d in sorted(tot.items(), key=lambda kv: -kv[1])[:topn]:
+        print(f"{d/1e3:9.3f} ms  x{cnt[n]:4d}  {n[:100]}", flush=True)
+    if total == 0:
+        print("-- no XLA Ops spans; dumping all device threads/spans --")
+        print("pids:", pids)
+        print("tids:", {k: v for k, v in tids.items() if k[0] in dev})
+        agg = defaultdict(float)
+        for e in evts:
+            if e.get("ph") == "X" and e.get("pid") in dev:
+                agg[(tids.get((e["pid"], e["tid"]), "?"),
+                     re.sub(r"\.\d+$", "", e.get("name", "")))] += \
+                    e.get("dur", 0.0)
+        for (tn, n), d in sorted(agg.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"{d/1e3:9.3f} ms  [{tn}] {n[:90]}", flush=True)
+
+
+def transformer():
+    from horovod_tpu.models import TransformerLM
+    dim, depth, heads, vocab, seq, bpc = 2048, 12, 16, 32768, 2048, 8
+    attn = os.environ.get("BENCH_TLM_ATTN", "flash")
+    ln_dtype = (jnp.float32
+                if os.environ.get("BENCH_TLM_LN_DTYPE", "bf16") == "f32"
+                else jnp.bfloat16)
+    model = TransformerLM(vocab=vocab, dim=dim, depth=depth,
+                          num_heads=heads, max_len=seq, attn=attn,
+                          dtype=jnp.bfloat16, head_dtype=jnp.bfloat16,
+                          ln_dtype=ln_dtype)
+    mesh = hvd.ranks_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+    @functools.partial(jax.jit, out_shardings=sharding)
+    def make_tokens(rng):
+        return jax.random.randint(rng, (bpc, seq + 1), 0, vocab,
+                                  dtype=jnp.int32)
+
+    tokens = make_tokens(jax.random.PRNGKey(0))
+    params = synth_variables(
+        jax, lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32)),
+        jax.random.PRNGKey(1))["params"]
+
+    fused_head = os.environ.get("BENCH_TLM_FUSED_XENT", "1") == "1"
+
+    def loss_fn(params, aux, batch):
+        if fused_head:
+            from horovod_tpu.ops.losses import fused_softmax_xent
+            h = model.apply({"params": params}, batch[:, :-1],
+                            return_hidden=True)
+            loss = fused_softmax_xent(
+                h.reshape(-1, dim), params["head"]["kernel"],
+                batch[:, 1:].reshape(-1)).mean()
+        else:
+            logits = model.apply({"params": params}, batch[:, :-1])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch[:, 1:]).mean()
+        return loss, aux
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False)
+    state = {}
+
+    def run():
+        nonlocal params, opt_state
+        params, _, opt_state, loss = step(params, {}, opt_state, tokens)
+        np.asarray(loss)
+
+    profile_and_dump(run, f"transformer step attn={attn}")
+
+
+def resnet():
+    from horovod_tpu.models import ResNet50
+    bpc, size = 128, 224
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    mesh = hvd.ranks_mesh()
+    rng = jax.random.PRNGKey(42)
+    images = jax.random.normal(rng, (bpc, size, size, 3), jnp.bfloat16)
+    labels = jnp.zeros((bpc,), jnp.int32)
+    variables = synth_variables(
+        jax, lambda r: model.init(r, images[:1], train=True), rng)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch_stats, batch):
+        imgs, lbls = batch
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": batch_stats}, imgs,
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, lbls).mean()
+        return loss, mut["batch_stats"]
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    step = make_train_step(loss_fn, tx, mesh, sync_aux_state=True,
+                           steps_per_call=1)
+    data = (images, labels)
+
+    def run():
+        nonlocal params, batch_stats, opt_state
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, data)
+        np.asarray(loss)
+
+    profile_and_dump(run, "resnet50 step bpc=128")
+
+
+if __name__ == "__main__":
+    hvd.init()
+    if "resnet" in sys.argv:
+        resnet()
+    else:
+        transformer()

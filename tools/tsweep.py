@@ -1,0 +1,103 @@
+"""Long-context T-sweep: flash vs full attention fwd+grad on the real
+chip — device ms (profiler span), tokens/s, and compiled peak temp
+memory.  Emits a markdown table for docs/long-context.md."""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import functools
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.ops.flash_attention import flash_attention
+from horovod_tpu.parallel.ring_attention import full_attention
+
+B, H, D = 1, 16, 128
+REPS = 8
+
+
+def device_ms(jfn, *args):
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    tmp = tempfile.mkdtemp(prefix="tsweep")
+    with jax.profiler.trace(tmp):
+        out = jfn(*args)
+        jax.block_until_ready(out)
+    path = sorted(glob.glob(os.path.join(
+        tmp, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(path) as fh:
+        trace = json.load(fh)
+    evts = trace.get("traceEvents", [])
+    pids = {e["pid"]: e["args"].get("name", "") for e in evts
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev = {p for p, n in pids.items() if "TPU" in n}
+    best = 0.0
+    for e in evts:
+        if (e.get("ph") == "X" and e.get("pid") in dev
+                and e.get("name", "").startswith("jit_")):
+            best = max(best, e.get("dur", 0.0))
+    return best / 1e3 / REPS
+
+
+def temp_gb(jfn, *args):
+    try:
+        mem = jfn.lower(*args).compile().memory_analysis()
+        return mem.temp_size_in_bytes / 1e9
+    except Exception as e:
+        return f"? ({type(e).__name__})"
+
+
+def grad_step(attn_fn):
+    def loss(q, k, v, do):
+        return (attn_fn(q, k, v).astype(jnp.float32)
+                * do.astype(jnp.float32)).sum()
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v, do):
+        def body(c, _):
+            dq, dk, dv = g(c, k, v, do)
+            return dq.astype(c.dtype), None
+        out, _ = lax.scan(body, q, None, length=REPS)
+        return out
+    return many
+
+
+def main():
+    Ts = [int(a) for a in sys.argv[1:]] or [2048, 4096, 8192, 16384]
+    print("| T | impl | fwd+bwd ms | tokens/s (B*T/step) | peak temp GB |")
+    print("|---|------|-----------:|--------------------:|-------------:|")
+    for T in Ts:
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv_, kd = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+        v = jax.random.normal(kv_, (B, T, H, D), jnp.bfloat16)
+        do = jax.random.normal(kd, (B, T, H, D), jnp.bfloat16)
+        for name, fn in (
+                ("flash", functools.partial(flash_attention, causal=True)),
+                ("full", functools.partial(full_attention, causal=True))):
+            try:
+                jfn = grad_step(fn)
+                mem = temp_gb(jfn, q, k, v, do)
+                t = device_ms(jfn, q, k, v, do)
+                toks = B * T / (t / 1e3)
+                memtxt = (f"{mem:.2f}" if isinstance(mem, float)
+                          else str(mem))
+                print(f"| {T} | {name} | {t:.2f} | {toks:,.0f} | "
+                      f"{memtxt} |", flush=True)
+            except Exception as e:
+                print(f"| {T} | {name} | OOM/fail "
+                      f"({type(e).__name__}: {str(e)[:60]}) | — | — |",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()
