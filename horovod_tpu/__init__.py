@@ -19,7 +19,8 @@ Quick start (mirrors the reference's 4-step usage, ``README.md``)::
 from horovod_tpu.basics import (           # noqa: F401
     init, shutdown, is_initialized, size, local_size, rank, local_rank,
     process_index, process_count, devices, local_devices, ranks_mesh,
-    get_topology, mpi_threads_supported, NotInitializedError,
+    hierarchical_mesh, get_topology, mpi_threads_supported,
+    NotInitializedError,
 )
 from horovod_tpu.ops.eager import (        # noqa: F401
     allreduce, allreduce_async, allgather, allgather_async, broadcast,

@@ -160,6 +160,18 @@ def ranks_mesh():
     return _require_init().mesh
 
 
+def hierarchical_mesh(ici_size=None):
+    """Two-tier ``('dcn', 'ici')`` mesh whose ``ici`` groups are the
+    devices' PHYSICAL slice membership (host locality as fallback; an
+    explicit ``ici_size`` forces a fixed split) — the device-level
+    analogue of the reference's local/cross communicator pair
+    (``operations.cc:1499-1532``).  Pair with
+    :func:`horovod_tpu.parallel.hierarchical.hierarchical_allreduce`."""
+    from horovod_tpu.parallel import mesh as _mesh_mod
+    return _mesh_mod.build_hierarchical_mesh(_require_init().topology,
+                                             ici_size)
+
+
 def get_topology():
     """The resolved job topology snapshot — pass it to
     :func:`horovod_tpu.parallel.mesh.build_mesh` to lay custom mesh shapes

@@ -24,7 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from horovod_tpu.topology import Topology
+from horovod_tpu.topology import Topology, slice_groups
 
 RANKS_AXIS = "ranks"
 ICI_AXIS = "ici"
@@ -32,7 +32,12 @@ DCN_AXIS = "dcn"
 
 
 def build_ranks_mesh(topology: Topology) -> Mesh:
-    """World communicator: 1-D mesh over all participating chips."""
+    """World communicator: 1-D mesh over all participating chips.
+
+    ``topology.devices`` is already in physical order (slice-grouped,
+    torus-snaked — :func:`horovod_tpu.topology.physical_device_order`), so
+    consecutive mesh positions are ICI neighbours and XLA's ring
+    collectives ride ICI links."""
     devs = np.asarray(topology.devices, dtype=object)
     return Mesh(devs, axis_names=(RANKS_AXIS,))
 
@@ -43,21 +48,16 @@ def build_hierarchical_mesh(
 ) -> Mesh:
     """Two-level ``('dcn', 'ici')`` mesh.
 
-    ``ici_size`` defaults to the number of chips per process (one process per
-    host/slice), so ``ici`` groups chips with fast interconnect and ``dcn``
-    spans groups — the TPU analogue of the reference's
-    ``local_comm``/``cross_comm`` pair (``operations.cc:1499-1532``).
-    """
-    n = topology.size
-    if ici_size is None:
-        ici_size = topology.local_size
-    if n % ici_size != 0:
-        raise ValueError(
-            f"total ranks {n} not divisible by ici group size {ici_size}; "
-            "hierarchical collectives need a homogeneous topology "
-            "(reference operations.cc:1511-1525 makes the same check)")
-    devs = np.asarray(topology.devices, dtype=object).reshape(
-        n // ici_size, ici_size)
+    The ``ici`` groups are the devices' ACTUAL slice membership
+    (``device.slice_index``; chips in one slice share ICI links), falling
+    back to host locality (``process_index``) and finally to one group,
+    when the runtime exposes no slice structure — the TPU analogue of the
+    reference's ``local_comm``/``cross_comm`` discovery
+    (``operations.cc:1499-1532``), done on *devices* rather than
+    processes.  ``ici_size`` forces a fixed group width instead (e.g. on
+    a virtual CPU mesh standing in for a pod)."""
+    groups = slice_groups(topology.devices, ici_size)
+    devs = np.asarray(groups, dtype=object)
     return Mesh(devs, axis_names=(DCN_AXIS, ICI_AXIS))
 
 
@@ -66,8 +66,11 @@ def build_mesh(
     shape: Sequence[int],
     axis_names: Sequence[str],
 ) -> Mesh:
-    """General mesh over the job's chips in rank order (for dp/tp/pp/sp/ep
-    layouts of model code built on this framework)."""
+    """General mesh for dp/tp/pp/sp/ep layouts of model code built on this
+    framework.  ``topology.devices`` is in physical order, so the LAST
+    (minor, fastest-varying) axis lands on consecutive ICI neighbours —
+    put the heaviest-communication axis (tp/sp) last and the lightest
+    (dp/pp over DCN) first, the scaling-book layout rule."""
     if int(np.prod(shape)) != topology.size:
         raise ValueError(
             f"mesh shape {tuple(shape)} does not cover {topology.size} chips")

@@ -136,6 +136,119 @@ def host_fingerprint(warn_truncation: bool = False) -> str:
     return name
 
 
+def _device_coords(d) -> Optional[Tuple[int, ...]]:
+    c = getattr(d, "coords", None)
+    if c is None:
+        return None
+    try:
+        return tuple(int(v) for v in c)
+    except (TypeError, ValueError):
+        return None
+
+
+def physical_device_order(devices: Sequence) -> list:
+    """Order devices so consecutive entries are physical ICI neighbours —
+    the device-level analogue of the reference's locality discovery
+    (``operations.cc:1499-1532``: MPI splits by shared memory; here the
+    split data is the chip's own ``slice_index``/``coords``).
+
+    Grouping is by ``slice_index`` first (chips in one slice share ICI;
+    crossing slices means DCN), then by owning process — a process's
+    devices MUST stay rank-contiguous because the shared-runtime
+    executor and the multi-process launcher both address a process's
+    ranks as the block ``[rank, rank + local_size)`` — and within each
+    process a boustrophedon ("snake") walk of the chip coordinates, so
+    consecutive pairs differ by one torus hop —
+    ``mesh_utils.create_device_mesh``-style ordering without its
+    fixed-slice-shape table.  Process blocks follow their first chip's
+    snake position, so cross-block seams sit between physically close
+    chips even though seam pairs may not be strict neighbours.  Multiple
+    cores on one chip stay adjacent.  Devices that expose no coordinates
+    (CPU meshes, virtual devices) are returned in the given order
+    unchanged.
+    """
+    devs = list(devices)
+    coords = [_device_coords(d) for d in devs]
+    if any(c is None for c in coords) or not devs:
+        return devs
+    ndim = len(coords[0])
+    if any(len(c) != ndim for c in coords):
+        return devs
+    lo = [min(c[i] for c in coords) for i in range(ndim)]
+    extent = [max(c[i] for c in coords) - lo[i] + 1 for i in range(ndim)]
+
+    def snake_key(d):
+        c = [a - b for a, b in zip(_device_coords(d), lo)]
+        # Walk the highest dim outermost; flip each lower dim's direction
+        # by the parity of the walk position in the dims above it, so the
+        # path only ever steps to an adjacent chip.
+        key = []
+        parity = 0
+        for i in reversed(range(ndim)):
+            v = c[i] if parity % 2 == 0 else extent[i] - 1 - c[i]
+            key.append(v)
+            parity = parity * extent[i] + v
+        key.append(getattr(d, "core_on_chip", 0))
+        return tuple(key)
+
+    def full_key(d):
+        return (getattr(d, "slice_index", 0) or 0,) + snake_key(d)
+
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(getattr(d, "process_index", 0), []).append(d)
+    for g in groups.values():
+        g.sort(key=full_key)
+    ordered_groups = sorted(groups.values(), key=lambda g: full_key(g[0]))
+    return [d for g in ordered_groups for d in g]
+
+
+def slice_groups(devices: Sequence, ici_size: Optional[int] = None):
+    """Partition devices into the ``(dcn, ici)`` grid by PHYSICAL
+    membership: chips sharing a ``slice_index`` form an ici group (they
+    share ICI links); distinct slices stack along dcn.  Fallbacks when the
+    runtime exposes no slice structure: group by ``process_index`` (host
+    locality), or by an explicit ``ici_size``.
+
+    Returns a list of equal-length device lists (one per ici group); an
+    uneven partition raises, mirroring the reference's homogeneity check
+    (``operations.cc:1511-1525``).
+    """
+    devs = list(devices)
+    n = len(devs)
+    if ici_size is not None:
+        if n % ici_size != 0:
+            raise ValueError(
+                f"total ranks {n} not divisible by ici group size "
+                f"{ici_size}; hierarchical collectives need a homogeneous "
+                "topology (reference operations.cc:1511-1525 makes the "
+                "same check)")
+        return [devs[i:i + ici_size] for i in range(0, n, ici_size)]
+    for attr in ("slice_index", "process_index"):
+        vals = [getattr(d, attr, None) for d in devs]
+        if any(v is None for v in vals):
+            continue
+        if len(set(vals)) <= 1:
+            if attr == "slice_index":
+                # One slice: EVERY chip shares ICI regardless of which
+                # host drives it — host grouping would put dcn tiers on
+                # ICI links.
+                return [devs]
+            continue
+        groups: dict = {}
+        for d, v in zip(devs, vals):
+            groups.setdefault(v, []).append(d)
+        sizes = {len(g) for g in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"device {attr} groups are uneven "
+                f"({sorted((v, len(g)) for v, g in groups.items())}); "
+                "hierarchical collectives need a homogeneous topology "
+                "(reference operations.cc:1511-1525 makes the same check)")
+        return [groups[v] for v in sorted(groups)]
+    return [devs]   # one group: a single slice/host owns every chip
+
+
 def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
     """Resolve the job topology from the JAX runtime.
 
@@ -181,6 +294,7 @@ def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
             raise RuntimeError(
                 f"horovod_tpu: rank layout overflows the job: first rank "
                 f"{rank} + {len(local)} local devices > size {size}.")
+        local = tuple(physical_device_order(local))
         return Topology(
             devices=local,
             local_devices=local,
@@ -200,6 +314,11 @@ def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
         devices = tuple(all_devices[r] for r in ranks)
     else:
         devices = all_devices
+    # Physical (slice/torus-aware) order becomes THE rank order: rank r ==
+    # mesh position r everywhere, and consecutive ranks are ICI neighbours
+    # (no-op where the runtime exposes no coordinates).  Subset indices
+    # above refer to the runtime's enumeration, as documented.
+    devices = tuple(physical_device_order(devices))
     local = tuple(d for d in devices if d.process_index == jax.process_index())
     if not local:
         raise RuntimeError(
