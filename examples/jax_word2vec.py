@@ -4,9 +4,12 @@
 instead of a dense allreduce over the whole vocabulary.
 
 Design: the forward gathers only the touched embedding rows; the backward
-produces gradients for those rows, which are exchanged as IndexedSlices via
-``sparse.allreduce`` (all_gather of rows+indices over the rank mesh) and
-scatter-added into the table — cost ∝ batch size, not vocab size.
+produces gradients for those rows, which are handed to the stock
+``DistributedOptimizer`` as ``IndexedSlices`` — the wrapper routes them
+through the sparse allgather automatically (rows+indices over the rank
+mesh, comm cost ∝ batch size, not vocab size) and scatters to dense only
+locally for the optax update.  ``sparse_as_dense=True`` would densify
+before a regular allreduce instead, like the reference's escape hatch.
 
 Corpus: synthetic Zipf-distributed token stream (the reference downloads
 text8; this stays hermetic).
@@ -18,6 +21,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -71,12 +75,17 @@ def main():
                                  maxval=0.5 / args.dim)
     # Step 4 of the recipe: all ranks start from identical tables.
     emb_in, emb_out = hvd.jax.broadcast_parameters((emb_in, emb_out))
+    params = {"emb_in": emb_in, "emb_out": emb_out}
 
-    lr = args.lr
+    # The stock wrapper: IndexedSlices gradient leaves take the sparse
+    # allgather path inside its update — no manual sparse.allreduce.
+    tx = hvd.jax.DistributedOptimizer(optax.sgd(args.lr))
+    opt_state = tx.init(params)
 
-    def step_body(emb_in, emb_out, centers, contexts, negs):
+    def step_body(params, opt_state, centers, contexts, negs):
         """One sparse SGD step under shard_map (centers/contexts/negs are
         this rank's shard)."""
+        emb_in, emb_out = params["emb_in"], params["emb_out"]
         c_rows = emb_in[centers]               # (B, D) touched rows only
         ctx_rows = emb_out[contexts]           # (B, D)
         neg_rows = emb_out[negs]               # (B, K, D)
@@ -92,20 +101,19 @@ def main():
         loss, (g_c, g_ctx, g_neg) = jax.value_and_grad(loss_of)(
             (c_rows, ctx_rows, neg_rows))
 
-        # Sparse exchange: allgather rows+indices across ranks (the
-        # reference's IndexedSlices path), then scatter-add locally.
-        g_in = sparse.allreduce(
-            sparse.IndexedSlices(g_c, centers), average=True)
-        g_out_ctx = sparse.allreduce(
-            sparse.IndexedSlices(g_ctx, contexts), average=True)
-        g_out_neg = sparse.allreduce(
-            sparse.IndexedSlices(g_neg.reshape(-1, g_neg.shape[-1]),
-                                 negs.reshape(-1)), average=True)
-
-        emb_in = sparse.apply_indexed_slices(emb_in, g_in, scale=-lr)
-        emb_out = sparse.apply_indexed_slices(emb_out, g_out_ctx, scale=-lr)
-        emb_out = sparse.apply_indexed_slices(emb_out, g_out_neg, scale=-lr)
-        return emb_in, emb_out, lax.pmean(loss, "ranks")
+        # Row-gradients as IndexedSlices; both emb_out contributions
+        # (context + negatives) concatenate into one slice-set —
+        # duplicate indices sum, the IndexedSlices contract.
+        grads = {
+            "emb_in": sparse.IndexedSlices(g_c, centers, emb_in.shape),
+            "emb_out": sparse.IndexedSlices(
+                jnp.concatenate([g_ctx, g_neg.reshape(-1, g_neg.shape[-1])]),
+                jnp.concatenate([contexts, negs.reshape(-1)]),
+                emb_out.shape),
+        }
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state2, lax.pmean(loss, "ranks")
 
     # check_vma=False is deliberate here: the sparse path allgathers
     # (rows, indices) and scatter-adds the identical gathered data on every
@@ -126,8 +134,8 @@ def main():
                            (global_batch, args.neg)).astype(np.int32)
         centers, contexts, negs = shard_batch(
             (centers, contexts, negs), mesh)
-        emb_in, emb_out, loss = step(emb_in, emb_out, centers, contexts,
-                                     negs)
+        params, opt_state, loss = step(params, opt_state, centers, contexts,
+                                       negs)
         if i % 50 == 0 and hvd.rank() == 0:
             print(f"step {i}: loss={float(np.asarray(loss)):.4f}")
     if hvd.rank() == 0:
