@@ -19,9 +19,12 @@ resumes bit-identical state.
 
 from __future__ import annotations
 
+import dataclasses
+import importlib
+import json
 import os
 import re
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from horovod_tpu import basics
 
@@ -79,71 +82,305 @@ def restore(directory: str, epoch: int, like: Any) -> Any:
         restore_args=ocp.checkpoint_utils.construct_restore_args(like))
 
 
+@dataclasses.dataclass
+class OptimizerSpec:
+    """Serializable optimizer identity — the optax analogue of the Keras
+    optimizer config the reference persists inside its h5 files
+    (``horovod/keras/__init__.py:113-148``: class name + hyperparams,
+    reconstructed at load with ``custom_optimizers`` resolution).
+
+    optax transforms are closures, so identity is declared rather than
+    introspected: an ordered list of ``(factory, kwargs)`` steps, each
+    factory a dotted import path (``"optax.adamw"``) or a name resolved
+    from ``custom_objects`` at build time (the reference's
+    ``custom_optimizers``/``custom_objects`` escape hatch).  Multiple
+    steps rebuild as ``optax.chain(*steps)``.
+    """
+
+    steps: List[Tuple[str, Dict[str, Any]]]
+
+    @classmethod
+    def of(cls, factory: str, **kwargs) -> "OptimizerSpec":
+        return cls([(factory, kwargs)])
+
+    @classmethod
+    def chain(cls, *steps) -> "OptimizerSpec":
+        return cls([(f, dict(kw)) for f, kw in steps])
+
+    def to_json(self) -> str:
+        return json.dumps({"steps": [[f, kw] for f, kw in self.steps]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "OptimizerSpec":
+        data = json.loads(text)
+        return cls([(f, kw) for f, kw in data["steps"]])
+
+    def build(self, custom_objects: Optional[Dict[str, Any]] = None):
+        import optax
+        txs = []
+        for factory, kwargs in self.steps:
+            fn = None
+            if custom_objects and factory in custom_objects:
+                fn = custom_objects[factory]
+            else:
+                mod_name, _, attr = factory.rpartition(".")
+                # The spec file sits on disk next to the checkpoint;
+                # resolving arbitrary dotted paths from it would hand a
+                # tampered directory code execution at resume.  Only the
+                # optax namespace auto-imports — everything else must
+                # come through the caller's custom_objects.
+                if mod_name != "optax" and not mod_name.startswith(
+                        "optax."):
+                    raise ValueError(
+                        f"optimizer factory {factory!r} is neither an "
+                        f"optax.* path nor in custom_objects "
+                        f"{sorted(custom_objects or {})}; pass it via "
+                        "load_model(custom_objects={...})")
+                fn = getattr(importlib.import_module(mod_name), attr)
+            txs.append(fn(**kwargs))
+        return txs[0] if len(txs) == 1 else optax.chain(*txs)
+
+
+def _as_optimizer_spec(optimizer) -> OptimizerSpec:
+    if isinstance(optimizer, OptimizerSpec):
+        return optimizer
+    if (isinstance(optimizer, tuple) and len(optimizer) == 2
+            and isinstance(optimizer[0], str)):
+        return OptimizerSpec([(optimizer[0], dict(optimizer[1]))])
+    if isinstance(optimizer, list):
+        return OptimizerSpec.chain(*optimizer)
+    raise TypeError(
+        "save_model(optimizer=...) takes an OptimizerSpec, a "
+        "(factory, kwargs) tuple, or a list of them — a raw optax "
+        "GradientTransformation is a closure and cannot be persisted; "
+        "declare how to rebuild it instead (see checkpoint.OptimizerSpec)")
+
+
+def _optimizer_spec_path(directory: str, epoch: int) -> str:
+    return checkpoint_path(directory, epoch) + ".optimizer.json"
+
+
+# ------------------------------------------------------ params skeleton
+# load_model-with-only-a-directory needs every rank to hold a pytree of
+# the right structure before the value broadcast; rank 0 derives this
+# structural spec from the checkpoint's METADATA (shapes/dtypes only — no
+# data read) and broadcasts it as bytes.  Orbax stores tuples as lists
+# and JSON keys are strings, so a params tree containing tuple nodes or
+# non-string dict keys cannot round-trip without an explicit
+# ``params_like`` — :func:`save_model` warns at save time.
+
+def _meta_to_spec(node) -> Any:
+    if node is None:
+        return {"t": "none"}
+    if isinstance(node, dict):
+        return {"t": "dict",
+                "items": {k: _meta_to_spec(v) for k, v in node.items()}}
+    if isinstance(node, (list, tuple)):
+        return {"t": "list", "items": [_meta_to_spec(v) for v in node]}
+    return {"t": "leaf", "dtype": str(node.dtype),
+            "shape": list(node.shape)}
+
+
+def _params_resume_safe(tree) -> bool:
+    """True when the params tree survives the metadata→JSON→skeleton trip
+    structurally intact: PLAIN dicts with string keys / plain lists, down
+    to array-or-scalar leaves.  Anything else — tuples, FrozenDict-style
+    mappings, custom pytree nodes — rebuilds as a different node type (or
+    not at all) from the JSON skeleton, so it is reported unsafe and
+    :func:`save_model` warns."""
+    import numpy as np
+    if type(tree) is dict:
+        return (all(isinstance(k, str) for k in tree)
+                and all(_params_resume_safe(v) for v in tree.values()))
+    if type(tree) is list:
+        return all(_params_resume_safe(v) for v in tree)
+    if isinstance(tree, (np.ndarray, np.generic, int, float, complex)):
+        return True
+    import jax
+    return isinstance(tree, jax.Array)
+
+
+def _spec_to_skeleton(spec) -> Any:
+    import jax.numpy as jnp
+    t = spec["t"]
+    if t == "none":
+        return None
+    if t == "dict":
+        return {k: _spec_to_skeleton(v) for k, v in spec["items"].items()}
+    if t == "list":
+        return [_spec_to_skeleton(v) for v in spec["items"]]
+    return jnp.zeros(tuple(spec["shape"]), jnp.dtype(spec["dtype"]))
+
+
+def _broadcast_text(text: Optional[str], root_rank: int, name: str) -> str:
+    """Broadcast a variable-length UTF-8 string from ``root_rank``:
+    length first (fixed-shape negotiated broadcast), then the payload."""
+    import numpy as np
+    from horovod_tpu.ops import eager
+    data = (text or "").encode("utf-8")
+    n = int(np.asarray(eager.broadcast(
+        np.asarray(len(data), np.int64), root_rank, name=f"{name}.len")))
+    buf = np.zeros(n, np.uint8)
+    if basics.rank() == root_rank:
+        buf = np.frombuffer(data, np.uint8).copy()
+    out = np.asarray(eager.broadcast(buf, root_rank, name=f"{name}.bytes"))
+    return out.tobytes().decode("utf-8")
+
+
 def save_model(directory: str, params: Any, opt_state: Any,
-               epoch: int) -> Optional[str]:
+               epoch: int, optimizer=None) -> Optional[str]:
     """Save a full training state (params + optimizer state) under the
     ``{"params", "opt_state"}`` convention :func:`load_model` restores.
-    Rank-0-only like :func:`save`."""
+    Rank-0-only like :func:`save`.
+
+    ``optimizer`` (an :class:`OptimizerSpec`, ``(factory, kwargs)`` tuple,
+    or list of them) additionally persists the optimizer *identity* next
+    to the checkpoint, enabling :func:`load_model` to resume from the
+    directory alone — the reference's serialize-the-optimizer-too
+    behaviour (``horovod/keras/__init__.py:113-148``)."""
+    spec = _as_optimizer_spec(optimizer) if optimizer is not None else None
+    if spec is not None and not _params_resume_safe(params):
+        import warnings
+        warnings.warn(
+            "save_model: this params tree contains tuple nodes or "
+            "non-string dict keys, which the directory-only load_model "
+            "skeleton cannot reproduce (orbax stores tuples as lists; "
+            "JSON keys are strings) — resuming will need an explicit "
+            "params_like=.", stacklevel=2)
+    # The spec lands BEFORE the checkpoint commits: a concurrent
+    # directory-only load_model that sees checkpoint-N must always find
+    # N's spec (a stale spec without its checkpoint is harmless —
+    # latest_epoch only matches checkpoint dirs).
+    if basics.rank() == 0 and spec is not None:
+        os.makedirs(os.path.abspath(directory), exist_ok=True)
+        with open(_optimizer_spec_path(directory, epoch), "w") as f:
+            f.write(spec.to_json())
     return save(directory, {"params": params, "opt_state": opt_state},
                 epoch)
 
 
-def load_model(directory: str, optimizer, params_like: Any, *,
+def load_model(directory: str, optimizer=None, params_like: Any = None, *,
                root_rank: int = 0, average: bool = True,
-               compression=None):
+               compression=None, custom_objects=None):
     """One-call resume with the optimizer re-wrapped distributed — the
     reference's ``hvd.load_model`` (``horovod/keras/__init__.py:115-148``,
-    ``_impl.py:93-109``: restore the saved model, wrap its optimizer in
-    DistributedOptimizer, broadcast).
+    ``_impl.py:93-109``: restore the saved model, reconstruct its
+    optimizer from the file, wrap in DistributedOptimizer, broadcast).
 
     Args:
       directory: checkpoint directory written by :func:`save_model`.
       optimizer: the PLAIN optax optimizer (any chain, custom or not) —
-        it is wrapped in :func:`horovod_tpu.jax.DistributedOptimizer`
-        here, exactly like the reference rewraps the deserialized
-        optimizer class.
+        wrapped in :func:`horovod_tpu.jax.DistributedOptimizer` here,
+        exactly like the reference rewraps the deserialized optimizer
+        class.  **Omit it** to rebuild the optimizer from the
+        :class:`OptimizerSpec` persisted by
+        ``save_model(..., optimizer=...)``; ``custom_objects`` resolves
+        non-importable factory names then (the reference's
+        ``custom_optimizers``/``custom_objects``).
       params_like: a params pytree of the right structure/shapes (e.g.
         from ``model.init``) used both as the restore skeleton and as
-        the fresh state when no checkpoint exists.
+        the fresh state when no checkpoint exists.  **Omit it** to derive
+        the skeleton from the checkpoint's metadata (no data read; the
+        structure is broadcast from rank 0).  Params built of
+        string-keyed dicts / lists of arrays round-trip; tuple nodes,
+        non-string keys, and custom pytree nodes need an explicit
+        ``params_like`` (``save_model`` warns about such trees).
       average / compression: forwarded to ``DistributedOptimizer``.
 
     Returns ``(params, distributed_tx, opt_state, resume_epoch)``;
     ``resume_epoch`` is -1 (fresh params/opt_state, still broadcast from
-    ``root_rank``) when the directory holds no checkpoint.  The returned
+    ``root_rank``) when the directory holds no checkpoint — starting
+    fresh requires ``optimizer`` and ``params_like``.  The returned
     ``opt_state`` preserves the optimizer's own pytree structure through
     the round trip, custom chains included (the reference round-trips
     custom optimizers in ``test/test_keras.py:60-183``).
     """
+    import numpy as np
     from horovod_tpu.compression import NoneCompressor
     from horovod_tpu.jax import DistributedOptimizer
+    from horovod_tpu.ops import eager
 
     if compression is None:
         compression = NoneCompressor
+    if isinstance(optimizer, OptimizerSpec):
+        # Accept the same spec save_model's optimizer= takes — build it
+        # rather than surfacing an AttributeError from optimizer.init.
+        optimizer = optimizer.build(custom_objects)
+    agreed_epoch = None
+    if optimizer is None or params_like is None:
+        # Directory-only resume: agree on the epoch ONCE, then both the
+        # reconstruction here and the restore below use it — a checkpoint
+        # landing concurrently must not split the spec/skeleton and the
+        # weights across two different epochs.
+        epoch = latest_epoch(directory) if basics.rank() == root_rank else -1
+        epoch = int(np.asarray(eager.broadcast(
+            np.asarray(epoch, np.int64), root_rank,
+            name="ckpt.spec_epoch")))
+        agreed_epoch = epoch
+        if epoch < 0:
+            raise FileNotFoundError(
+                f"load_model: no checkpoint in {directory!r} to "
+                "reconstruct from; pass optimizer= and params_like= to "
+                "start fresh")
+        if optimizer is None:
+            spec_text = None
+            if basics.rank() == root_rank:
+                p = _optimizer_spec_path(directory, epoch)
+                spec_text = open(p).read() if os.path.exists(p) else ""
+            spec_text = _broadcast_text(spec_text, root_rank,
+                                        "ckpt.optspec")
+            if not spec_text:
+                raise FileNotFoundError(
+                    f"load_model: checkpoint-{epoch} in {directory!r} was "
+                    "saved without an optimizer spec (save_model's "
+                    "optimizer= argument); pass optimizer= explicitly")
+            optimizer = OptimizerSpec.from_json(spec_text).build(
+                custom_objects)
+        if params_like is None:
+            skel_json = None
+            if basics.rank() == root_rank:
+                # Metadata only — shapes/dtypes without reading the
+                # checkpoint data (the values are read once, below, in
+                # restore_and_broadcast).
+                meta = _checkpointer().metadata(
+                    checkpoint_path(directory, epoch))
+                tree = meta.item_metadata.tree
+                skel_json = json.dumps(_meta_to_spec(tree["params"]))
+            skel_json = _broadcast_text(skel_json, root_rank, "ckpt.pskel")
+            params_like = _spec_to_skeleton(json.loads(skel_json))
     tx = DistributedOptimizer(optimizer, average=average,
                               compression=compression)
     like = {"params": params_like, "opt_state": optimizer.init(params_like)}
     state, epoch = restore_and_broadcast(directory, like,
-                                         root_rank=root_rank)
+                                         root_rank=root_rank,
+                                         epoch=agreed_epoch)
     return state["params"], tx, state["opt_state"], epoch
 
 
 def restore_and_broadcast(directory: str, like: Any,
-                          root_rank: int = 0) -> Tuple[Any, int]:
+                          root_rank: int = 0,
+                          epoch: Optional[int] = None) -> Tuple[Any, int]:
     """Resume protocol (conventions 2+3): the resume epoch is agreed by
     broadcasting rank 0's scan; rank 0 restores; state is broadcast so all
     ranks start identical (reference ``keras_imagenet_resnet50.py:64-103``,
     ``pytorch_imagenet_resnet50.py:71,134-142``).
 
     Returns ``(state, resume_epoch)``; ``resume_epoch`` is -1 (and ``state``
-    is ``like``, broadcast from root) when no checkpoint exists.
+    is ``like``, broadcast from root) when no checkpoint exists.  Pass an
+    explicit ``epoch`` (already agreed across ranks) to restore that
+    checkpoint instead of re-scanning — callers that derived other state
+    from an epoch must restore the SAME one even if a new checkpoint
+    lands concurrently.
     """
     import numpy as np
     from horovod_tpu.jax import broadcast_parameters
     from horovod_tpu.ops import eager
 
-    epoch = latest_epoch(directory) if basics.rank() == root_rank else -1
-    epoch = int(np.asarray(eager.broadcast(
-        np.asarray(epoch, np.int64), root_rank, name="ckpt.resume_epoch")))
+    if epoch is None:
+        epoch = latest_epoch(directory) if basics.rank() == root_rank else -1
+        epoch = int(np.asarray(eager.broadcast(
+            np.asarray(epoch, np.int64), root_rank,
+            name="ckpt.resume_epoch")))
     state = like
     if epoch >= 0 and basics.rank() == root_rank:
         state = restore(directory, epoch, like)
