@@ -773,15 +773,43 @@ class Controller:
         blob = wire.serialize_request_list(pending, shutdown=shutting)
         resp_blob = self._control.tick(blob, self.fusion_threshold)
         responses, remote_shutdown = wire.parse_response_list(resp_blob)
+        ready = []
         for resp in responses:
             with self._lock:
                 entries = [self._tensor_table.pop(n)
                            for n in resp.tensor_names
                            if n in self._tensor_table]
             if entries:
-                self._executor.execute(resp, entries)
+                ready.append((resp, entries))
+        if self.timeline:
+            # QUEUE: response constructed → executor picks it up (the
+            # reference brackets the same wait, operations.h:35 +
+            # operations.cc:951 — later responses in one tick queue
+            # behind earlier ones executing).
+            for _, entries in ready:
+                self.timeline.activity_start_all(entries, "QUEUE")
+        self._execute_ready(ready)
         self._maybe_check_stalls_distributed()
         return remote_shutdown
+
+    def _execute_ready(self, ready):
+        """Run each popped (response, entries) pair; a raising executor
+        (normally impossible — execute converts failures to ERROR
+        callbacks) must not strand the LATER responses' already-popped
+        entries: their callbacks would never fire and no stall scan could
+        see them, so convert the failure and keep going."""
+        for resp, entries in ready:
+            if self.timeline:
+                self.timeline.activity_end_all(entries)
+            try:
+                self._executor.execute(resp, entries)
+            except Exception as exc:   # noqa: BLE001 — see docstring
+                status = Status(StatusType.UNKNOWN_ERROR, repr(exc))
+                for e in entries:
+                    try:
+                        e.callback(status, None)
+                    except Exception:   # noqa: BLE001 — best-effort
+                        pass
 
     def _maybe_check_stalls_distributed(self):
         if self.stall_check_disabled or self.topology.process_index != 0:
@@ -823,10 +851,17 @@ class Controller:
         fused = self._plan_fusion(responses, entry_bytes, entry_dtype,
                                   self.fusion_threshold)
 
+        ready = []
         for resp in fused:
             with self._lock:
                 entries = [self._tensor_table.pop(n) for n in resp.tensor_names]
-            self._executor.execute(resp, entries)
+            ready.append((resp, entries))
+        if self.timeline:
+            # QUEUE span per negotiated tensor: response constructed →
+            # executor start (reference operations.h:35, cc:951).
+            for _, entries in ready:
+                self.timeline.activity_start_all(entries, "QUEUE")
+        self._execute_ready(ready)
 
         self._maybe_check_stalls()
 

@@ -185,21 +185,78 @@ class _StepWatchdog:
         self._queue.put(out)
 
 
+class _GuardedStage:
+    """Proxy over a ``jax.stages`` Traced/Lowered object whose terminal
+    ``.compile()`` re-applies the dispatch-time wrapper (ordering guard /
+    watchdog / timeline spans), so the AOT route —
+    ``step.lower(...).compile()`` — keeps the same per-call contract as
+    direct dispatch (ADVICE r4: bench.py's own AOT path bypassed the
+    guard and the step watchdog)."""
+
+    def __init__(self, inner, rewrap):
+        self._inner = inner
+        self._rewrap = rewrap
+
+    def lower(self, *args, **kwargs):
+        return _GuardedStage(self._inner.lower(*args, **kwargs), self._rewrap)
+
+    def compile(self, *args, **kwargs):
+        return self._rewrap(self._inner.compile(*args, **kwargs))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _GuardedExecutable:
+    """Callable proxy over a compiled executable: each call runs through
+    ``around``; everything else (``cost_analysis`` etc.) delegates."""
+
+    def __init__(self, inner, around):
+        self._inner = inner
+        self._around = around
+
+    def __call__(self, *args, **kwargs):
+        return self._around(self._inner, args, kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _wrap_with_stages(fn, around):
+    """Build the dispatch wrapper for ``fn`` plus ``lower``/``trace``
+    passthroughs that keep ``around`` attached through AOT compilation."""
+
+    def wrapped(*args, **kwargs):
+        return around(fn, args, kwargs)
+
+    def rewrap(compiled):
+        return _GuardedExecutable(compiled, around)
+
+    for attr in ("lower", "trace"):
+        if hasattr(fn, attr):
+            def passthrough(*a, _m=getattr(fn, attr), **kw):
+                return _GuardedStage(_m(*a, **kw), rewrap)
+            setattr(wrapped, attr, passthrough)
+    return wrapped
+
+
 def _ordering_guard(fn, what: str = "make_train_step"):
     """Enforce the shared-runtime async-eager ordering contract at every
     dispatch: launching this jitted collective program while ``*_async``
     eager collectives are outstanding on a shared multi-controller
     runtime could interleave program launches differently per process
     (see :func:`horovod_tpu.basics.check_mesh_async_ordering`).  One
-    attribute check + counter read per step when a controller exists."""
+    attribute check + counter read per step when a controller exists.
+    AOT compilation through the returned wrapper's ``lower``/``trace``
+    yields executables with the same guard."""
     from horovod_tpu import basics
 
     timeout_s = float(os.environ.get("HOROVOD_TPU_STEP_TIMEOUT_S", "0"))
     watchdog = _StepWatchdog(timeout_s) if timeout_s > 0 else None
 
-    def wrapped(*args, **kwargs):
+    def around(target, args, kwargs):
         basics.check_mesh_async_ordering(what)
-        out = fn(*args, **kwargs)
+        out = target(*args, **kwargs)
         if watchdog is not None:
             # Watch the loss: other outputs are typically donated into
             # the next call; one executable's outputs become ready
@@ -207,10 +264,7 @@ def _ordering_guard(fn, what: str = "make_train_step"):
             watchdog.watch(out[-1] if isinstance(out, tuple) else out)
         return out
 
-    for attr in ("lower", "trace"):   # AOT entry points pass through
-        if hasattr(fn, attr):
-            setattr(wrapped, attr, getattr(fn, attr))
-    return wrapped
+    return _wrap_with_stages(fn, around)
 
 
 class _StepSpans:
@@ -268,13 +322,13 @@ class _StepSpans:
     def instrument(self, fn):
         import threading
 
-        def wrapped(*args, **kwargs):
+        def around(target, args, kwargs):
             timeline = self._timeline()
             if timeline is None:
-                return fn(*args, **kwargs)
+                return target(*args, **kwargs)
             timeline.activity_start_all([self._dispatch], "DISPATCH")
             try:
-                out = fn(*args, **kwargs)
+                out = target(*args, **kwargs)
             finally:
                 # A raising step must not leave an unbalanced B event.
                 timeline.activity_end_all([self._dispatch])
@@ -293,10 +347,7 @@ class _StepSpans:
             self._queue.put((timeline, watch))
             return out
 
-        for attr in ("lower", "trace"):   # AOT entry points pass through
-            if hasattr(fn, attr):
-                setattr(wrapped, attr, getattr(fn, attr))
-        return wrapped
+        return _wrap_with_stages(fn, around)
 
 
 def make_train_step(
@@ -419,7 +470,8 @@ def make_train_step(
         plain_body = functools.partial(scan_steps, plain_one)
     else:
         plain_body = plain_one
-    plain_step = jax.jit(plain_body, donate_argnums=donate_argnums)
+    plain_step = _ordering_guard(
+        jax.jit(plain_body, donate_argnums=donate_argnums))
     chosen = []
 
     def _resolve(args):

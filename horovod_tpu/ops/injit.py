@@ -15,10 +15,13 @@ gradient semantics) a TPU-native home:
 * ``allgather``  ↔ ``MPI_Allgatherv`` (``operations.cc:796-856``); gradient
   is reduce-scatter = "allreduce then slice by rank offset"
   (``mpi_ops.py:126-164``), which is exactly the transpose XLA derives.
-* ``broadcast``  ↔ ``MPI_Bcast`` (``operations.cc:1333-1353``); implemented
-  as a masked psum so its JAX-derived gradient is "allreduce, zeroed on
-  non-root ranks" — matching the registered gradient at
-  ``mpi_ops.py:167-182``.
+* ``broadcast``  ↔ ``MPI_Bcast`` (``operations.cc:1333-1353``); a real
+  broadcast forward (binomial tree of CollectivePermutes — no AllReduce in
+  the compiled program) whose ``custom_vjp`` backward is "psum the upstream
+  grad, zeroed on non-root ranks" — the registered gradient at
+  ``mpi_ops.py:167-182``.  ``mode="psum"`` selects the masked-psum
+  formulation instead when a VMA-*invariant* (provably replicated) output
+  is required.
 
 All take ``axis_name`` (default ``'ranks'``, the world mesh axis) and work
 under ``shard_map``/``pmap`` with that axis in scope.
@@ -26,6 +29,7 @@ under ``shard_map``/``pmap`` with that axis in scope.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Union
 
 import jax
@@ -79,16 +83,77 @@ def allgather(x, *, axis_name: AxisName = RANKS_AXIS, axis: int = 0):
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
-def broadcast(x, root_rank: int, *, axis_name: AxisName = RANKS_AXIS):
+def _tree_broadcast(x, root_rank: int, axis_name: str):
+    """Binomial-tree broadcast: ceil(log2 n) CollectivePermute rounds, the
+    set of ranks holding root's value doubling each round.  No AllReduce
+    appears in the program."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    rel = (idx - root_rank) % n
+    cur = x
+    step = 1
+    while step < n:
+        perm = [((root_rank + s) % n, (root_rank + s + step) % n)
+                for s in range(step) if s + step < n]
+        recv = lax.ppermute(cur, axis_name, perm)
+        got = (rel >= step) & (rel < 2 * step)
+        cur = jnp.where(got, recv, cur)
+        step *= 2
+    return cur
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _broadcast_permute(x, root_rank: int, axis_name: str):
+    return _tree_broadcast(x, root_rank, axis_name)
+
+
+def _broadcast_permute_fwd(x, root_rank, axis_name):
+    return _tree_broadcast(x, root_rank, axis_name), None
+
+
+def _broadcast_permute_bwd(root_rank, axis_name, _res, g):
+    # The reference's registered gradient (mpi_ops.py:167-182): allreduce
+    # the upstream grad; non-root ranks contribute zeros downstream.
+    idx = lax.axis_index(axis_name)
+    total = lax.psum(g, axis_name)
+    return (jnp.where(idx == root_rank, total,
+                      jnp.zeros_like(total)),)
+
+
+_broadcast_permute.defvjp(_broadcast_permute_fwd, _broadcast_permute_bwd)
+
+
+def broadcast(x, root_rank: int, *, axis_name: AxisName = RANKS_AXIS,
+              mode: str = "permute"):
     """Every rank receives rank ``root_rank``'s value of ``x``.
 
-    Masked-psum formulation: its autodiff transpose is psum of the cotangent
-    with non-root ranks zeroed — the exact registered gradient of the
-    reference (``horovod/tensorflow/mpi_ops.py:167-182``).
+    ``mode="permute"`` (default): a real broadcast — binomial tree of
+    CollectivePermutes, no AllReduce in the forward program — with a
+    ``custom_vjp`` reproducing the reference's registered gradient (psum
+    of the cotangent, zeroed off-root, ``mpi_ops.py:167-182``).  Its
+    output is VMA-**varying** (equal on every rank in fact, but the
+    checker cannot see through a permute), so under
+    ``shard_map(check_vma=True)`` return it through a per-rank
+    ``out_spec`` (e.g. ``P('ranks')``) or keep consuming it in-scope.
+    Code that returned the old masked-psum result through a REPLICATED
+    ``out_spec`` (``P()``) will now fail at trace time with shard_map's
+    varying-over-mesh-axes error — pass ``mode="psum"`` there to keep
+    the provably-invariant formulation.
+
+    ``mode="psum"``: the masked-psum formulation — ~2× the bytes on the
+    forward but VMA-*invariant* output (usable with replicated
+    ``out_specs``) and the same gradient via the autodiff transpose.
+    Composite ``axis_name`` tuples always take this path (a tree over a
+    product of axes would need a linearized permute).
     """
-    idx = lax.axis_index(axis_name)
-    mask = (idx == root_rank).astype(x.dtype)
-    return lax.psum(x * mask, axis_name)
+    if mode not in ("permute", "psum"):
+        raise ValueError(f"broadcast mode must be 'permute' or 'psum', "
+                         f"got {mode!r}")
+    if mode == "psum" or not isinstance(axis_name, str):
+        idx = lax.axis_index(axis_name)
+        mask = (idx == root_rank).astype(x.dtype)
+        return lax.psum(x * mask, axis_name)
+    return _broadcast_permute(x, root_rank, axis_name)
 
 
 def reducescatter(x, *, average: bool = False,

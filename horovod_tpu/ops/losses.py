@@ -40,7 +40,15 @@ def _pick_chunk(n: int, target: int) -> int:
     exactly; callers flatten (B, T) so n is composite in practice)."""
     if n <= target:
         return n
-    return max(d for d in range(1, target + 1) if n % d == 0)
+    chunk = max(d for d in range(1, target + 1) if n % d == 0)
+    if chunk < max(1, target // 8):
+        import warnings
+        warnings.warn(
+            f"fused cross-entropy: token count {n} has no divisor near the "
+            f"target chunk {target} (best is {chunk}); the scan degenerates "
+            f"to {n // chunk} tiny (chunk={chunk}, vocab) tiles. Pad or "
+            f"flatten the batch to a composite token count.", stacklevel=3)
+    return chunk
 
 
 def _chunk_fwd(h_c, w, labels_c):

@@ -2,11 +2,13 @@
 
 Mirrors ``horovod/common/timeline.{h,cc}``: each named tensor is modelled as
 a trace "process" (metadata event naming it); spans cover the negotiation
-phase (NEGOTIATE_ALLREDUCE etc. with per-rank instant events), the top-level
-operation, and nested activities (QUEUE, MEMCPY_IN_FUSION_BUFFER,
-XLA_ALLREDUCE, ...).  Opened on rank 0 only, when ``HOROVOD_TPU_TIMELINE``
-is set (reference ``operations.cc:1556-1560``).  Output loads in
-``chrome://tracing`` / Perfetto.
+phase (NEGOTIATE_ALLREDUCE etc. with per-rank instant events), a QUEUE span
+(response constructed → executor start, the reference's time-in-queue
+bracket, ``operations.h:35``), the top-level operation, and nested
+activities (MEMCPY_IN_FUSION_BUFFER, XLA_ALLREDUCE, ...).  Opened on rank 0
+only, when ``HOROVOD_TPU_TIMELINE`` is set (reference
+``operations.cc:1556-1560``).  Output loads in ``chrome://tracing`` /
+Perfetto.
 
 This complements (does not replace) the XLA profiler: it shows the
 control-plane life cycle of every named tensor, which device-side profiles
