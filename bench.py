@@ -430,8 +430,16 @@ def bench_transformer(jax, hvd, mesh, nchips):
     achieved = model_flops * spc / (dt / timed_batches)
     mfu = achieved / peak if peak else None
     mfu_xla = None
+    mfu_xla_note = None
     if flops and peak:
         mfu_xla = flops * spc / (dt / timed_batches) / peak
+        if mfu_xla > 1.0 and spc > 1:
+            # Guard against a jax/XLA change that starts multiplying the
+            # scan-body cost by trip count: >1.0 MFU is physically
+            # impossible, so drop our own spc scaling and say so.
+            mfu_xla = flops / (dt / timed_batches) / peak
+            mfu_xla_note = ("cost model appears to include the scan trip "
+                            "count; spc scaling removed")
     return {
         "transformer_lm": {
             "tokens_per_sec_per_chip": round(tok_per_sec / nchips, 1),
@@ -439,6 +447,7 @@ def bench_transformer(jax, hvd, mesh, nchips):
             "mfu": (round(mfu, 4) if mfu is not None else None),
             "mfu_xla_cost_model": (round(mfu_xla, 4)
                                    if mfu_xla is not None else None),
+            **({"mfu_xla_note": mfu_xla_note} if mfu_xla_note else {}),
             "achieved_model_tflops_per_chip": round(achieved / 1e12, 2),
             "dim": dim, "depth": depth, "seq_len": seq,
             "batch_per_chip": batch_per_chip, "attn": attn,
@@ -512,10 +521,16 @@ def tcp_worker():
     np.asarray(loss)
     dt = time.perf_counter() - t0
     if hvd.rank() == 0:
+        from horovod_tpu import basics
+        control = getattr(basics.controller(), "_control", None)
+        transport = (control.ring_transport()
+                     if control is not None
+                     and hasattr(control, "ring_transport") else "none")
         print("TCPLEG " + json.dumps({
             "n_proc": n,
             "images_per_sec_per_proc": round(batch * iters / dt, 2),
             "comm_fraction": round(t_comm / dt, 4),
+            "ring_transport": transport,
         }), flush=True)
     hvd.shutdown()
 
@@ -550,9 +565,13 @@ def bench_scaling_tcp():
 
     one = run_leg(1)
     two = run_leg(2)
+    transport = two.get("ring_transport", "tcp")
     return {
         "n_proc": 2,
-        "transport": "native TCP ring (disjoint runtimes)",
+        "transport": ("native ring over Unix domain sockets (co-located "
+                      "on-host fast path)" if transport == "uds"
+                      else "native TCP ring (disjoint runtimes)"),
+        "ring_transport": transport,
         "images_per_sec_per_proc_1": one["images_per_sec_per_proc"],
         "images_per_sec_per_proc_2": two["images_per_sec_per_proc"],
         "scaling_efficiency": round(

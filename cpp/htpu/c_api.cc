@@ -219,15 +219,27 @@ HTPU_API int htpu_control_tick(void* cp, const void* req_blob, int len,
 
 // Exceptions (e.g. bad_alloc on giant payloads) must not cross the C
 // boundary into ctypes; data-plane failures are -1 like any other error.
+// One copy total: the input lands straight in the malloc'd output buffer
+// and the ring reduces in place (the payload path measured copy-bound at
+// multi-MB gradients — docs/benchmarks.md, round-5 eager plane study).
 HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
                            long long len, void** out) try {
-  std::string contrib(static_cast<const char*>(in), size_t(len));
-  std::string result;
-  if (!static_cast<htpu::ControlPlane*>(cp)->Allreduce(dtype, contrib,
-                                                       &result)) {
+  char* buf = static_cast<char*>(malloc(len > 0 ? size_t(len) : 1));
+  if (!buf) return -1;
+  std::memcpy(buf, in, size_t(len));
+  bool ok = false;
+  try {
+    ok = static_cast<htpu::ControlPlane*>(cp)->AllreduceBuf(dtype, buf,
+                                                            len);
+  } catch (...) {
+    ok = false;   // e.g. bad_alloc sizing the ring's tmp segment buffer
+  }
+  if (!ok) {
+    free(buf);
     return -1;
   }
-  return CopyOut(result, out);
+  *out = buf;
+  return int(len);
 } catch (...) {
   return -1;
 }
@@ -260,6 +272,11 @@ HTPU_API int htpu_control_broadcast(void* cp, int root_process, const void* in,
 // Cumulative eager-data-plane payload traffic of this process.
 HTPU_API void htpu_control_data_bytes(void* cp, long long* sent, long long* recvd) {
   static_cast<htpu::ControlPlane*>(cp)->DataBytes(sent, recvd);
+}
+
+// Ring-next transport: static string "uds" / "tcp" / "none".
+HTPU_API const char* htpu_control_ring_transport(void* cp) {
+  return static_cast<htpu::ControlPlane*>(cp)->ring_transport();
 }
 
 // Coordinator-side stall scan; same length-prefixed record format as

@@ -1,6 +1,10 @@
 #include "htpu/control.h"
 
+#include <unistd.h>
+
 #include <algorithm>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <numeric>
 #include <stdexcept>
@@ -12,6 +16,28 @@
 namespace htpu {
 
 namespace {
+
+// Host-unique identity for the on-host fast path — same resolution as
+// topology.host_fingerprint (boot id, else hostname): unique per booted
+// host and shared by every container on it.
+std::string HostFingerprint() {
+  std::string fp;
+  FILE* f = fopen("/proc/sys/kernel/random/boot_id", "r");
+  if (f) {
+    char buf[128];
+    if (fgets(buf, sizeof(buf), f)) {
+      fp = buf;
+      while (!fp.empty() && (fp.back() == '\n' || fp.back() == '\r'))
+        fp.pop_back();
+    }
+    fclose(f);
+  }
+  if (fp.empty()) {
+    char name[256] = {0};
+    if (gethostname(name, sizeof(name) - 1) == 0) fp = name;
+  }
+  return fp;
+}
 
 // Handshake payload: process_index:i32 first_rank:i32 (little-endian).
 std::string HandshakeBlob(int process_index, int first_rank) {
@@ -84,20 +110,43 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
 }
 
 bool ControlPlane::SetupRing(const std::string& coord_host) {
-  // 1. Every process opens an ephemeral listen socket for its ring-prev.
+  // 1. Every process opens an ephemeral listen socket for its ring-prev —
+  // plus a Unix-domain listener so a CO-LOCATED prev can skip the
+  // loopback TCP stack (the on-host fast path MPI gets from its
+  // shared-memory BTL behind the reference's CPU plane,
+  // operations.cc:1232-1327).  HOROVOD_TPU_UDS=0 disables for A/B runs.
   int ring_port = 0;
   int ring_listen = Listen(0, &ring_port);
   if (ring_listen < 0) return false;
+  const char* uds_env = getenv("HOROVOD_TPU_UDS");
+  bool uds_enabled = !(uds_env && std::string(uds_env) == "0");
+  std::string uds_path;
+  int uds_listen = -1;
+  if (uds_enabled) {
+    uds_path = "/tmp/htpu_ring_" + std::to_string(getpid()) + "_" +
+               std::to_string(ring_port) + ".sock";
+    uds_listen = ListenUnix(uds_path);
+    if (uds_listen < 0) uds_path.clear();
+  }
 
-  // 2. Advertise "host\tport\tfirst_rank".  The coordinator is reachable at
-  // the address everyone already dialed; a worker advertises the local
-  // address of its coordinator connection (the interface that routes to
-  // the rest of the job).
+  // 2. Advertise "host\tport\tfirst_rank\tfingerprint\tuds_path".  The
+  // coordinator is reachable at the address everyone already dialed; a
+  // worker advertises the local address of its coordinator connection
+  // (the interface that routes to the rest of the job).  The fingerprint
+  // (boot id, the same identity topology.host_fingerprint uses) tells the
+  // ring-prev peer whether the uds_path is on its own host.
   std::string host =
       is_coordinator() ? coord_host : LocalAddrOf(coord_fd_);
   if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
   std::string record = host + "\t" + std::to_string(ring_port) + "\t" +
-                       std::to_string(first_rank_);
+                       std::to_string(first_rank_) + "\t" +
+                       HostFingerprint() + "\t" + uds_path;
+
+  auto cleanup = [&]() {
+    CloseFd(ring_listen);
+    CloseFd(uds_listen);
+    if (!uds_path.empty()) unlink(uds_path.c_str());
+  };
 
   // 3. Exchange the address book over the star.
   std::string book;
@@ -107,7 +156,7 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     for (int i = 1; i < process_count_; ++i) {
       if (!RecvFrame(worker_fds_[size_t(i)], &records[size_t(i)],
                      timeout_ms_)) {
-        CloseFd(ring_listen);
+        cleanup();
         return false;
       }
     }
@@ -117,20 +166,20 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     }
     for (int i = 1; i < process_count_; ++i) {
       if (!SendFrame(worker_fds_[size_t(i)], book)) {
-        CloseFd(ring_listen);
+        cleanup();
         return false;
       }
     }
   } else {
     if (!SendFrame(coord_fd_, record) ||
         !RecvFrame(coord_fd_, &book, timeout_ms_)) {
-      CloseFd(ring_listen);
+      cleanup();
       return false;
     }
   }
 
-  // 4. Parse the book; dial ring-next, accept ring-prev.
-  std::vector<std::string> hosts;
+  // 4. Parse the book (one tab-separated record per process).
+  std::vector<std::string> hosts, fps, uds_paths;
   std::vector<int> ports;
   all_first_ranks_.clear();
   size_t pos = 0;
@@ -138,31 +187,56 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     size_t nl = book.find('\n', pos);
     std::string line =
         book.substr(pos, nl == std::string::npos ? nl : nl - pos);
-    size_t t1 = line.find('\t'), t2 = line.rfind('\t');
-    if (t1 == std::string::npos || t2 == t1) {
-      CloseFd(ring_listen);
+    std::vector<std::string> fields;
+    size_t fpos = 0;
+    while (fpos <= line.size()) {
+      size_t tab = line.find('\t', fpos);
+      fields.push_back(line.substr(
+          fpos, tab == std::string::npos ? tab : tab - fpos));
+      if (tab == std::string::npos) break;
+      fpos = tab + 1;
+    }
+    if (fields.size() < 5) {
+      cleanup();
       return false;
     }
-    hosts.push_back(line.substr(0, t1));
-    ports.push_back(std::stoi(line.substr(t1 + 1, t2 - t1 - 1)));
-    all_first_ranks_.push_back(std::stoi(line.substr(t2 + 1)));
+    hosts.push_back(fields[0]);
+    ports.push_back(std::stoi(fields[1]));
+    all_first_ranks_.push_back(std::stoi(fields[2]));
+    fps.push_back(fields[3]);
+    uds_paths.push_back(fields[4]);
     if (nl == std::string::npos) break;
     pos = nl + 1;
   }
   if (int(hosts.size()) != process_count_) {
-    CloseFd(ring_listen);
+    cleanup();
     return false;
   }
 
+  // 5. Dial ring-next — UDS when the peer is on this host and advertises
+  // a path (falling back to TCP if the path does not resolve, e.g.
+  // containers sharing a boot id but not /tmp) — then accept ring-prev on
+  // whichever listener it picked.
   int next = (process_index_ + 1) % process_count_;
-  ring_next_fd_ = DialRetry(hosts[size_t(next)], ports[size_t(next)],
-                            timeout_ms_);
+  std::string my_fp = HostFingerprint();
+  if (uds_enabled && !uds_paths[size_t(next)].empty() &&
+      !my_fp.empty() && fps[size_t(next)] == my_fp) {
+    ring_next_fd_ =
+        DialUnixRetry(uds_paths[size_t(next)],
+                      timeout_ms_ < 5000 ? timeout_ms_ : 5000);
+    if (ring_next_fd_ >= 0) ring_transport_ = "uds";
+  }
   if (ring_next_fd_ < 0) {
-    CloseFd(ring_listen);
+    ring_next_fd_ = DialRetry(hosts[size_t(next)], ports[size_t(next)],
+                              timeout_ms_);
+    if (ring_next_fd_ >= 0) ring_transport_ = "tcp";
+  }
+  if (ring_next_fd_ < 0) {
+    cleanup();
     return false;
   }
-  ring_prev_fd_ = AcceptOne(ring_listen, timeout_ms_);
-  CloseFd(ring_listen);
+  ring_prev_fd_ = AcceptEither(ring_listen, uds_listen, timeout_ms_);
+  cleanup();
   return ring_prev_fd_ >= 0;
 }
 
@@ -267,14 +341,27 @@ bool ControlPlane::Allreduce(const std::string& dtype, const std::string& in,
 // the reference got the same property from MPI's ring algorithms for free.
 bool ControlPlane::RingAllreduce(const std::string& dtype,
                                  const std::string& in, std::string* out) {
+  *out = in;
+  return in.empty() ||
+         AllreduceBuf(dtype, &(*out)[0], int64_t(out->size()));
+}
+
+// In-place chunked ring allreduce on a raw buffer: reduce-scatter then
+// allgather, P-1 steps each.  Every step sends one segment downstream
+// while receiving another from upstream (full duplex), so per-process
+// traffic is 2*(P-1)/P * payload — the reference got the same property
+// from MPI's ring algorithms for free.  Operating in place on the
+// caller's buffer keeps the copy count at one for the whole C API round
+// trip (the payload path was measured copy-bound, docs/benchmarks.md).
+bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
+                                int64_t nbytes) {
+  if (process_count_ == 1) return true;
   const int P = process_count_;
   const int r = process_index_;
   const int elem = DtypeSize(dtype);
-  if (elem <= 0 || in.size() % size_t(elem) != 0) return false;
-  const int64_t n_elems = int64_t(in.size()) / elem;
-
-  *out = in;
-  if (in.empty()) return true;
+  if (elem <= 0 || nbytes % elem != 0) return false;
+  const int64_t n_elems = nbytes / elem;
+  if (n_elems == 0) return true;
 
   // Segment boundaries by element count (segments may be empty when
   // n_elems < P).
@@ -299,7 +386,7 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
     int send_seg = (r - s + P) % P;
     int recv_seg = (r - s - 1 + P) % P;
     int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
-    if (!DuplexTransfer(ring_next_fd_, out->data() + off_bytes(send_seg),
+    if (!DuplexTransfer(ring_next_fd_, data + off_bytes(send_seg),
                         size_t(sbytes), ring_prev_fd_, &tmp[0],
                         size_t(rbytes), timeout_ms_)) {
       return false;
@@ -307,8 +394,7 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
     data_bytes_sent_ += sbytes;
     data_bytes_recv_ += rbytes;
     if (rbytes &&
-        !SumInto(dtype, &(*out)[size_t(off_bytes(recv_seg))], tmp.data(),
-                 rbytes)) {
+        !SumInto(dtype, data + off_bytes(recv_seg), tmp.data(), rbytes)) {
       return false;
     }
   }
@@ -318,9 +404,9 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
     int send_seg = (r + 1 - s + P) % P;
     int recv_seg = (r - s + P) % P;
     int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
-    if (!DuplexTransfer(ring_next_fd_, out->data() + off_bytes(send_seg),
+    if (!DuplexTransfer(ring_next_fd_, data + off_bytes(send_seg),
                         size_t(sbytes), ring_prev_fd_,
-                        &(*out)[size_t(off_bytes(recv_seg))], size_t(rbytes),
+                        data + off_bytes(recv_seg), size_t(rbytes),
                         timeout_ms_)) {
       return false;
     }

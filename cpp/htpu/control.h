@@ -55,6 +55,10 @@ class ControlPlane {
   // elsewhere).
   bool Allreduce(const std::string& dtype, const std::string& in,
                  std::string* out);
+  // Zero-extra-copy variant: reduce IN PLACE on the caller's buffer (the
+  // C API round trip is copy-bound at multi-MB payloads; this keeps it
+  // at one copy total).
+  bool AllreduceBuf(const std::string& dtype, char* data, int64_t nbytes);
   bool Allgather(const std::string& in, std::string* out);
   bool Broadcast(int root_process, const std::string& in, std::string* out);
 
@@ -63,6 +67,10 @@ class ControlPlane {
       double age_s) const;
 
   int process_count() const { return process_count_; }
+
+  // Transport the ring-next hop rides: "uds" (co-located peer, on-host
+  // fast path), "tcp", or "none" (single process).
+  const char* ring_transport() const { return ring_transport_; }
 
   // Cumulative eager-data-plane traffic of THIS process (payload bytes put
   // on / taken off the wire).  Lets tests assert the ring's O(payload)
@@ -103,6 +111,7 @@ class ControlPlane {
   // Ring data plane (all processes when process_count > 1).
   int ring_next_fd_ = -1;   // to process (index+1) % P
   int ring_prev_fd_ = -1;   // from process (index-1+P) % P
+  const char* ring_transport_ = "none";
   std::vector<int> all_first_ranks_;  // first global rank per process index
   long long data_bytes_sent_ = 0;
   long long data_bytes_recv_ = 0;

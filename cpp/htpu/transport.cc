@@ -6,6 +6,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <chrono>
@@ -108,9 +109,77 @@ int AcceptOne(int listen_fd, int timeout_ms) {
   int fd = accept(listen_fd, nullptr, nullptr);
   if (fd >= 0) {
     int one = 1;
+    // No-op (EOPNOTSUPP) on non-TCP sockets such as AF_UNIX.
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   }
   return fd;
+}
+
+int ListenUnix(const std::string& path) {
+  struct sockaddr_un addr;
+  if (path.empty() || path.size() >= sizeof(addr.sun_path)) return -1;
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  unlink(path.c_str());  // replace a stale socket file from a dead job
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  std::memcpy(addr.sun_path, path.c_str(), path.size());
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int DialUnixRetry(const std::string& path, int timeout_ms) {
+  struct sockaddr_un addr;
+  if (path.empty() || path.size() >= sizeof(addr.sun_path)) return -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd >= 0) {
+      std::memset(&addr, 0, sizeof(addr));
+      addr.sun_family = AF_UNIX;
+      std::memcpy(addr.sun_path, path.c_str(), path.size());
+      if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+        return fd;
+      }
+      int err = errno;
+      close(fd);
+      // The peer binds its path BEFORE advertising it, so a missing
+      // path is conclusive (private /tmp mounts in co-located
+      // containers): fail straight to the TCP fallback instead of
+      // burning the retry window.
+      if (err == ENOENT) return -1;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+int AcceptEither(int listen_fd_a, int listen_fd_b, int timeout_ms) {
+  struct pollfd fds[2];
+  int nfds = 0;
+  if (listen_fd_a >= 0) {
+    fds[nfds].fd = listen_fd_a;
+    fds[nfds].events = POLLIN;
+    ++nfds;
+  }
+  if (listen_fd_b >= 0) {
+    fds[nfds].fd = listen_fd_b;
+    fds[nfds].events = POLLIN;
+    ++nfds;
+  }
+  if (nfds == 0) return -1;
+  int rc = poll(fds, nfds_t(nfds), timeout_ms);
+  if (rc <= 0) return -1;
+  for (int i = 0; i < nfds; ++i) {
+    if (fds[i].revents & POLLIN) return AcceptOne(fds[i].fd, timeout_ms);
+  }
+  return -1;
 }
 
 bool SendFrame(int fd, const std::string& payload) {

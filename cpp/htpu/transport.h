@@ -36,6 +36,24 @@ int Listen(int port, int* out_port);
 // Accept one connection (blocking, with timeout); fd or -1.
 int AcceptOne(int listen_fd, int timeout_ms);
 
+// Unix-domain-socket variants for the on-host fast path: co-located
+// processes skip the loopback TCP stack entirely (the role MPI's
+// shared-memory BTL plays behind the reference's CPU data plane,
+// operations.cc:1232-1327).  The ring algorithms are fd-agnostic, so a
+// UDS fd drops straight into DuplexTransfer/SendFrame/RecvFrame.
+// ListenUnix binds (replacing any stale socket file) and listens; -1 on
+// failure (e.g. path exceeds sockaddr_un limits).
+int ListenUnix(const std::string& path);
+
+// Dial a UDS path, retrying up to `timeout_ms`; fd or -1.  A co-located
+// peer that advertises a path this process cannot reach (distinct mount
+// namespaces) simply times out and the caller falls back to TCP.
+int DialUnixRetry(const std::string& path, int timeout_ms);
+
+// Accept one connection from whichever of two listeners (either may be
+// -1) becomes readable first; fd or -1 on timeout.
+int AcceptEither(int listen_fd_a, int listen_fd_b, int timeout_ms);
+
 // Send a length-framed message; false on error.
 bool SendFrame(int fd, const std::string& payload);
 

@@ -81,7 +81,7 @@ def _configure(lib) -> None:
         ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_control_allreduce.restype = ctypes.c_int
     lib.htpu_control_allreduce.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_control_allgather.restype = ctypes.c_int
     lib.htpu_control_allgather.argtypes = [
@@ -98,6 +98,8 @@ def _configure(lib) -> None:
     lib.htpu_control_data_bytes.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong)]
+    lib.htpu_control_ring_transport.restype = ctypes.c_char_p
+    lib.htpu_control_ring_transport.argtypes = [ctypes.c_void_p]
 
 
 def load():
@@ -284,10 +286,21 @@ class CppControlPlane:
             raise ConnectionError("control-plane tick failed")
         return _take_buffer(self._lib, out, n)
 
-    def allreduce(self, dtype: str, data: bytes) -> bytes:
+    def allreduce(self, dtype: str, data) -> bytes:
+        """Ring-allreduce ``data`` (bytes, or a C-contiguous numpy array —
+        arrays are read straight from their buffer, skipping a
+        ``tobytes`` copy; the payload path is copy-bound at multi-MB
+        gradients)."""
+        import numpy as np
+        if isinstance(data, np.ndarray):
+            if not data.flags["C_CONTIGUOUS"]:
+                data = np.ascontiguousarray(data)
+            ptr, length = data.ctypes.data, data.nbytes
+        else:
+            ptr, length = data, len(data)
         out = ctypes.c_void_p()
         n = self._lib.htpu_control_allreduce(
-            self._ptr, dtype.encode("utf-8"), data, len(data),
+            self._ptr, dtype.encode("utf-8"), ptr, length,
             ctypes.byref(out))
         if n < 0:
             raise ConnectionError("data-plane allreduce failed")
@@ -318,6 +331,13 @@ class CppControlPlane:
         self._lib.htpu_control_data_bytes(self._ptr, ctypes.byref(sent),
                                           ctypes.byref(recvd))
         return sent.value, recvd.value
+
+    def ring_transport(self) -> str:
+        """'uds' when the ring-next hop rides a Unix domain socket (the
+        co-located on-host fast path), 'tcp' across hosts, 'none' when
+        single-process."""
+        return self._lib.htpu_control_ring_transport(
+            self._ptr).decode("ascii")
 
     def stalled(self, age_s: float):
         out = ctypes.c_void_p()

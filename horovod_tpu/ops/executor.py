@@ -544,7 +544,8 @@ class DistributedExecutor(Executor):
             self.timeline.activity_end_all(entries)
             self.timeline.activity_start_all(entries, "TCP_ALLREDUCE")
         reduced = np.frombuffer(
-            self._control.allreduce(str(dtype), buf.tobytes()), dtype=dtype)
+            self._control.allreduce(str(dtype), np.ascontiguousarray(buf)),
+            dtype=dtype)
         if self.timeline:
             self.timeline.activity_end_all(entries)
         return reduced
