@@ -489,3 +489,92 @@ class TestFlashUnderShardMap:
             params, _, opt_state, loss = step(params, {}, opt_state, toks)
             losses.append(float(np.asarray(loss)))
         assert losses[-1] < losses[0]
+
+
+class TestHeadGroupBwd:
+    """HOROVOD_TPU_FLASH_BWD_GROUP=G routes the packed backward through
+    the head-group blocked kernel pair (contiguous group*D-wide tiles,
+    VERDICT r4 weak #3) — gradients must be oracle-exact for every
+    layout the packed path serves."""
+
+    def test_grouped_matches_oracle_flash_attention(self, hvd, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD_GROUP", "2")
+        q, k, v = make_qkv(jax.random.PRNGKey(41), 2, 32, 4, 128)
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=8,
+                                    block_k=8, interpret=True) ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_grouped_matches_ungrouped_qkv_proj(self, hvd, monkeypatch):
+        """Fused-qkv head bases (0, H, 2H) with group=2: the grouped
+        index maps divide the bases by the group size.  Per-head math is
+        identical to the per-head packed kernels, so the gradients must
+        match them EXACTLY (the per-head path is itself oracle-checked
+        in test_merged_bwd_ab_qkv_proj)."""
+        from horovod_tpu.ops.flash_attention import flash_qkv_proj
+
+        B, T, H, D = 1, 24, 4, 128
+        C = H * D
+        x = jax.random.normal(jax.random.PRNGKey(42), (B, T, C))
+        w = jax.random.normal(jax.random.PRNGKey(43), (C, 3 * C)) * 0.1
+
+        def loss(x, w):
+            return (flash_qkv_proj(x, w, H, causal=True, block_q=8,
+                                   block_k=8, interpret=True) ** 2).sum()
+
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD_GROUP", "1")
+        want = jax.grad(loss, argnums=(0, 1))(x, w)
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD_GROUP", "2")
+        got = jax.grad(loss, argnums=(0, 1))(x, w)
+        for g, w_ in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w_))
+
+    def test_nondividing_group_falls_back(self, hvd, monkeypatch):
+        """group=3 with H=2 cannot tile; the per-head path must serve
+        the gradient unchanged rather than erroring."""
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD_GROUP", "3")
+        q, k, v = make_qkv(jax.random.PRNGKey(44), 1, 16, 2, 128)
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=8,
+                                    block_k=8, interpret=True) ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_padded_seq_len_grouped(self, hvd, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_BWD_GROUP", "2")
+        T, T_pad = 24, 32
+        q, k, v = make_qkv(jax.random.PRNGKey(45), 1, T, 2, 128)
+        pad = [(0, 0), (0, T_pad - T), (0, 0), (0, 0)]
+
+        def loss(q, k, v):
+            out = flash_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, block_q=8, block_k=8, interpret=True,
+                seq_len=T)
+            return (out[:, :T] ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-4, atol=2e-4)
