@@ -1,6 +1,6 @@
 """Roofline attribution from a captured step trace: per-op time, FLOP/s
 vs 197 TF/s peak, bytes vs 819 GB/s peak, grouped by (name-stem, source).
-Usage: python scratch_roofline.py [trace_glob]"""
+Usage: python tools/roofline.py [trace_glob]"""
 import os as _os, sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import glob
