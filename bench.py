@@ -467,39 +467,17 @@ def tcp_worker():
     device-side spans)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
-    import optax
 
     import horovod_tpu as hvd
     import horovod_tpu.jax as hvd_jax
-    from horovod_tpu.models import ConvNet
 
     hvd.init()
     n = hvd.process_count()
-    batch = int(os.environ.get("BENCH_TCP_BATCH", "8"))
-    iters = int(os.environ.get("BENCH_TCP_ITERS", "12"))
-    model = ConvNet(num_classes=10)
-    rng = jax.random.PRNGKey(hvd.rank())
-    images = jax.random.normal(rng, (batch, 32, 32, 3), jnp.float32)
-    labels = jnp.zeros((batch,), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), images[:1])["params"]
+    batch, iters, params, tx, grads_fn, apply_fn = _conv_leg_setup(
+        seed=hvd.rank())
     params = hvd_jax.broadcast_parameters(params)
-    tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
-
-    @jax.jit
-    def grads_fn(params):
-        def loss(p):
-            logits = model.apply({"params": p}, images)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels).mean()
-        return jax.value_and_grad(loss)(params)
-
-    @jax.jit
-    def apply_fn(params, opt_state, grads):
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
 
     # warmup/compile
     for _ in range(2):
@@ -535,6 +513,68 @@ def tcp_worker():
     hvd.shutdown()
 
 
+def _conv_leg_setup(seed=0):
+    """Shared workload of the 2-process leg and its contention probes:
+    identical model/data/optimizer so the probes measure scheduling, not
+    a different program."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import ConvNet
+
+    batch = int(os.environ.get("BENCH_TCP_BATCH", "8"))
+    iters = int(os.environ.get("BENCH_TCP_ITERS", "12"))
+    model = ConvNet(num_classes=10)
+    images = jax.random.normal(jax.random.PRNGKey(seed),
+                               (batch, 32, 32, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), images[:1])["params"]
+    tx = optax.sgd(0.01, momentum=0.9)
+
+    @jax.jit
+    def grads_fn(params):
+        def loss(p):
+            logits = model.apply({"params": p}, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        return jax.value_and_grad(loss)(params)
+
+    @jax.jit
+    def apply_fn(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    return batch, iters, params, tx, grads_fn, apply_fn
+
+
+def solo_worker():
+    """The tcp_worker loop minus framework and communication — the same
+    split grads/apply dispatch and per-iter grads sync, so one copy is
+    the comm-free baseline and two concurrent copies measure the host's
+    pure compute-contention ceiling for the 2-process leg."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    batch, iters, params, tx, grads_fn, apply_fn = _conv_leg_setup()
+    opt_state = tx.init(params)
+    for _ in range(2):
+        loss, grads = grads_fn(params)
+        jax.block_until_ready(grads)
+        params, opt_state = apply_fn(params, opt_state, grads)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, grads = grads_fn(params)
+        jax.block_until_ready(grads)
+        params, opt_state = apply_fn(params, opt_state, grads)
+    np.asarray(loss)
+    dt = time.perf_counter() - t0
+    print("SOLOLEG " + json.dumps(
+        {"images_per_sec": round(batch * iters / dt, 2)}), flush=True)
+
+
 def bench_scaling_tcp():
     """Disjoint-runtime scaling leg on localhost: the same worker loop at
     1 process (no communication) and at 2 processes under the
@@ -563,9 +603,52 @@ def bench_scaling_tcp():
             f"tcp leg ({nproc}p) produced no TCPLEG line:\n"
             f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
 
+    def run_solo(nproc):
+        """N INDEPENDENT comm-free workers at once (the tcp loop minus
+        the framework); at N=1 the comm-free baseline, at N=2 the pure
+        core-contention measurement.  None on any child failure — a
+        half-failed pair would report a contention-free 'ceiling'."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--solo-worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+            for _ in range(nproc)]
+        rates = []
+        try:
+            for p in procs:
+                try:
+                    out, _ = p.communicate(timeout=600)
+                except subprocess.TimeoutExpired:
+                    return None
+                if p.returncode != 0:
+                    return None
+                for line in out.splitlines():
+                    if line.startswith("SOLOLEG "):
+                        rates.append(json.loads(
+                            line[len("SOLOLEG "):])["images_per_sec"])
+            if len(rates) != nproc:
+                return None
+            return sum(rates) / len(rates)
+        finally:
+            # Any early exit must not leave a sibling worker burning the
+            # cores under the NEXT bench leg.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
     one = run_leg(1)
     two = run_leg(2)
+    single_solo = run_solo(1)
+    dual_solo = run_solo(2) if single_solo else None
     transport = two.get("ring_transport", "tcp")
+    eff = round(two["images_per_sec_per_proc"]
+                / one["images_per_sec_per_proc"], 4)
+    ceiling = (round(dual_solo / single_solo, 4)
+               if dual_solo and single_solo else None)
     return {
         "n_proc": 2,
         "transport": ("native ring over Unix domain sockets (co-located "
@@ -574,9 +657,15 @@ def bench_scaling_tcp():
         "ring_transport": transport,
         "images_per_sec_per_proc_1": one["images_per_sec_per_proc"],
         "images_per_sec_per_proc_2": two["images_per_sec_per_proc"],
-        "scaling_efficiency": round(
-            two["images_per_sec_per_proc"]
-            / one["images_per_sec_per_proc"], 4),
+        "scaling_efficiency": eff,
+        # Two processes share one host's cores: two INDEPENDENT
+        # comm-free copies measure the efficiency ceiling contention
+        # alone imposes; efficiency_vs_ceiling is the data plane's own
+        # share of it (a multi-host pod has no such ceiling — peers
+        # don't steal each other's compute).
+        "contention_ceiling": ceiling,
+        "efficiency_vs_ceiling": (round(eff / ceiling, 4)
+                                  if ceiling else None),
         "comm_fraction": two["comm_fraction"],
         "comm_fraction_note": "wall time inside the eager allreduce over "
                               "wall time of the step, measured on rank 0 "
@@ -746,10 +835,15 @@ def main():
                     help="skip the transformer MFU leg")
     ap.add_argument("--tcp-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--solo-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.tcp_worker:
         tcp_worker()
+        return
+    if args.solo_worker:
+        solo_worker()
         return
     if args.n_virtual:
         print(json.dumps(bench_scaling(args.n_virtual)))
