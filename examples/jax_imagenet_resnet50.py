@@ -87,12 +87,29 @@ def main():
 
     # Resume: agree on the epoch, restore on rank 0, broadcast everywhere
     # (reference keras_imagenet_resnet50.py:64-103 — there via
-    # hvd.load_model + broadcast; here the state pytree broadcast does both).
-    ckpt_state = {"params": state.params, "batch_stats": state.aux_state}
+    # hvd.load_model + broadcast; here the state pytree broadcast does
+    # both).  The optimizer state resumes too, so SGD momentum survives
+    # a restart exactly as the reference's loaded optimizer does;
+    # params-and-optimizer-only jobs can use checkpoint.save_model /
+    # load_model(directory) instead, which also rebuilds the optimizer
+    # from its persisted spec.
+    ckpt_state = {"params": state.params, "batch_stats": state.aux_state,
+                  "opt_state": state.opt_state}
+    # optional_keys: checkpoints written before opt_state was added
+    # still resume (momentum restarts fresh in that case).
     restored, resume_epoch = hvd_checkpoint.restore_and_broadcast(
-        args.checkpoint_dir, ckpt_state)
+        args.checkpoint_dir, ckpt_state, optional_keys=("opt_state",))
     state.params = restored["params"]
     state.aux_state = restored["batch_stats"]
+    state.opt_state = restored["opt_state"]
+    # The restored hyperparams carry the checkpoint's DECAYED lr; the
+    # schedule callbacks below capture initial_lr at on_train_begin and
+    # re-apply their multipliers per epoch, so the live hyperparams must
+    # be reset to the configured base values — otherwise a resume past a
+    # decay boundary double-applies the decay.  (Momentum buffers — the
+    # actual optimizer STATE — stay restored.)
+    hvd_callbacks.find_hyperparams(state.opt_state).update(
+        hvd_callbacks.find_hyperparams(opt_state))
 
     cbs = hvd_callbacks.CallbackList(
         [
@@ -134,7 +151,8 @@ def main():
         # Rank-0-only checkpoint (reference convention, README step 6).
         hvd_checkpoint.save(
             args.checkpoint_dir,
-            {"params": state.params, "batch_stats": state.aux_state},
+            {"params": state.params, "batch_stats": state.aux_state,
+             "opt_state": state.opt_state},
             epoch=epoch)
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={logs['loss']:.4f} "

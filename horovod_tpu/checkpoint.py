@@ -359,7 +359,9 @@ def load_model(directory: str, optimizer=None, params_like: Any = None, *,
 
 def restore_and_broadcast(directory: str, like: Any,
                           root_rank: int = 0,
-                          epoch: Optional[int] = None) -> Tuple[Any, int]:
+                          epoch: Optional[int] = None,
+                          optional_keys: Tuple[str, ...] = ()
+                          ) -> Tuple[Any, int]:
     """Resume protocol (conventions 2+3): the resume epoch is agreed by
     broadcasting rank 0's scan; rank 0 restores; state is broadcast so all
     ranks start identical (reference ``keras_imagenet_resnet50.py:64-103``,
@@ -371,6 +373,14 @@ def restore_and_broadcast(directory: str, like: Any,
     checkpoint instead of re-scanning — callers that derived other state
     from an epoch must restore the SAME one even if a new checkpoint
     lands concurrently.
+
+    ``optional_keys`` (``like`` must be a dict): top-level template keys
+    tolerated as absent on disk — rank 0 checks the checkpoint's
+    metadata and the presence set is agreed across ranks BEFORE the
+    value broadcast, so a checkpoint written by an older script version
+    (e.g. without ``opt_state``) resumes cleanly — the corresponding
+    ``like`` values pass through untouched — instead of rank 0 raising
+    a tree-structure error while the other ranks hang in the broadcast.
     """
     import numpy as np
     from horovod_tpu.jax import broadcast_parameters
@@ -381,9 +391,37 @@ def restore_and_broadcast(directory: str, like: Any,
         epoch = int(np.asarray(eager.broadcast(
             np.asarray(epoch, np.int64), root_rank,
             name="ckpt.resume_epoch")))
+    if optional_keys and not isinstance(like, dict):
+        # Fail on the FIRST call, not on the first resume after a
+        # checkpoint exists.
+        raise TypeError(
+            "optional_keys needs a dict template (top-level keys)")
+    if optional_keys and epoch >= 0:
+        present = 0
+        if basics.rank() == root_rank:
+            tree = _checkpointer().metadata(
+                checkpoint_path(directory, epoch)).item_metadata.tree
+            present = sum(1 << i for i, k in enumerate(optional_keys)
+                          if k in tree)
+        present = int(np.asarray(eager.broadcast(
+            np.asarray(present, np.int64), root_rank,
+            name="ckpt.optional_keys")))
+        missing = {k for i, k in enumerate(optional_keys)
+                   if not (present >> i) & 1}
+        # Restore without the absent keys; their template values are
+        # merged back before the broadcast below, so every rank ends
+        # with root's copy of the defaults too (a fresh opt_state built
+        # pre-broadcast may differ per rank).
+        defaults = {k: like[k] for k in optional_keys
+                    if k in missing and k in like}
+        like = {k: v for k, v in like.items() if k not in missing}
+    else:
+        defaults = {}
     state = like
     if epoch >= 0 and basics.rank() == root_rank:
         state = restore(directory, epoch, like)
+    if defaults:
+        state = {**state, **defaults}
     state = broadcast_parameters(state, root_rank,
                                  name_prefix="ckpt.broadcast")
     return state, epoch

@@ -28,6 +28,11 @@ _EXAMPLES = [
     ("examples/jax_mnist_advanced.py",
      ["--epochs", "1", "--batch-size", "64", "--warmup-epochs", "1",
       "--checkpoint-dir", "{tmp}"]),
+    # The sparse allgather path through the stock DistributedOptimizer
+    # (round-5 rework) — single-chip collectives degenerate but the
+    # IndexedSlices routing and scatter-to-dense update still execute.
+    ("examples/jax_word2vec.py",
+     ["--steps", "30", "--vocab", "500", "--batch-size", "16"]),
 ]
 
 

@@ -458,3 +458,29 @@ class TestOptimizerReconstruction:
             checkpoint.save_model(str(tmp_path), params,
                                   optax.sgd(0.1).init(params), epoch=0,
                                   optimizer=spec)
+
+    def test_restore_optional_keys_tolerates_old_checkpoints(
+            self, hvd, tmp_path):
+        """A checkpoint written WITHOUT opt_state must still resume when
+        the new template includes it as an optional key (the template
+        value passes through, broadcast from root); a checkpoint WITH it
+        restores it normally."""
+        old = {"params": {"w": jnp.full((3,), 5.0)}}
+        checkpoint.save(str(tmp_path), old, epoch=1)
+        like = {"params": {"w": jnp.zeros(3)},
+                "opt_state": {"mu": jnp.full((3,), 7.0)}}
+        restored, epoch = checkpoint.restore_and_broadcast(
+            str(tmp_path), like, optional_keys=("opt_state",))
+        assert epoch == 1
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 5.0)
+        np.testing.assert_allclose(
+            np.asarray(restored["opt_state"]["mu"]), 7.0)  # template value
+
+        new = {"params": {"w": jnp.full((3,), 6.0)},
+               "opt_state": {"mu": jnp.full((3,), 2.0)}}
+        checkpoint.save(str(tmp_path), new, epoch=2)
+        restored, epoch = checkpoint.restore_and_broadcast(
+            str(tmp_path), like, optional_keys=("opt_state",))
+        assert epoch == 2
+        np.testing.assert_allclose(
+            np.asarray(restored["opt_state"]["mu"]), 2.0)  # restored
