@@ -279,6 +279,15 @@ HTPU_API const char* htpu_control_ring_transport(void* cp) {
   return static_cast<htpu::ControlPlane*>(cp)->ring_transport();
 }
 
+// Attach a native Timeline (htpu_timeline_create) so the coordinator's
+// Tick loop emits negotiation spans; pass nullptr to detach.  The caller
+// must keep the timeline alive while attached (and detach before
+// htpu_timeline_destroy).
+HTPU_API void htpu_control_set_timeline(void* cp, void* timeline) {
+  static_cast<htpu::ControlPlane*>(cp)->set_timeline(
+      static_cast<htpu::Timeline*>(timeline));
+}
+
 // Coordinator-side stall scan; same length-prefixed record format as
 // htpu_table_stalled.
 HTPU_API int htpu_control_stalled(void* cp, double age_s, void** out) {

@@ -11,6 +11,7 @@
 
 #include "htpu/fusion.h"
 #include "htpu/reduce.h"
+#include "htpu/timeline.h"
 #include "htpu/transport.h"
 
 namespace htpu {
@@ -295,10 +296,28 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       err.response_type = ResponseType::ERROR;
       err.tensor_names = {r.tensor_name};
       err.error_message = "Request rank out of range.";
+      // Close any open negotiation span — a stuck entry would swallow
+      // the tensor's NEGOTIATE starts for the rest of the job.
+      if (timeline_ && negotiating_.erase(r.tensor_name)) {
+        timeline_->NegotiateEnd(r.tensor_name);
+      }
       out.responses.push_back(std::move(err));
       continue;
     }
+    if (timeline_) {
+      // Negotiation spans for the reference's timeline model
+      // (NEGOTIATE_* bracket + per-rank ready instants): the Python
+      // MessageTable hooks never run in multi-process mode.
+      if (negotiating_.insert(r.tensor_name).second) {
+        timeline_->NegotiateStart(r.tensor_name, r.request_type);
+      }
+      timeline_->NegotiateRankReady(r.tensor_name, r.request_rank);
+    }
     if (ready) {
+      if (timeline_) {
+        timeline_->NegotiateEnd(r.tensor_name);
+        negotiating_.erase(r.tensor_name);
+      }
       out.responses.push_back(table_->ConstructResponse(r.tensor_name));
     }
   }

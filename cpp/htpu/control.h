@@ -26,12 +26,15 @@
 #include <memory>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "htpu/message_table.h"
 #include "htpu/wire.h"
 
 namespace htpu {
+
+class Timeline;
 
 class ControlPlane {
  public:
@@ -71,6 +74,13 @@ class ControlPlane {
   // Transport the ring-next hop rides: "uds" (co-located peer, on-host
   // fast path), "tcp", or "none" (single process).
   const char* ring_transport() const { return ring_transport_; }
+
+  // Coordinator-side negotiation spans (NEGOTIATE_* with per-rank ready
+  // instants) for the multi-process mode: the Python MessageTable hooks
+  // never run there — the table lives in this class — so the timeline
+  // must be driven from the Tick loop.  Not owned; the caller keeps the
+  // Timeline alive for the plane's lifetime.  Coordinator only.
+  void set_timeline(Timeline* timeline) { timeline_ = timeline; }
 
   // Cumulative eager-data-plane traffic of THIS process (payload bytes put
   // on / taken off the wire).  Lets tests assert the ring's O(payload)
@@ -117,6 +127,8 @@ class ControlPlane {
   long long data_bytes_recv_ = 0;
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
+  Timeline* timeline_ = nullptr;          // coordinator only; not owned
+  std::unordered_set<std::string> negotiating_;   // timeline span state
 };
 
 }  // namespace htpu

@@ -602,6 +602,13 @@ class Controller:
             else:
                 from horovod_tpu.timeline import Timeline
                 self.timeline = Timeline(timeline_path)
+        if (self._control is not None and self.timeline is not None
+                and hasattr(self.timeline, "attach_to_control")):
+            # Multi-process mode negotiates inside the C++ coordinator;
+            # wire the native timeline in so NEGOTIATE_* spans (with
+            # per-rank ready instants) appear exactly as in the
+            # single-process mode (reference timeline model, §5.1).
+            self.timeline.attach_to_control(self._control)
 
         self.handle_manager = HandleManager()
         if self._use_cpp:
@@ -671,14 +678,22 @@ class Controller:
             self._message_queue.clear()
         for e in entries:
             e.callback(SHUT_DOWN_ERROR, None)
-        if self._control is not None and thread_exited:
-            # If the background thread is wedged inside a control-plane call
-            # (e.g. a dead peer), destroying the native object under it
-            # would be a use-after-free — leak it instead; the process is
-            # tearing down anyway.
-            self._control.close()
-        if self.timeline:
-            self.timeline.close()
+        if self._control is not None and not thread_exited:
+            # The background thread is wedged inside a control-plane call
+            # (e.g. a dead peer): destroying the native objects under it
+            # would be a use-after-free — leak them instead (the wrappers'
+            # __del__ would otherwise still destroy at GC); the process
+            # is tearing down anyway.  The control plane holds a raw
+            # pointer to the native timeline, so both leak together.
+            if hasattr(self._control, "leak"):
+                self._control.leak()
+            if self.timeline and hasattr(self.timeline, "leak"):
+                self.timeline.leak()
+        else:
+            if self._control is not None:
+                self._control.close()
+            if self.timeline:
+                self.timeline.close()
 
     def enqueue(self, entry: TensorTableEntry) -> Status:
         """Framework-thread side: register tensor data and queue one request

@@ -100,6 +100,9 @@ def _configure(lib) -> None:
         ctypes.POINTER(ctypes.c_longlong)]
     lib.htpu_control_ring_transport.restype = ctypes.c_char_p
     lib.htpu_control_ring_transport.argtypes = [ctypes.c_void_p]
+    lib.htpu_control_set_timeline.restype = None
+    lib.htpu_control_set_timeline.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p]
 
 
 def load():
@@ -346,9 +349,20 @@ class CppControlPlane:
         return _parse_stall_records(_take_buffer(self._lib, out, n))
 
     def close(self):
+        if getattr(self, "_leaked", False):
+            return   # pointer stays valid for the wedged thread; no free
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.htpu_control_destroy(ptr)
+
+    def leak(self):
+        """Disarm destruction WITHOUT invalidating the pointer — for
+        shutdown with a wedged background thread still inside (or about
+        to make) a control-plane call: destroying would be a
+        use-after-free, and nulling the pointer would turn the thread's
+        next ctypes call into a NULL dereference in C++.  The object is
+        reclaimed by process exit."""
+        self._leaked = True
 
     def __del__(self):
         try:
@@ -374,6 +388,14 @@ class CppTimeline:
         self._ptr = self._lib.htpu_timeline_create(path.encode("utf-8"))
         if not self._ptr:
             raise OSError(f"cannot open timeline file: {path}")
+
+    def attach_to_control(self, control: "CppControlPlane") -> None:
+        """Wire this writer into the native coordinator so its Tick loop
+        emits NEGOTIATE_* spans (multi-process mode negotiates in C++,
+        bypassing the Python MessageTable's timeline hooks).  Lifetime:
+        the Controller closes the control plane before this timeline."""
+        if self._ptr and control._ptr:
+            self._lib.htpu_control_set_timeline(control._ptr, self._ptr)
 
     def negotiate_start(self, tensor_name: str, request_type) -> None:
         if not self._ptr:
@@ -417,6 +439,14 @@ class CppTimeline:
         for e in entries:
             self._lib.htpu_timeline_activity_end(
                 self._ptr, e.name.encode("utf-8"))
+
+    def leak(self):
+        """Abandon the native writer WITHOUT closing or destroying it —
+        for shutdown with a wedged background thread whose control plane
+        still holds the raw Timeline pointer (see Controller.stop); the
+        trace file stays unfinalized, which is the lesser evil next to a
+        teardown use-after-free."""
+        self._ptr = None
 
     def close(self):
         # Close only finalizes the file; the C++ object stays alive (its
