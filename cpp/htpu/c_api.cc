@@ -284,6 +284,7 @@ HTPU_API const char* htpu_control_ring_transport(void* cp) {
 // must keep the timeline alive while attached (and detach before
 // htpu_timeline_destroy).
 HTPU_API void htpu_control_set_timeline(void* cp, void* timeline) {
+  if (!cp) return;   // teardown race: plane may be closed under the caller
   static_cast<htpu::ControlPlane*>(cp)->set_timeline(
       static_cast<htpu::Timeline*>(timeline));
 }

@@ -285,6 +285,11 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
 
   ResponseList out;
   out.shutdown = shutdown;
+  // One acquire-load per tick: a concurrent detach (teardown without
+  // shutdown, cpp_core.CppTimeline.__del__) must not tear the pointer
+  // mid-loop.  A stale non-null value is safe — the writer is closed,
+  // not destroyed, and closed writers no-op under their own mutex.
+  Timeline* timeline = timeline_.load(std::memory_order_acquire);
   std::unordered_map<std::string, Request> first_request;
   for (const Request& r : all_requests) {
     first_request.emplace(r.tensor_name, r);
@@ -297,26 +302,29 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       err.tensor_names = {r.tensor_name};
       err.error_message = "Request rank out of range.";
       // Close any open negotiation span — a stuck entry would swallow
-      // the tensor's NEGOTIATE starts for the rest of the job.
-      if (timeline_ && negotiating_.erase(r.tensor_name)) {
-        timeline_->NegotiateEnd(r.tensor_name);
+      // the tensor's NEGOTIATE starts for the rest of the job.  The
+      // erase runs regardless of the timeline so span state cannot go
+      // stale across a detach/re-attach cycle.
+      if (negotiating_.erase(r.tensor_name) && timeline) {
+        timeline->NegotiateEnd(r.tensor_name);
       }
       out.responses.push_back(std::move(err));
       continue;
     }
-    if (timeline_) {
+    if (timeline) {
       // Negotiation spans for the reference's timeline model
       // (NEGOTIATE_* bracket + per-rank ready instants): the Python
       // MessageTable hooks never run in multi-process mode.
       if (negotiating_.insert(r.tensor_name).second) {
-        timeline_->NegotiateStart(r.tensor_name, r.request_type);
+        timeline->NegotiateStart(r.tensor_name, r.request_type);
       }
-      timeline_->NegotiateRankReady(r.tensor_name, r.request_rank);
+      timeline->NegotiateRankReady(r.tensor_name, r.request_rank);
     }
     if (ready) {
-      if (timeline_) {
-        timeline_->NegotiateEnd(r.tensor_name);
-        negotiating_.erase(r.tensor_name);
+      // Erase outside the timeline guard (same detach/re-attach
+      // staleness concern as the error path above).
+      if (negotiating_.erase(r.tensor_name) && timeline) {
+        timeline->NegotiateEnd(r.tensor_name);
       }
       out.responses.push_back(table_->ConstructResponse(r.tensor_name));
     }

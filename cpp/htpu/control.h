@@ -23,6 +23,7 @@
 #ifndef HTPU_CONTROL_H_
 #define HTPU_CONTROL_H_
 
+#include <atomic>
 #include <memory>
 #include <string>
 #include <unordered_map>
@@ -79,8 +80,13 @@ class ControlPlane {
   // instants) for the multi-process mode: the Python MessageTable hooks
   // never run there — the table lives in this class — so the timeline
   // must be driven from the Tick loop.  Not owned; the caller keeps the
-  // Timeline alive for the plane's lifetime.  Coordinator only.
-  void set_timeline(Timeline* timeline) { timeline_ = timeline; }
+  // Timeline alive for the plane's lifetime — or DETACHES (nullptr)
+  // before letting it die.  Atomic because the detach may race a Tick
+  // in flight on the background thread (interpreter teardown without
+  // shutdown); Tick loads the pointer once per use.  Coordinator only.
+  void set_timeline(Timeline* timeline) {
+    timeline_.store(timeline, std::memory_order_release);
+  }
 
   // Cumulative eager-data-plane traffic of THIS process (payload bytes put
   // on / taken off the wire).  Lets tests assert the ring's O(payload)
@@ -127,7 +133,7 @@ class ControlPlane {
   long long data_bytes_recv_ = 0;
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
-  Timeline* timeline_ = nullptr;          // coordinator only; not owned
+  std::atomic<Timeline*> timeline_{nullptr};  // coordinator only; not owned
   std::unordered_set<std::string> negotiating_;   // timeline span state
 };
 

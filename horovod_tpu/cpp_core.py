@@ -393,9 +393,14 @@ class CppTimeline:
         """Wire this writer into the native coordinator so its Tick loop
         emits NEGOTIATE_* spans (multi-process mode negotiates in C++,
         bypassing the Python MessageTable's timeline hooks).  Lifetime:
-        the Controller closes the control plane before this timeline."""
+        the Controller closes the control plane before this timeline; for
+        teardown paths that skip the Controller (no hvd.shutdown), the
+        weakref lets ``__del__`` detach instead of destroying under the
+        coordinator's raw pointer."""
         if self._ptr and control._ptr:
+            import weakref
             self._lib.htpu_control_set_timeline(control._ptr, self._ptr)
+            self._control_ref = weakref.ref(control)
 
     def negotiate_start(self, tensor_name: str, request_type) -> None:
         if not self._ptr:
@@ -441,12 +446,34 @@ class CppTimeline:
                 self._ptr, e.name.encode("utf-8"))
 
     def leak(self):
-        """Abandon the native writer WITHOUT closing or destroying it —
-        for shutdown with a wedged background thread whose control plane
-        still holds the raw Timeline pointer (see Controller.stop); the
-        trace file stays unfinalized, which is the lesser evil next to a
-        teardown use-after-free."""
-        self._ptr = None
+        """Abandon the native writer WITHOUT destroying it — for shutdown
+        with a wedged background thread whose control plane still holds
+        the raw Timeline pointer (see Controller.stop).  The file is
+        finalized best-effort: ``htpu_timeline_close`` only closes the
+        stream under the object's own mutex and every later write no-ops,
+        so the wedged thread can still call through its stale pointer
+        safely — only ``htpu_timeline_destroy`` is the use-after-free
+        hazard, and that never runs for a leaked writer (``__del__`` sees
+        a null ``_ptr``).  The close runs on a bounded-wait daemon
+        thread: in the usual wedge (thread stuck in a control-plane recv)
+        the timeline mutex is free and it finishes instantly, but a
+        writer wedged INSIDE ``Emit`` (full disk, hung NFS) holds that
+        mutex, and leak() must never convert a 90 s join timeout into an
+        unbounded hang of shutdown itself."""
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            import threading
+
+            def _close():
+                try:
+                    self._lib.htpu_timeline_close(ptr)
+                except Exception:   # noqa: BLE001 — best-effort finalize
+                    pass
+
+            t = threading.Thread(target=_close, daemon=True,
+                                 name="htpu-timeline-leak-close")
+            t.start()
+            t.join(timeout=2.0)
 
     def close(self):
         # Close only finalizes the file; the C++ object stays alive (its
@@ -459,8 +486,26 @@ class CppTimeline:
     def __del__(self):
         try:
             ptr, self._ptr = self._ptr, None
-            if ptr:
-                self._lib.htpu_timeline_close(ptr)
-                self._lib.htpu_timeline_destroy(ptr)
+            if not ptr:
+                return
+            self._lib.htpu_timeline_close(ptr)
+            ctrl = (self._control_ref()
+                    if hasattr(self, "_control_ref") else None)
+            # Snapshot the control handle ONCE: a concurrent close() nulls
+            # ctrl._ptr, and re-reading between the check and the call
+            # would pass NULL into C++ (the C shim also guards, but the
+            # snapshot closes the Python-side window).
+            ctrl_ptr = getattr(ctrl, "_ptr", None) if ctrl is not None else None
+            if ctrl_ptr:
+                # Interpreter teardown without hvd.shutdown(): the native
+                # coordinator still holds this raw pointer and its tick
+                # caller (a daemon thread) may be mid-call.  Detach so new
+                # ticks see no timeline, and LEAK the object instead of
+                # destroying under a possibly-in-flight span — a stale
+                # pointer into the closed-but-alive writer is a locked
+                # no-op, a destroyed one is a use-after-free.
+                self._lib.htpu_control_set_timeline(ctrl_ptr, None)
+                return
+            self._lib.htpu_timeline_destroy(ptr)
         except Exception:   # noqa: BLE001 — interpreter teardown
             pass
