@@ -271,6 +271,11 @@ class CppControlPlane:
         self._lib = load()
         if self._lib is None:
             raise RuntimeError("native core not available")
+        # Serializes destruction against an attached timeline's __del__
+        # detach (CppTimeline.__del__): without it the detach could call
+        # into a plane freed between its pointer snapshot and the ctypes
+        # call.
+        self._teardown_lock = threading.Lock()
         self._ptr = self._lib.htpu_control_create(
             process_index, process_count, host.encode("utf-8"), port,
             first_rank, nranks_total, timeout_ms)
@@ -351,9 +356,10 @@ class CppControlPlane:
     def close(self):
         if getattr(self, "_leaked", False):
             return   # pointer stays valid for the wedged thread; no free
-        ptr, self._ptr = self._ptr, None
-        if ptr:
-            self._lib.htpu_control_destroy(ptr)
+        with self._teardown_lock:
+            ptr, self._ptr = self._ptr, None
+            if ptr:
+                self._lib.htpu_control_destroy(ptr)
 
     def leak(self):
         """Disarm destruction WITHOUT invalidating the pointer — for
@@ -491,21 +497,33 @@ class CppTimeline:
             self._lib.htpu_timeline_close(ptr)
             ctrl = (self._control_ref()
                     if hasattr(self, "_control_ref") else None)
-            # Snapshot the control handle ONCE: a concurrent close() nulls
-            # ctrl._ptr, and re-reading between the check and the call
-            # would pass NULL into C++ (the C shim also guards, but the
-            # snapshot closes the Python-side window).
-            ctrl_ptr = getattr(ctrl, "_ptr", None) if ctrl is not None else None
-            if ctrl_ptr:
+            if ctrl is not None:
                 # Interpreter teardown without hvd.shutdown(): the native
-                # coordinator still holds this raw pointer and its tick
-                # caller (a daemon thread) may be mid-call.  Detach so new
-                # ticks see no timeline, and LEAK the object instead of
-                # destroying under a possibly-in-flight span — a stale
-                # pointer into the closed-but-alive writer is a locked
-                # no-op, a destroyed one is a use-after-free.
-                self._lib.htpu_control_set_timeline(ctrl_ptr, None)
-                return
+                # coordinator may still hold this raw pointer while its
+                # tick caller (a daemon thread) is mid-call.  Under the
+                # plane's teardown lock — so a concurrent close() cannot
+                # destroy the plane between the pointer read and the
+                # call — detach so new ticks see no timeline, and LEAK
+                # the object instead of destroying under a
+                # possibly-in-flight span: a stale pointer into the
+                # closed-but-alive writer is a locked no-op, a destroyed
+                # one is a use-after-free.  Bounded acquire: this
+                # finalizer can run via cyclic GC ON the thread currently
+                # holding the lock inside close() — a blocking acquire
+                # there would deadlock the interpreter; on timeout, leak
+                # the writer without detaching (still safe: close() only
+                # destroys the PLANE, and this writer is never destroyed).
+                if not ctrl._teardown_lock.acquire(timeout=2.0):
+                    return
+                try:
+                    ctrl_ptr = getattr(ctrl, "_ptr", None)
+                    if ctrl_ptr:
+                        self._lib.htpu_control_set_timeline(ctrl_ptr, None)
+                        return
+                finally:
+                    ctrl._teardown_lock.release()
+                # Plane already closed: nothing references the writer any
+                # more — destroying it below is safe.
             self._lib.htpu_timeline_destroy(ptr)
         except Exception:   # noqa: BLE001 — interpreter teardown
             pass

@@ -55,7 +55,15 @@ def _flash_vmem_mb() -> int:
     Default 32 (measured sufficient for g2 at 1024² blocks, D=128);
     0 restores Mosaic's compiler default; a malformed value warns and
     falls back rather than raising mid-backward."""
-    raw = os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB", "32")
+    raw = os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB")
+    default = 32 if _vmem_headroom_ok() else 0
+    if raw is None:
+        # The raised default only applies where the hardware can back it
+        # (v2/v3 have 16 MB of physical VMEM per core): an explicit
+        # HOROVOD_TPU_FLASH_BWD_GROUP opt-in at small blocks compiled
+        # fine under Mosaic's default budget there, and must keep doing
+        # so without the user also discovering the VMEM knob.
+        return default
     try:
         val = int(raw)
         if val < 0:
@@ -65,8 +73,9 @@ def _flash_vmem_mb() -> int:
         import warnings
         warnings.warn(
             f"HOROVOD_TPU_FLASH_VMEM_MB={raw!r} is not a non-negative "
-            "integer; using the default 32", RuntimeWarning, stacklevel=2)
-        return 32
+            f"integer; using the default {default}",
+            RuntimeWarning, stacklevel=2)
+        return default
 
 
 # TPU generations with only 16 MB of physical VMEM per core — the raised

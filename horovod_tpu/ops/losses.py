@@ -4,23 +4,27 @@ The straightforward ``logits = hidden @ W; optax.softmax_cross_entropy``
 materializes an ``(N, vocab)`` f32 logits tensor *and* keeps it (plus
 softmax intermediates) alive as autodiff residuals — at the benchmark
 shape (N = 16384, vocab = 32768) that is ~2 GB of f32 logits and enough
-peak-HBM pressure that XLA auto-rematerializes one convolution per layer
-(measured ~40 ms/step of recompute on v5e, docs/benchmarks.md).
+peak-HBM pressure that XLA auto-rematerializes convolution fusions
+(measured ~26 ms/step of recompute on v5e, docs/benchmarks.md).
 
-This op computes the same loss with the classic streamed-head schedule
-(public pattern in every large-LM codebase):
+This op computes the same loss with the streamed-head schedule (public
+pattern in every large-LM codebase):
 
-* forward: scan over row chunks; each chunk computes its logits tile,
-  reduces it to ``lse`` and the label logit, and DISCARDS the tile —
-  residuals are just ``(hidden, W, labels, lse)``;
-* backward: rescan the chunks, recompute the logits tile, form
+* forward: split the rows into chunks (python-unrolled, 2-way by
+  default); each chunk computes its logits tile, reduces it to ``lse``
+  and the label logit, and DISCARDS the tile — residuals are just
+  ``(hidden, W, labels, lse)``;
+* backward: revisit the chunks, recompute each logits tile, form
   ``softmax - onehot`` in place and contract it immediately into
   ``d hidden`` and ``dW``.
 
 Cost: one extra head matmul (the backward recompute) in exchange for
-never holding O(N x vocab) residuals.  All matmuls run in the input
-dtype (bf16 on TPU) with f32 accumulation, so precision matches the
-f32-logits reference within bf16 rounding.
+never holding O(N x vocab) residuals; ``HOROVOD_TPU_XENT_MODE`` selects
+alternative schedules (see :func:`_xent_mode`), including a
+save-the-logits form that trades the recompute back for a compact bf16
+residual.  All matmuls run in the input dtype (bf16 on TPU) with f32
+accumulation, so precision matches the f32-logits reference within bf16
+rounding.
 
 No reference analogue (the reference's models predate large-vocab LM
 heads); cited by SURVEY §5.7's long-context mandate.
@@ -29,10 +33,68 @@ heads); cited by SURVEY §5.7's long-context mandate.
 from __future__ import annotations
 
 import functools
+import os
+import re
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+_DEFAULT_MODE = "unroll2"
+
+
+def _xent_mode() -> str:
+    """CE schedule variant from ``HOROVOD_TPU_XENT_MODE`` (trace time):
+
+    * ``unroll2`` (default) — python-unrolled 2-way row chunking of the
+      streamed-head schedule: the logits transient halves, with none of
+      the ``lax.scan`` while-loop/stacking overhead that made the
+      scanned form slower.  At the bench shape the halved transient
+      (1 GB instead of 2 GB) drops peak HBM below the point where XLA
+      auto-rematerializes one convolution fusion per layer — measured
+      547 → 518 ms/step, MFU 0.704 → 0.744 on v5e
+      (docs/benchmarks.md).  ``unrollK`` generalizes (K clamped to a
+      divisor of N; K=1 == one tile).
+    * ``recompute`` — the single-tile streamed-head schedule (or a
+      ``lax.scan`` when the ``chunk`` argument is below N): no logits
+      residual, one extra head matmul in the backward.
+    * ``save`` / ``saveK`` — keep the logits as a compact bf16 residual
+      (N × vocab × 2 bytes, K-way chunked) and skip the backward
+      recompute matmul; ``save2`` measured ~0.5 ms ≤ ``unroll2`` at the
+      bench shape but holds a 1 GB residual, so it stays opt-in.
+
+    An unrecognized value warns and falls back to the default rather
+    than raising mid-trace.
+    """
+    raw = os.environ.get("HOROVOD_TPU_XENT_MODE", _DEFAULT_MODE)
+    if not re.fullmatch(r"recompute|save\d*|unroll\d+", raw):
+        import warnings
+        warnings.warn(
+            f"HOROVOD_TPU_XENT_MODE={raw!r} is not one of 'recompute', "
+            f"'saveK', 'unrollK'; using the default {_DEFAULT_MODE!r}",
+            RuntimeWarning, stacklevel=3)
+        return _DEFAULT_MODE
+    return raw
+
+
+def _mode_layout(mode: str, n: int, chunk: int):
+    """(save_logits, n_chunks) for a validated mode string; ``n_chunks``
+    is ``None`` for the ``recompute`` schedule (which tiles by the
+    ``chunk`` argument instead) and otherwise clamped to a divisor of
+    ``n``.  An explicitly small ``chunk`` is honored in every mode —
+    the caller's transient bound (chunk × V f32) RAISES the chunk count
+    past the mode's minimum when n/k would exceed it, keeping the
+    documented memory contract while staying python-unrolled."""
+    if mode == "recompute":
+        return False, None
+    save = mode.startswith("save")
+    k = int((mode[len("save"):] if save else mode[len("unroll"):]) or 1)
+    k = max(1, k)
+    while n % k:
+        k -= 1
+    if n // k > chunk:
+        k = n // _pick_chunk(n, chunk)
+    return save, k
 
 
 def _pick_chunk(n: int, target: int) -> int:
@@ -51,23 +113,31 @@ def _pick_chunk(n: int, target: int) -> int:
     return chunk
 
 
-def _chunk_fwd(h_c, w, labels_c):
-    """One chunk's (loss, lse) from its logits tile; the tile dies here."""
+def _chunk_fwd(h_c, w, labels_c, want_logits=False):
+    """One chunk's (loss, lse) from its logits tile; the tile dies here —
+    unless ``want_logits`` asks for it back as a compact bf16 residual
+    (the save schedule)."""
     logits = jax.lax.dot_general(
         h_c, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)              # (C, V) f32
     m = jnp.max(logits, axis=-1, keepdims=True)
     lse = (m[:, 0] + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)))
     correct = jnp.take_along_axis(logits, labels_c[:, None], axis=-1)[:, 0]
+    if want_logits:
+        return lse - correct, lse, logits.astype(jnp.bfloat16)
     return lse - correct, lse
 
 
-def _chunk_bwd(h_c, w, labels_c, lse_c, g_c):
-    """Recompute one chunk's logits tile and contract ``softmax - onehot``
-    straight into (dh_c, dw_c)."""
-    logits = jax.lax.dot_general(
-        h_c, w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (C, V) f32
+def _chunk_bwd(h_c, w, labels_c, lse_c, g_c, logits_c=None):
+    """Contract one chunk's ``softmax - onehot`` straight into
+    (dh_c, dw_c); the logits tile is recomputed unless a saved bf16 tile
+    (``logits_c``) is supplied."""
+    if logits_c is None:
+        logits = jax.lax.dot_general(
+            h_c, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (C, V) f32
+    else:
+        logits = logits_c.astype(jnp.float32)
     p = jnp.exp(logits - lse_c[:, None])
     cols = lax.broadcasted_iota(jnp.int32, p.shape, 1)
     dlogits = ((p - (cols == labels_c[:, None]))
@@ -91,19 +161,21 @@ def fused_softmax_xent(hidden, w, labels, chunk=16384):
         dtype with f32 accumulation).
       w: (d, V) head weight (cast to ``hidden.dtype`` for the matmuls).
       labels: (N,) int32 target ids in [0, V).
-      chunk: target rows per logits tile (clamped to the largest divisor
-        of N, so any N works); peak transient is chunk x V f32.  The
-        default keeps the bench shape (16384 x 32k vocab = 2 GB tile) in
-        ONE tile: the tile is transient (never a residual), and a scanned
-        loop measured slower on v5e than one big tile (the while-loop +
-        dh-stacking overhead outweighed the smaller transient,
-        docs/benchmarks.md) — lower it only when chunk x V f32 itself
-        cannot fit.
+      chunk: target rows per logits tile for the ``lax.scan`` fallback
+        schedule (``HOROVOD_TPU_XENT_MODE=recompute`` with chunk < N);
+        clamped to the largest divisor of N.  The DEFAULT schedule is
+        ``unroll2`` (see :func:`_xent_mode`): python-unrolled 2-way
+        chunking, which halves the logits transient with no loop
+        overhead — at the bench shape that freed enough peak HBM to stop
+        XLA auto-rematerializing a convolution per layer (−29 ms/step on
+        v5e).  A *scanned* loop measured slower than one tile
+        (while-loop + dh stacking, docs/benchmarks.md); the unrolled
+        form is how to shrink the transient.
 
     Returns: (N,) f32 per-token losses (``lse - logit[label]``) — take
     ``.mean()`` for the usual reduction.
     """
-    loss, _ = _xent_fwd_impl(hidden, w, labels, chunk)
+    loss, _ = _xent_fwd(hidden, w, labels, chunk)
     return loss
 
 
@@ -126,16 +198,45 @@ def _xent_fwd_impl(hidden, w, labels, chunk):
 
 
 def _xent_fwd(hidden, w, labels, chunk):
-    loss, lse = _xent_fwd_impl(hidden, w, labels, chunk)
-    return loss, (hidden, w, labels, lse)
+    save, k = _mode_layout(_xent_mode(), hidden.shape[0], chunk)
+    if k is None:
+        loss, lse = _xent_fwd_impl(hidden, w, labels, chunk)
+        return loss, (hidden, w, labels, lse, None)
+    wc = w.astype(hidden.dtype)
+    n = hidden.shape[0]
+    c = n // k
+    parts = [_chunk_fwd(hidden[i * c:(i + 1) * c], wc,
+                        labels[i * c:(i + 1) * c], want_logits=save)
+             for i in range(k)]
+    loss = jnp.concatenate([p[0] for p in parts])
+    lse = jnp.concatenate([p[1] for p in parts])
+    logits_bf16 = (jnp.concatenate([p[2] for p in parts]) if save else None)
+    return loss, (hidden, w, labels, lse, logits_bf16)
 
 
 def _xent_bwd(chunk, res, g):
-    hidden, w, labels, lse = res
+    # Whether logits were saved is read off the residual itself (not the
+    # env), so a mode change between the forward and backward trace
+    # cannot desynchronize the schedule from the saved state.
+    hidden, w, labels, lse, logits_bf16 = res
     n, d = hidden.shape
-    c = _pick_chunk(n, chunk)
     wc = w.astype(hidden.dtype)
     g = g.astype(jnp.float32)
+    _, k = _mode_layout(_xent_mode(), n, chunk)
+    if k is not None or logits_bf16 is not None:
+        k = k or 1
+        c = n // k
+        dhs, dw = [], jnp.zeros_like(w, jnp.float32)
+        for i in range(k):
+            s = slice(i * c, (i + 1) * c)
+            dh_c, dw_c = _chunk_bwd(
+                hidden[s], wc, labels[s], lse[s], g[s],
+                None if logits_bf16 is None else logits_bf16[s])
+            dhs.append(dh_c)
+            dw = dw + dw_c
+        return (jnp.concatenate(dhs).astype(hidden.dtype),
+                dw.astype(w.dtype), None)
+    c = _pick_chunk(n, chunk)
     if c == n:
         dh, dw = _chunk_bwd(hidden, wc, labels, lse, g)
     else:
