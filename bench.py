@@ -455,6 +455,22 @@ def bench_transformer(jax, hvd, mesh, nchips):
     }
 
 
+def _pin_cpu_half(half: int) -> bool:
+    """Pin this process to one half of the allowed CPUs (BENCH_TCP_PIN
+    legs).  Must run BEFORE jax initializes its thread pools.  Returns
+    False (no-op) when affinity is unsupported or <2 CPUs."""
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+    except AttributeError:          # non-Linux
+        return False
+    if len(cpus) < 2:
+        return False
+    mid = len(cpus) // 2
+    os.sched_setaffinity(0, set(cpus[:mid] if half % 2 == 0
+                                else cpus[mid:]))
+    return True
+
+
 def tcp_worker():
     """2-process disjoint-runtime worker (spawned by ``horovod_tpu.run``
     under :func:`bench_scaling_tcp`): a small conv training loop whose
@@ -464,7 +480,17 @@ def tcp_worker():
     directly measured communication fraction (wall time inside
     ``allreduce_gradients`` over wall time of the whole step — the
     profiler cannot provide this on the CPU backend, which exposes no
-    device-side spans)."""
+    device-side spans).
+
+    With ``BENCH_TCP_PIN=1`` each process pins itself to a disjoint CPU
+    half before JAX spins up (the pinned leg: contention replaced by a
+    fixed half-machine budget); the TCPLEG line reports whether the pin
+    actually took, so the parent never mistakes an unpinnable host's
+    numbers for pinned ones."""
+    pinned = False
+    if os.environ.get("BENCH_TCP_PIN") == "1":
+        pinned = _pin_cpu_half(
+            int(os.environ.get("HOROVOD_TPU_PROCESS_INDEX", "0")))
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
@@ -509,6 +535,7 @@ def tcp_worker():
             "images_per_sec_per_proc": round(batch * iters / dt, 2),
             "comm_fraction": round(t_comm / dt, 4),
             "ring_transport": transport,
+            "pinned": pinned,
         }), flush=True)
     hvd.shutdown()
 
@@ -586,10 +613,17 @@ def bench_scaling_tcp():
     import subprocess
     import sys
 
-    def run_leg(nproc):
+    def run_leg(nproc, pin=False):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        if pin:
+            env["BENCH_TCP_PIN"] = "1"
+        else:
+            # An exported BENCH_TCP_PIN must not leak into the nominally
+            # unpinned legs — the artifact would silently mix pinned and
+            # unpinned measurements.
+            env.pop("BENCH_TCP_PIN", None)
         out = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.run", "-np", str(nproc),
              "--", sys.executable, os.path.abspath(__file__),
@@ -644,6 +678,44 @@ def bench_scaling_tcp():
     two = run_leg(2)
     single_solo = run_solo(1)
     dual_solo = run_solo(2) if single_solo else None
+    # Pinned legs: each process confined to a disjoint CPU half, and the
+    # 1-process baseline confined to a half as well — so numerator and
+    # denominator run on the SAME compute budget and the efficiency
+    # isolates the data plane instead of scheduler contention (the
+    # multi-host analogue, where peers never share cores).  Requires at
+    # least 2 allowed CPUs; on a 1-CPU host the legs would silently
+    # measure the unpinned configuration, so they are skipped instead.
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        n_cpus = 1
+    if n_cpus < 2:
+        pinned = {"skipped": f"host allows {n_cpus} CPU(s); disjoint "
+                             "halves are impossible, the 2-process leg "
+                             "shares that budget entirely (see "
+                             "contention_ceiling)"}
+    else:
+        try:
+            one_pin = run_leg(1, pin=True)
+            two_pin = run_leg(2, pin=True)
+            if not (one_pin.get("pinned") and two_pin.get("pinned")):
+                raise RuntimeError("worker could not apply CPU affinity")
+            pinned_eff = round(two_pin["images_per_sec_per_proc"]
+                               / one_pin["images_per_sec_per_proc"], 4)
+            pinned = {
+                "images_per_sec_per_proc_1_halfcores":
+                    one_pin["images_per_sec_per_proc"],
+                "images_per_sec_per_proc_2":
+                    two_pin["images_per_sec_per_proc"],
+                "scaling_efficiency": pinned_eff,
+                "comm_fraction": two_pin["comm_fraction"],
+                "note": ("both measurements on a fixed half-machine CPU "
+                         "budget (sched_setaffinity): the efficiency "
+                         "loss here is the eager data plane's own cost, "
+                         "not core-scheduler contention"),
+            }
+        except Exception as e:   # noqa: BLE001 — affinity-less platforms
+            pinned = {"error": f"{type(e).__name__}: {e}"}
     transport = two.get("ring_transport", "tcp")
     eff = round(two["images_per_sec_per_proc"]
                 / one["images_per_sec_per_proc"], 4)
@@ -666,6 +738,7 @@ def bench_scaling_tcp():
         "contention_ceiling": ceiling,
         "efficiency_vs_ceiling": (round(eff / ceiling, 4)
                                   if ceiling else None),
+        "pinned": pinned,
         "comm_fraction": two["comm_fraction"],
         "comm_fraction_note": "wall time inside the eager allreduce over "
                               "wall time of the step, measured on rank 0 "

@@ -56,14 +56,15 @@ def _flash_vmem_mb() -> int:
     0 restores Mosaic's compiler default; a malformed value warns and
     falls back rather than raising mid-backward."""
     raw = os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB")
-    default = 32 if _vmem_headroom_ok() else 0
     if raw is None:
         # The raised default only applies where the hardware can back it
         # (v2/v3 have 16 MB of physical VMEM per core): an explicit
         # HOROVOD_TPU_FLASH_BWD_GROUP opt-in at small blocks compiled
         # fine under Mosaic's default budget there, and must keep doing
-        # so without the user also discovering the VMEM knob.
-        return default
+        # so without the user also discovering the VMEM knob.  Computed
+        # only on this branch — _vmem_headroom_ok touches the device
+        # list, which an explicit valid value never needs.
+        return 32 if _vmem_headroom_ok() else 0
     try:
         val = int(raw)
         if val < 0:
@@ -71,6 +72,7 @@ def _flash_vmem_mb() -> int:
         return val
     except ValueError:
         import warnings
+        default = 32 if _vmem_headroom_ok() else 0
         warnings.warn(
             f"HOROVOD_TPU_FLASH_VMEM_MB={raw!r} is not a non-negative "
             f"integer; using the default {default}",

@@ -41,6 +41,9 @@ import jax.numpy as jnp
 from jax import lax
 
 _DEFAULT_MODE = "unroll2"
+# Beyond this many python-unrolled chunks the HLO growth outweighs the
+# unrolled form's advantages and the lax.scan schedule takes over.
+_MAX_UNROLL_CHUNKS = 8
 
 
 def _xent_mode() -> str:
@@ -78,15 +81,21 @@ def _xent_mode() -> str:
 
 
 def _mode_layout(mode: str, n: int, chunk: int):
-    """(save_logits, n_chunks) for a validated mode string; ``n_chunks``
-    is ``None`` for the ``recompute`` schedule (which tiles by the
-    ``chunk`` argument instead) and otherwise clamped to a divisor of
-    ``n``.  An explicitly small ``chunk`` is honored in every mode —
+    """(save_logits, n_chunks, scan_chunk) for a validated mode string.
+
+    ``n_chunks`` is ``None`` when the schedule should be the
+    ``lax.scan``/single-tile ``recompute`` form, tiled by ``scan_chunk``
+    rows; otherwise it is the python-unroll count, clamped to a divisor
+    of ``n``.  An explicitly small ``chunk`` is honored in every mode —
     the caller's transient bound (chunk × V f32) RAISES the chunk count
-    past the mode's minimum when n/k would exceed it, keeping the
-    documented memory contract while staying python-unrolled."""
+    past the mode's minimum when n/k would exceed it — but once that
+    would unroll more than ``_MAX_UNROLL_CHUNKS`` bodies into the HLO
+    (each ~3 large matmuls in the backward), the constant-size scan
+    schedule takes over at the same transient bound (losing a
+    save-mode's residual is fine — at that many chunks the transient is
+    tiny anyway)."""
     if mode == "recompute":
-        return False, None
+        return False, None, chunk
     save = mode.startswith("save")
     k = int((mode[len("save"):] if save else mode[len("unroll"):]) or 1)
     k = max(1, k)
@@ -94,7 +103,9 @@ def _mode_layout(mode: str, n: int, chunk: int):
         k -= 1
     if n // k > chunk:
         k = n // _pick_chunk(n, chunk)
-    return save, k
+    if k > _MAX_UNROLL_CHUNKS:
+        return False, None, min(chunk, n // k)
+    return save, k, chunk
 
 
 def _pick_chunk(n: int, target: int) -> int:
@@ -175,7 +186,9 @@ def fused_softmax_xent(hidden, w, labels, chunk=16384):
     Returns: (N,) f32 per-token losses (``lse - logit[label]``) — take
     ``.mean()`` for the usual reduction.
     """
-    loss, _ = _xent_fwd(hidden, w, labels, chunk)
+    # Primal-only call (no VJP): a save-mode residual would be computed
+    # and thrown away — suppress it.
+    loss, _ = _xent_fwd(hidden, w, labels, chunk, _save_ok=False)
     return loss
 
 
@@ -197,10 +210,11 @@ def _xent_fwd_impl(hidden, w, labels, chunk):
     return loss.reshape(n), lse.reshape(n)
 
 
-def _xent_fwd(hidden, w, labels, chunk):
-    save, k = _mode_layout(_xent_mode(), hidden.shape[0], chunk)
+def _xent_fwd(hidden, w, labels, chunk, _save_ok=True):
+    save, k, scan_chunk = _mode_layout(_xent_mode(), hidden.shape[0], chunk)
+    save = save and _save_ok
     if k is None:
-        loss, lse = _xent_fwd_impl(hidden, w, labels, chunk)
+        loss, lse = _xent_fwd_impl(hidden, w, labels, scan_chunk)
         return loss, (hidden, w, labels, lse, None)
     wc = w.astype(hidden.dtype)
     n = hidden.shape[0]
@@ -222,7 +236,7 @@ def _xent_bwd(chunk, res, g):
     n, d = hidden.shape
     wc = w.astype(hidden.dtype)
     g = g.astype(jnp.float32)
-    _, k = _mode_layout(_xent_mode(), n, chunk)
+    _, k, scan_chunk = _mode_layout(_xent_mode(), n, chunk)
     if k is not None or logits_bf16 is not None:
         k = k or 1
         c = n // k
@@ -236,7 +250,7 @@ def _xent_bwd(chunk, res, g):
             dw = dw + dw_c
         return (jnp.concatenate(dhs).astype(hidden.dtype),
                 dw.astype(w.dtype), None)
-    c = _pick_chunk(n, chunk)
+    c = _pick_chunk(n, scan_chunk)
     if c == n:
         dh, dw = _chunk_bwd(hidden, wc, labels, lse, g)
     else:

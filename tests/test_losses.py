@@ -67,7 +67,7 @@ class TestFusedXent:
                                    rtol=3e-2, atol=3e-2)
 
     @pytest.mark.parametrize("mode", ["recompute", "save", "save2",
-                                      "unroll2", "unroll3"])
+                                      "unroll2", "unroll3", "unroll16"])
     def test_schedule_modes_match_reference(self, mode, monkeypatch):
         """Every HOROVOD_TPU_XENT_MODE schedule (default unroll2, the
         save/saveK residual forms, the single-tile recompute) computes
