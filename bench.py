@@ -458,17 +458,63 @@ def bench_transformer(jax, hvd, mesh, nchips):
 def _pin_cpu_half(half: int) -> bool:
     """Pin this process to one half of the allowed CPUs (BENCH_TCP_PIN
     legs).  Must run BEFORE jax initializes its thread pools.  Returns
-    False (no-op) when affinity is unsupported or <2 CPUs."""
+    False (no-op) when affinity is unsupported or <2 CPUs.
+
+    The split keeps SMT siblings TOGETHER: Linux typically enumerates
+    one hyperthread per physical core first and the siblings after, so
+    a naive first-half/second-half cut would hand both processes the
+    same physical cores (each owning one thread of every core) — the
+    exact contention the pinned leg exists to remove.  CPUs are grouped
+    by (package, core) id from sysfs and whole cores are dealt greedily
+    (largest group to the lighter half) so the halves get CPU counts as
+    equal as whole cores allow — a group-count or contiguous split
+    would starve one half on a hybrid host (2-thread P-cores + 1-thread
+    E-cores) and the lockstep allreduce would report the asymmetry as
+    data-plane cost.  Unreadable topology degrades to single-CPU groups
+    (positional dealing)."""
     try:
         cpus = sorted(os.sched_getaffinity(0))
     except AttributeError:          # non-Linux
         return False
-    if len(cpus) < 2:
-        return False
-    mid = len(cpus) // 2
-    os.sched_setaffinity(0, set(cpus[:mid] if half % 2 == 0
-                                else cpus[mid:]))
+    groups = _cpu_core_groups(cpus)
+    if len(groups) < 2:
+        return False   # a single physical core cannot give disjoint halves
+    bins, counts = ([], []), [0, 0]
+    for g in sorted(groups, key=len, reverse=True):
+        i = 0 if counts[0] <= counts[1] else 1
+        bins[i].append(g)
+        counts[i] += len(g)
+    chosen = bins[half % 2]
+    os.sched_setaffinity(0, {c for g in chosen for c in g})
     return True
+
+
+def _cpu_core_groups(cpus):
+    """Allowed CPUs grouped by physical core ((package, core) id from
+    sysfs), sorted; single-CPU groups positionally when the topology is
+    unreadable.  Shared by the pin helper and the parent's can-we-pin
+    gate so they can never disagree."""
+    if len(cpus) < 2:
+        return [[c] for c in cpus]
+
+    def core_key(c):
+        base = f"/sys/devices/system/cpu/cpu{c}/topology"
+        try:
+            with open(f"{base}/physical_package_id") as f:
+                pkg = int(f.read())
+            with open(f"{base}/core_id") as f:
+                core = int(f.read())
+            return (pkg, core)
+        except (OSError, ValueError):
+            return None
+
+    keys = {c: core_key(c) for c in cpus}
+    if any(k is None for k in keys.values()):
+        return [[c] for c in cpus]                   # positional fallback
+    by_core = {}
+    for c in cpus:
+        by_core.setdefault(keys[c], []).append(c)
+    return [by_core[k] for k in sorted(by_core)]
 
 
 def tcp_worker():
@@ -624,18 +670,32 @@ def bench_scaling_tcp():
             # unpinned legs — the artifact would silently mix pinned and
             # unpinned measurements.
             env.pop("BENCH_TCP_PIN", None)
-        out = subprocess.run(
+        # Own session so a timeout can kill the WHOLE process group:
+        # subprocess.run's timeout only kills the launcher, leaving its
+        # worker grandchildren burning cores under the retried window.
+        proc = subprocess.Popen(
             [sys.executable, "-m", "horovod_tpu.run", "-np", str(nproc),
              "--", sys.executable, os.path.abspath(__file__),
              "--tcp-worker"],
-            capture_output=True, text=True, timeout=600, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        for line in out.stdout.splitlines():
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+            proc.wait()
+            raise
+        for line in stdout.splitlines():
             if line.startswith("TCPLEG "):
                 return json.loads(line[len("TCPLEG "):])
         raise RuntimeError(
             f"tcp leg ({nproc}p) produced no TCPLEG line:\n"
-            f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+            f"{stdout[-2000:]}\n{stderr[-2000:]}")
 
     def run_solo(nproc):
         """N INDEPENDENT comm-free workers at once (the tcp loop minus
@@ -681,12 +741,17 @@ def bench_scaling_tcp():
     windows = max(1, int(os.environ.get("BENCH_TCP_WINDOWS", "3")))
 
     def best_leg(nproc, pin=False):
-        """Best window by throughput; a transient window failure only
-        costs that window — the leg fails when ALL windows do."""
+        """Best window by throughput; a transient launch/negotiation
+        failure only costs that window — the leg fails when ALL windows
+        do.  A TIMEOUT is not retried: a hang is not transient, each
+        repeat would cost another 600 s, and the group-kill above has
+        already reaped the stuck workers."""
         runs, last_err = [], None
         for _ in range(windows):
             try:
                 runs.append(run_leg(nproc, pin=pin))
+            except subprocess.TimeoutExpired:
+                raise
             except Exception as e:   # noqa: BLE001 — launcher transients
                 last_err = e
         if not runs:
@@ -712,11 +777,17 @@ def bench_scaling_tcp():
     # least 2 allowed CPUs; on a 1-CPU host the legs would silently
     # measure the unpinned configuration, so they are skipped instead.
     try:
-        n_cpus = len(os.sched_getaffinity(0))
+        allowed = sorted(os.sched_getaffinity(0))
     except AttributeError:
-        n_cpus = 1
-    if n_cpus < 2:
-        pinned = {"skipped": f"host allows {n_cpus} CPU(s); disjoint "
+        allowed = [0]
+    # Same grouping the worker's pin helper uses: a host whose allowed
+    # CPUs are SMT siblings of one physical core is just as unsplittable
+    # as a 1-CPU host, and must be reported as a deliberate skip, not as
+    # an affinity "error" after burning every pinned window.
+    n_splittable = len(_cpu_core_groups(allowed))
+    if n_splittable < 2:
+        pinned = {"skipped": f"host allows {len(allowed)} CPU(s) on "
+                             f"{n_splittable} physical core(s); disjoint "
                              "halves are impossible, the 2-process leg "
                              "shares that budget entirely (see "
                              "contention_ceiling)"}
