@@ -484,6 +484,14 @@ def _pin_cpu_half(half: int) -> bool:
         i = 0 if counts[0] <= counts[1] else 1
         bins[i].append(g)
         counts[i] += len(g)
+    # When whole cores cannot split evenly (odd core count), hand the
+    # SMALLER half to process 0: the pinned 1-process baseline runs as
+    # process 0, and the lockstep 2-process leg is paced by its slowest
+    # rank — giving both the same (bottleneck) budget keeps the
+    # efficiency ratio an apples-to-apples data-plane measurement
+    # instead of blaming the core asymmetry on the wire.
+    if counts[1] < counts[0]:
+        bins = (bins[1], bins[0])
     chosen = bins[half % 2]
     os.sched_setaffinity(0, {c for g in chosen for c in g})
     return True
@@ -750,14 +758,19 @@ def bench_scaling_tcp():
         for _ in range(windows):
             try:
                 runs.append(run_leg(nproc, pin=pin))
-            except subprocess.TimeoutExpired:
-                raise
+            except subprocess.TimeoutExpired as e:
+                # A hang is not transient and each repeat costs another
+                # 600 s — stop launching windows, but keep any already
+                # collected (the group-kill has reaped the stuck
+                # workers, so they are untainted).
+                last_err = e
+                break
             except Exception as e:   # noqa: BLE001 — launcher transients
                 last_err = e
         if not runs:
             raise RuntimeError(
-                f"all {windows} windows of the {nproc}-process leg "
-                f"failed; last error: {last_err}") from last_err
+                f"all windows of the {nproc}-process leg failed; last "
+                f"error: {last_err}") from last_err
         return max(runs, key=lambda r: r["images_per_sec_per_proc"])
 
     def best_solo(nproc):

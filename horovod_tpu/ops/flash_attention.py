@@ -456,10 +456,30 @@ def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
     # unrolled-KV form there; compiled Mosaic is unaffected.
     in_vma = getattr(jax.typeof(q), "vma", None) or frozenset()
     fb = min(_FULL_UNROLL_BLOCK, block_q, block_k, T)
+    # Mosaic's stack for the unrolled body scales ~T² (f32 s/p
+    # temporaries per live block pair): measured ≤16 MB at T=2048 but
+    # 44.4 MB at T=4096, which overflows the default scoped-VMEM budget.
+    # Past 2048 the kernel therefore needs a ≥64 MB budget: by default
+    # granted where the hardware backs it (v4+; v2/v3's 16 MB physical
+    # VMEM cannot), and when HOROVOD_TPU_FLASH_VMEM_MB is set
+    # explicitly, the user's figure rules — a value below 64 (including
+    # 0 = compiler default) stands this form down instead of silently
+    # requesting more than asked.  Either way the unrolled-KV form
+    # below takes over when this one is refused.
+    if T <= 2048:
+        _fwd_vmem_mb = 0                 # default budget suffices
+        _fwd_ok = True
+    elif os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB") is None:
+        _fwd_vmem_mb = 64 if _vmem_headroom_ok() else 0
+        _fwd_ok = _fwd_vmem_mb > 0
+    else:
+        _fwd_vmem_mb = _flash_vmem_mb()
+        _fwd_ok = _fwd_vmem_mb >= 64
     if (T <= _FULL_UNROLL_MAX_T and T % fb == 0
             and T // fb <= _FULL_UNROLL_MAX_NQ
             and not (interpret and in_vma)
-            and T * D * q.dtype.itemsize <= _UNROLL_KV_MAX_BYTES):
+            and T * D * q.dtype.itemsize <= _UNROLL_KV_MAX_BYTES
+            and _fwd_ok):
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel_fullunroll, scale=scale,
                               causal=causal, block=fb, seq_len=seq_len,
@@ -479,7 +499,9 @@ def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
                 _struct((B, H, T, 8), jnp.float32, q, k, v),
             ],
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel")),
+                dimension_semantics=("parallel", "parallel"),
+                **({"vmem_limit_bytes": _fwd_vmem_mb * 1024 * 1024}
+                   if _fwd_vmem_mb else {})),
             interpret=interpret,
         )(q, k, v)
         return out, lse[..., 0]

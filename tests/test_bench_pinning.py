@@ -8,6 +8,7 @@ the sysfs topology."""
 
 import builtins
 import io
+import os
 import sys
 
 import pytest
@@ -15,6 +16,13 @@ import pytest
 
 @pytest.fixture
 def bench_mod():
+    # bench.py lives at the repo root, which plain `pytest` does not put
+    # on sys.path (tests/ has no __init__.py, so rootdir insertion
+    # inserts tests/, not the root).
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    added = root not in sys.path
+    if added:
+        sys.path.insert(0, root)
     saved = sys.argv
     sys.argv = ["bench.py"]
     try:
@@ -22,6 +30,8 @@ def bench_mod():
         yield bench
     finally:
         sys.argv = saved
+        if added:
+            sys.path.remove(root)
 
 
 def _fake_topology(monkeypatch, bench, cpus, pkg_core_by_cpu):
@@ -84,6 +94,23 @@ class TestPinCpuHalf:
         assert len(h0) == len(h1) == 3
         assert ({0, 4} <= h0) or ({0, 4} <= h1)   # siblings together
         assert ({1, 5} <= h0) or ({1, 5} <= h1)
+
+    def test_odd_core_count_gives_process0_the_smaller_half(
+            self, monkeypatch, bench_mod):
+        """5 cores x 2 threads: whole cores cannot split 5/5 — the pinned
+        1-process baseline (process 0) must get the SMALLER half, the
+        same budget that paces the lockstep 2-process leg, so the
+        efficiency ratio stays apples-to-apples."""
+        topo = {c: (0, c % 5) for c in range(10)}
+        pinned = _fake_topology(monkeypatch, bench_mod, range(10), topo)
+        assert bench_mod._pin_cpu_half(0)
+        h0 = pinned["mask"]
+        assert bench_mod._pin_cpu_half(1)
+        h1 = pinned["mask"]
+        assert h0 | h1 == set(range(10)) and not (h0 & h1)
+        assert len(h0) == 4 and len(h1) == 6
+        for c in range(5):
+            assert ({c, c + 5} <= h0) or ({c, c + 5} <= h1)
 
     def test_single_physical_core_refuses(self, monkeypatch, bench_mod):
         """2 CPUs that are SMT siblings of ONE core: no disjoint halves
