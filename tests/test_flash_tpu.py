@@ -27,13 +27,21 @@ def make_qkv(rng, B, T, H, D, dtype=jnp.bfloat16):
     return tuple(jax.random.normal(k, (B, T, H, D), dtype) for k in ks)
 
 
-def test_fwd_bwd_compile_and_match_dense():
+def _check_fwd_bwd(key, B, T, H, D, expect_fwd_kernel=None):
+    """Shared compile-and-match body: flash forward vs the dense oracle,
+    grad finiteness, and (optionally) WHICH forward kernel form the
+    lowering selected — a fallback silently passing as the guarded form
+    is exactly what a regression test must not do."""
     from horovod_tpu.ops.flash_attention import flash_attention
     from horovod_tpu.parallel.ring_attention import full_attention
 
-    q, k, v = make_qkv(jax.random.PRNGKey(0), 1, 2048, 4, 64)
-    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
-        q, k, v)
+    q, k, v = make_qkv(jax.random.PRNGKey(key), B, T, H, D)
+    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    if expect_fwd_kernel is not None:
+        assert expect_fwd_kernel in fwd.lower(q, k, v).as_text(), (
+            f"expected the {expect_fwd_kernel} forward form at "
+            f"T={T}, D={D}; the gate stood it down")
+    out = fwd(q, k, v)
     want = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(want, np.float32),
@@ -45,6 +53,23 @@ def test_fwd_bwd_compile_and_match_dense():
 
     g = jax.jit(jax.grad(loss))(q)
     assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_fwd_bwd_compile_and_match_dense():
+    _check_fwd_bwd(0, 1, 2048, 4, 64)
+
+
+def test_fullunroll_t4096_grad_compiles():
+    """T=4096, D=128: the fully-unrolled forward's Mosaic stack is
+    ~44 MB here — over the 16 MB default scoped-VMEM budget — and only
+    compiles through the raised per-kernel budget (round-5 regression:
+    the sweep's 4096 row failed allocation until the budget landed).
+    Asserts the fullunroll form is actually selected (the unrolled-KV
+    fallback must not let a gate regression pass silently), checks the
+    forward against the dense oracle, and runs the backward through the
+    packed split pair at these blocks."""
+    _check_fwd_bwd(5, 1, 4096, 2, 128,
+                   expect_fwd_kernel="_fwd_kernel_fullunroll")
 
 
 def test_auto_pad_prime_length_compiles():
