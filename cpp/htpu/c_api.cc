@@ -8,6 +8,7 @@
 // Memory contract: every function returning a buffer allocates it with
 // malloc and the caller releases it with htpu_free().
 
+#include <algorithm>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -16,6 +17,7 @@
 #include "htpu/control.h"
 #include "htpu/fusion.h"
 #include "htpu/message_table.h"
+#include "htpu/quantize.h"
 #include "htpu/timeline.h"
 #include "htpu/wire.h"
 
@@ -222,17 +224,20 @@ HTPU_API int htpu_control_tick(void* cp, const void* req_blob, int len,
 // One copy total: the input lands straight in the malloc'd output buffer
 // and the ring reduces in place (the payload path measured copy-bound at
 // multi-MB gradients — docs/benchmarks.md, round-5 eager plane study).
-HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
-                           long long len, void** out) try {
+// `wire_dtype` ("", "bf16", "fp16", "int8") selects the compressed wire
+// format for fp32 payloads (quantize.h).
+HTPU_API int htpu_control_allreduce_wire(void* cp, const char* dtype,
+                                const char* wire_dtype, const void* in,
+                                long long len, void** out) try {
   char* buf = static_cast<char*>(malloc(len > 0 ? size_t(len) : 1));
   if (!buf) return -1;
   std::memcpy(buf, in, size_t(len));
   bool ok = false;
   try {
-    ok = static_cast<htpu::ControlPlane*>(cp)->AllreduceBuf(dtype, buf,
-                                                            len);
+    ok = static_cast<htpu::ControlPlane*>(cp)->AllreduceBuf(
+        dtype, buf, len, wire_dtype ? wire_dtype : "");
   } catch (...) {
-    ok = false;   // e.g. bad_alloc sizing the ring's tmp segment buffer
+    ok = false;   // e.g. bad_alloc sizing the ring's chunk buffers
   }
   if (!ok) {
     free(buf);
@@ -242,6 +247,11 @@ HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
   return int(len);
 } catch (...) {
   return -1;
+}
+
+HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
+                           long long len, void** out) {
+  return htpu_control_allreduce_wire(cp, dtype, "", in, len, out);
 }
 
 HTPU_API int htpu_control_allgather(void* cp, const void* in, long long len,
@@ -265,6 +275,37 @@ HTPU_API int htpu_control_broadcast(void* cp, int root_process, const void* in,
     return -1;
   }
   return CopyOut(result, out);
+} catch (...) {
+  return -1;
+}
+
+// Single-process round trip through the wire codec (quantize.h), framed
+// in the same kSubChunkElems sub-chunks the ring uses: encode `n_elems`
+// fp32 values, decode them back into `out`.  Returns the wire byte count
+// (what the ring would put on the socket per hop for this payload) or -1
+// on an unknown wire dtype.  Exists so tests can pin the codec's
+// numerics and framing without spawning a 2-process ring.
+HTPU_API long long htpu_wire_roundtrip(const char* wire_dtype, const void* in,
+                              long long n_elems, void* out) try {
+  const int wire = htpu::WireDtypeId(wire_dtype ? wire_dtype : "");
+  if (wire < 0 || n_elems < 0) return -1;
+  const float* src = static_cast<const float*>(in);
+  float* dst = static_cast<float*>(out);
+  if (wire == htpu::kWireRaw) {
+    std::memcpy(dst, src, size_t(n_elems) * 4);
+    return n_elems * 4;
+  }
+  std::string buf(size_t(htpu::WireChunkBytes(wire, htpu::kSubChunkElems)),
+                  '\0');
+  long long total = 0;
+  for (long long lo = 0; lo < n_elems; lo += htpu::kSubChunkElems) {
+    const long long len = std::min<long long>(htpu::kSubChunkElems,
+                                              n_elems - lo);
+    htpu::EncodeWireChunk(wire, src + lo, len, &buf[0]);
+    htpu::DecodeWireChunk(wire, buf.data(), len, dst + lo);
+    total += htpu::WireChunkBytes(wire, len);
+  }
+  return total;
 } catch (...) {
   return -1;
 }

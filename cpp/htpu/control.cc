@@ -6,10 +6,12 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <future>
 #include <numeric>
 #include <stdexcept>
 
 #include "htpu/fusion.h"
+#include "htpu/quantize.h"
 #include "htpu/reduce.h"
 #include "htpu/timeline.h"
 #include "htpu/transport.h"
@@ -380,9 +382,28 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
 // from MPI's ring algorithms for free.  Operating in place on the
 // caller's buffer keeps the copy count at one for the whole C API round
 // trip (the payload path was measured copy-bound, docs/benchmarks.md).
+//
+// Two round-6 additions (quantize.h):
+//  * wire_dtype narrows fp32 payloads on the socket — bf16/fp16
+//    truncate-cast, or int8 per-block absmax with fp32 scales; the
+//    accumulator stays fp32, so each reduce-scatter hop is
+//    dequantize-sum and the next send requantizes the partial sum
+//    (EQuARX's dequantize-sum-requantize).  In the allgather phase each
+//    reduced segment is encoded once by its owner and the wire image
+//    forwarded verbatim, so every element is quantized at most once.
+//  * every segment moves in kSubChunkElems sub-chunks with a
+//    double-buffered receive, so the SumInto/dequantize of sub-chunk k
+//    overlaps the duplex transfer of sub-chunk k+1 (previously the
+//    whole segment transferred, then reduced serially).
 bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
-                                int64_t nbytes) {
+                                int64_t nbytes,
+                                const std::string& wire_dtype) {
   if (process_count_ == 1) return true;
+  const int wire = WireDtypeId(wire_dtype);
+  if (wire < 0) return false;
+  // Compressed wire formats are defined over fp32 payloads only (the
+  // Python surface enforces the same rule before submitting).
+  if (wire != kWireRaw && dtype != "float32") return false;
   const int P = process_count_;
   const int r = process_index_;
   const int elem = DtypeSize(dtype);
@@ -399,46 +420,208 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       seg_off[size_t(i) + 1] =
           seg_off[size_t(i)] + (base + (i < rem ? 1 : 0));
   }
-  auto off_bytes = [&](int seg) { return seg_off[size_t(seg)] * elem; };
-  auto len_bytes = [&](int seg) {
-    return (seg_off[size_t(seg) + 1] - seg_off[size_t(seg)]) * elem;
+  auto seg_elems = [&](int seg) {
+    return seg_off[size_t(seg) + 1] - seg_off[size_t(seg)];
+  };
+  auto seg_base = [&](int seg) {
+    return data + seg_off[size_t(seg)] * elem;
   };
 
-  std::string tmp;
-  tmp.resize(size_t((n_elems / P + 1) * elem));
+  const int64_t CH = kSubChunkElems;
+  auto n_chunks_of = [CH](int64_t n) { return (n + CH - 1) / CH; };
+
+  // Receive-side double buffer + one in-flight decode per slot: the
+  // reduce of sub-chunk k runs on a helper thread while sub-chunk k+1 is
+  // on the wire.  Raw wires size the slots by the payload element width.
+  const int64_t chunk_wire_cap =
+      wire == kWireRaw ? CH * elem : WireChunkBytes(wire, CH);
+  std::vector<char> rbuf[2];
+  rbuf[0].resize(size_t(chunk_wire_cap));
+  rbuf[1].resize(size_t(chunk_wire_cap));
+  std::future<bool> pending[2];
+  auto drain = [&pending]() {
+    bool ok = true;
+    for (auto& p : pending)
+      if (p.valid()) ok = p.get() && ok;
+    return ok;
+  };
+
+  std::vector<char> sbuf;   // encode staging (compressed wires only)
+  if (wire != kWireRaw) sbuf.resize(size_t(chunk_wire_cap));
+
+  auto wire_bytes_of = [&](int64_t n) {
+    return wire == kWireRaw ? n * elem : WireChunkBytes(wire, n);
+  };
 
   // Phase 1: reduce-scatter.  After step s, this process holds the partial
-  // sum of segments (r - s - 1) mod P across s + 2 processes.
+  // sum of segment (r - s - 1) mod P across s + 2 processes.
   for (int s = 0; s < P - 1; ++s) {
-    int send_seg = (r - s + P) % P;
-    int recv_seg = (r - s - 1 + P) % P;
-    int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
-    if (!DuplexTransfer(ring_next_fd_, data + off_bytes(send_seg),
-                        size_t(sbytes), ring_prev_fd_, &tmp[0],
-                        size_t(rbytes), timeout_ms_)) {
-      return false;
+    const int send_seg = (r - s + P) % P;
+    const int recv_seg = (r - s - 1 + P) % P;
+    const int64_t send_n = seg_elems(send_seg);
+    const int64_t recv_n = seg_elems(recv_seg);
+    const int64_t steps =
+        std::max(n_chunks_of(send_n), n_chunks_of(recv_n));
+    char* send_base = seg_base(send_seg);
+    char* recv_base = seg_base(recv_seg);
+    bool ok = true;
+    for (int64_t k = 0; k < steps; ++k) {
+      const int64_t s_lo = std::min(k * CH, send_n);
+      const int64_t s_len = std::min(CH, send_n - s_lo);
+      const int64_t r_lo = std::min(k * CH, recv_n);
+      const int64_t r_len = std::min(CH, recv_n - r_lo);
+      const char* sptr;
+      if (wire == kWireRaw) {
+        sptr = send_base + s_lo * elem;
+      } else {
+        EncodeWireChunk(wire,
+                        reinterpret_cast<const float*>(send_base) + s_lo,
+                        s_len, sbuf.data());
+        sptr = sbuf.data();
+      }
+      const int64_t swire = wire_bytes_of(s_len);
+      const int64_t rwire = wire_bytes_of(r_len);
+      char* rptr = rbuf[k & 1].data();
+      // The slot's previous decode (sub-chunk k-2) must land before the
+      // buffer is overwritten.
+      if (pending[k & 1].valid()) ok = pending[k & 1].get() && ok;
+      if (!ok) {
+        drain();
+        return false;
+      }
+      if (!DuplexTransfer(ring_next_fd_, sptr, size_t(swire),
+                          ring_prev_fd_, rptr, size_t(rwire),
+                          timeout_ms_)) {
+        drain();
+        return false;
+      }
+      data_bytes_sent_ += swire;
+      data_bytes_recv_ += rwire;
+      if (r_len > 0) {
+        if (wire == kWireRaw) {
+          char* acc = recv_base + r_lo * elem;
+          const int64_t acc_bytes = r_len * elem;
+          if (steps == 1) {
+            ok = SumInto(dtype, acc, rptr, acc_bytes) && ok;
+          } else {
+            pending[k & 1] = std::async(
+                std::launch::async, [&dtype, acc, rptr, acc_bytes]() {
+                  return SumInto(dtype, acc, rptr, acc_bytes);
+                });
+          }
+        } else {
+          float* acc = reinterpret_cast<float*>(recv_base) + r_lo;
+          if (steps == 1) {
+            DecodeWireChunkAdd(wire, rptr, r_len, acc);
+          } else {
+            pending[k & 1] = std::async(
+                std::launch::async, [wire, rptr, r_len, acc]() {
+                  DecodeWireChunkAdd(wire, rptr, r_len, acc);
+                  return true;
+                });
+          }
+        }
+      }
     }
-    data_bytes_sent_ += sbytes;
-    data_bytes_recv_ += rbytes;
-    if (rbytes &&
-        !SumInto(dtype, data + off_bytes(recv_seg), tmp.data(), rbytes)) {
-      return false;
-    }
+    // The segment just reduced is next step's send segment: every decode
+    // must land before it goes back on the wire.
+    ok = drain() && ok;
+    if (!ok) return false;
   }
 
   // Phase 2: allgather of the fully reduced segments.
-  for (int s = 0; s < P - 1; ++s) {
-    int send_seg = (r + 1 - s + P) % P;
-    int recv_seg = (r - s + P) % P;
-    int64_t sbytes = len_bytes(send_seg), rbytes = len_bytes(recv_seg);
-    if (!DuplexTransfer(ring_next_fd_, data + off_bytes(send_seg),
-                        size_t(sbytes), ring_prev_fd_,
-                        data + off_bytes(recv_seg), size_t(rbytes),
-                        timeout_ms_)) {
-      return false;
+  if (wire == kWireRaw) {
+    for (int s = 0; s < P - 1; ++s) {
+      int send_seg = (r + 1 - s + P) % P;
+      int recv_seg = (r - s + P) % P;
+      int64_t sbytes = seg_elems(send_seg) * elem;
+      int64_t rbytes = seg_elems(recv_seg) * elem;
+      if (!DuplexTransfer(ring_next_fd_, seg_base(send_seg),
+                          size_t(sbytes), ring_prev_fd_,
+                          seg_base(recv_seg), size_t(rbytes),
+                          timeout_ms_)) {
+        return false;
+      }
+      data_bytes_sent_ += sbytes;
+      data_bytes_recv_ += rbytes;
     }
-    data_bytes_sent_ += sbytes;
-    data_bytes_recv_ += rbytes;
+    return true;
+  }
+
+  // Compressed allgather: each reduced segment is encoded ONCE by its
+  // owner and the wire image forwarded verbatim around the ring
+  // (re-encoding at every hop would compound quantization error and CPU
+  // cost); every receiver materializes fp32 from that same image, so the
+  // final buffers agree bit-for-bit across processes except each owner's
+  // own (exact fp32) segment.
+  int64_t max_seg = 0;
+  for (int i = 0; i < P; ++i) max_seg = std::max(max_seg, seg_elems(i));
+  std::vector<char> wseg[2];
+  wseg[0].resize(size_t(WireSegmentBytes(wire, max_seg)));
+  wseg[1].resize(size_t(WireSegmentBytes(wire, max_seg)));
+  int cur = 0;
+  {
+    // Encode our own reduced segment — the one sent at step 0.
+    const int own = (r + 1) % P;
+    const float* src = reinterpret_cast<const float*>(seg_base(own));
+    const int64_t n = seg_elems(own);
+    char* o = wseg[cur].data();
+    for (int64_t lo = 0; lo < n; lo += CH) {
+      const int64_t len = std::min(CH, n - lo);
+      EncodeWireChunk(wire, src + lo, len, o);
+      o += WireChunkBytes(wire, len);
+    }
+  }
+  for (int s = 0; s < P - 1; ++s) {
+    const int send_seg = (r + 1 - s + P) % P;
+    const int recv_seg = (r - s + P) % P;
+    const int64_t send_n = seg_elems(send_seg);
+    const int64_t recv_n = seg_elems(recv_seg);
+    const int64_t steps =
+        std::max(n_chunks_of(send_n), n_chunks_of(recv_n));
+    const char* sw = wseg[cur].data();
+    char* rw = wseg[cur ^ 1].data();
+    float* out_base = reinterpret_cast<float*>(seg_base(recv_seg));
+    int64_t s_off = 0, r_off = 0;
+    bool ok = true;
+    for (int64_t k = 0; k < steps; ++k) {
+      const int64_t s_lo = std::min(k * CH, send_n);
+      const int64_t s_len = std::min(CH, send_n - s_lo);
+      const int64_t r_lo = std::min(k * CH, recv_n);
+      const int64_t r_len = std::min(CH, recv_n - r_lo);
+      const int64_t swire = WireChunkBytes(wire, s_len);
+      const int64_t rwire = WireChunkBytes(wire, r_len);
+      if (pending[k & 1].valid()) ok = pending[k & 1].get() && ok;
+      if (!ok) {
+        drain();
+        return false;
+      }
+      if (!DuplexTransfer(ring_next_fd_, sw + s_off, size_t(swire),
+                          ring_prev_fd_, rw + r_off, size_t(rwire),
+                          timeout_ms_)) {
+        drain();
+        return false;
+      }
+      data_bytes_sent_ += swire;
+      data_bytes_recv_ += rwire;
+      if (r_len > 0) {
+        const char* src = rw + r_off;
+        float* dst = out_base + r_lo;
+        if (steps == 1) {
+          DecodeWireChunk(wire, src, r_len, dst);
+        } else {
+          pending[k & 1] = std::async(
+              std::launch::async, [wire, src, r_len, dst]() {
+                DecodeWireChunk(wire, src, r_len, dst);
+                return true;
+              });
+        }
+      }
+      s_off += swire;
+      r_off += rwire;
+    }
+    if (!(drain() && ok)) return false;
+    cur ^= 1;   // the image just received is next step's forward
   }
   return true;
 }

@@ -61,8 +61,14 @@ class ControlPlane {
                  std::string* out);
   // Zero-extra-copy variant: reduce IN PLACE on the caller's buffer (the
   // C API round trip is copy-bound at multi-MB payloads; this keeps it
-  // at one copy total).
-  bool AllreduceBuf(const std::string& dtype, char* data, int64_t nbytes);
+  // at one copy total).  `wire_dtype` ("", "bf16", "fp16", "int8" —
+  // quantize.h) selects the compressed wire format for fp32 payloads:
+  // segments are narrowed before the socket and re-widened into the fp32
+  // accumulator on receive, and every segment moves in ~256 KiB
+  // sub-chunks double-buffered so the dequantize/SumInto of chunk k
+  // overlaps the duplex transfer of chunk k+1.
+  bool AllreduceBuf(const std::string& dtype, char* data, int64_t nbytes,
+                    const std::string& wire_dtype = std::string());
   bool Allgather(const std::string& in, std::string* out);
   bool Broadcast(int root_process, const std::string& in, std::string* out);
 

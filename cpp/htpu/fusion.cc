@@ -21,6 +21,7 @@ std::vector<Response> PlanFusion(
     merged.response_type = ResponseType::ALLREDUCE;
     merged.tensor_names = r.tensor_names;
     merged.devices = r.devices;
+    merged.wire_dtype = r.wire_dtype;
     int64_t total = 0;
     for (const auto& n : merged.tensor_names) total += entry_bytes(n);
     std::string dtype = entry_dtype(merged.tensor_names[0]);
@@ -30,6 +31,9 @@ std::vector<Response> PlanFusion(
       if (nxt.response_type != ResponseType::ALLREDUCE) break;
       if (nxt.tensor_names.empty()) break;
       if (entry_dtype(nxt.tensor_names[0]) != dtype) break;
+      // A fused buffer rides the ring as one payload with one wire
+      // format — only merge entries that negotiated the same one.
+      if (nxt.wire_dtype != merged.wire_dtype) break;
       int64_t nbytes = 0;
       for (const auto& n : nxt.tensor_names) nbytes += entry_bytes(n);
       if (total + nbytes > threshold) break;

@@ -63,6 +63,24 @@ Response MessageTable::ConstructResponse(const std::string& name) {
     }
   }
 
+  // Wire compression must be uniform too: the ring's hops re-encode with
+  // the negotiated wire dtype, so disagreeing ranks would desync the
+  // byte stream.  Same coordinated-error style as the dtype check.
+  if (error.empty()) {
+    auto wire_name = [](const std::string& w) {
+      return w.empty() ? std::string("fp32") : w;
+    };
+    const std::string& wire0 = requests[0].wire_dtype;
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      if (requests[i].wire_dtype != wire0) {
+        error = "Mismatched wire compression: One rank requested wire "
+                "dtype " + wire_name(wire0) +
+                ", but another rank requested wire dtype " +
+                wire_name(requests[i].wire_dtype) + ".";
+      }
+    }
+  }
+
   RequestType message_type = requests[0].request_type;
   if (error.empty()) {
     for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
@@ -161,6 +179,7 @@ Response MessageTable::ConstructResponse(const std::string& name) {
 
   resp.tensor_names = {name};
   resp.devices = std::move(devices);
+  resp.wire_dtype = requests[0].wire_dtype;
   if (!error.empty()) {
     resp.response_type = ResponseType::ERROR;
     resp.error_message = std::move(error);

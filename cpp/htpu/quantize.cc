@@ -1,0 +1,142 @@
+#include "htpu/quantize.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+#include "htpu/half.h"
+
+namespace htpu {
+
+namespace {
+
+// Per-block absmax scale: maps the block's range onto [-127, 127].  An
+// all-zero (or all-NaN-free zero) block gets scale 1 so dequantization
+// stays exact zeros.
+inline float BlockScale(const float* in, int64_t n) {
+  float absmax = 0.0f;
+  for (int64_t i = 0; i < n; ++i) {
+    float a = std::fabs(in[i]);
+    if (a > absmax) absmax = a;
+  }
+  return absmax > 0.0f ? absmax / 127.0f : 1.0f;
+}
+
+inline int64_t NumBlocks(int64_t n) {
+  return (n + kInt8BlockElems - 1) / kInt8BlockElems;
+}
+
+}  // namespace
+
+int WireDtypeId(const std::string& wire_dtype) {
+  if (wire_dtype.empty() || wire_dtype == "fp32" ||
+      wire_dtype == "float32" || wire_dtype == "none") {
+    return kWireRaw;
+  }
+  if (wire_dtype == "bf16" || wire_dtype == "bfloat16") return kWireBf16;
+  if (wire_dtype == "fp16" || wire_dtype == "float16") return kWireFp16;
+  if (wire_dtype == "int8") return kWireInt8;
+  return -1;
+}
+
+int64_t WireChunkBytes(int wire_id, int64_t n) {
+  switch (wire_id) {
+    case kWireRaw:
+      return n * 4;
+    case kWireBf16:
+    case kWireFp16:
+      return n * 2;
+    case kWireInt8:
+      // fp32 scale header (one per block), then the int8 payload.
+      return NumBlocks(n) * 4 + n;
+    default:
+      return -1;
+  }
+}
+
+int64_t WireSegmentBytes(int wire_id, int64_t n) {
+  int64_t total = 0;
+  for (int64_t off = 0; off < n; off += kSubChunkElems) {
+    total += WireChunkBytes(wire_id, std::min(kSubChunkElems, n - off));
+  }
+  return total;
+}
+
+void EncodeWireChunk(int wire_id, const float* in, int64_t n, char* out) {
+  if (wire_id == kWireBf16) {
+    uint16_t* o = reinterpret_cast<uint16_t*>(out);
+    for (int64_t i = 0; i < n; ++i) o[i] = Float2BfloatBits(in[i]);
+    return;
+  }
+  if (wire_id == kWireFp16) {
+    uint16_t* o = reinterpret_cast<uint16_t*>(out);
+    for (int64_t i = 0; i < n; ++i) o[i] = Float2HalfBits(in[i]);
+    return;
+  }
+  // int8: [n_blocks x fp32 scale][n x int8]
+  const int64_t n_blocks = NumBlocks(n);
+  char* payload = out + n_blocks * 4;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    const int64_t lo = b * kInt8BlockElems;
+    const int64_t len = std::min(kInt8BlockElems, n - lo);
+    float scale = BlockScale(in + lo, len);
+    std::memcpy(out + b * 4, &scale, 4);
+    const float inv = 1.0f / scale;
+    int8_t* q = reinterpret_cast<int8_t*>(payload + lo);
+    for (int64_t i = 0; i < len; ++i) {
+      float v = in[lo + i] * inv;
+      // round-half-away like rintf would under nearbyint ties-to-even is
+      // fine too; clamp guards absmax elements rounding to 127 exactly.
+      v = std::nearbyintf(v);
+      q[i] = int8_t(std::max(-127.0f, std::min(127.0f, v)));
+    }
+  }
+}
+
+void DecodeWireChunkAdd(int wire_id, const char* in, int64_t n, float* acc) {
+  if (wire_id == kWireBf16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(in);
+    for (int64_t i = 0; i < n; ++i) acc[i] += BfloatBits2Float(w[i]);
+    return;
+  }
+  if (wire_id == kWireFp16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(in);
+    for (int64_t i = 0; i < n; ++i) acc[i] += HalfBits2Float(w[i]);
+    return;
+  }
+  const int64_t n_blocks = NumBlocks(n);
+  const char* payload = in + n_blocks * 4;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    const int64_t lo = b * kInt8BlockElems;
+    const int64_t len = std::min(kInt8BlockElems, n - lo);
+    float scale;
+    std::memcpy(&scale, in + b * 4, 4);
+    const int8_t* q = reinterpret_cast<const int8_t*>(payload + lo);
+    for (int64_t i = 0; i < len; ++i) acc[lo + i] += float(q[i]) * scale;
+  }
+}
+
+void DecodeWireChunk(int wire_id, const char* in, int64_t n, float* out) {
+  if (wire_id == kWireBf16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(in);
+    for (int64_t i = 0; i < n; ++i) out[i] = BfloatBits2Float(w[i]);
+    return;
+  }
+  if (wire_id == kWireFp16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(in);
+    for (int64_t i = 0; i < n; ++i) out[i] = HalfBits2Float(w[i]);
+    return;
+  }
+  const int64_t n_blocks = NumBlocks(n);
+  const char* payload = in + n_blocks * 4;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    const int64_t lo = b * kInt8BlockElems;
+    const int64_t len = std::min(kInt8BlockElems, n - lo);
+    float scale;
+    std::memcpy(&scale, in + b * 4, 4);
+    const int8_t* q = reinterpret_cast<const int8_t*>(payload + lo);
+    for (int64_t i = 0; i < len; ++i) out[lo + i] = float(q[i]) * scale;
+  }
+}
+
+}  // namespace htpu

@@ -1,0 +1,68 @@
+// Wire codecs for the compressed ring data plane.
+//
+// The ring allreduce (control.cc) optionally narrows fp32 payloads before
+// they hit the socket: bf16/fp16 truncate-cast (the reference made fp16
+// wire compression a first-class optimizer knob, arXiv 1802.05799 §4), or
+// EQuARX-style per-block int8 absmax quantization (arXiv 2506.17615) with
+// one fp32 scale per kInt8BlockElems elements.  Accumulation always
+// happens in fp32 on the receiver — the wire dtype only shapes what
+// travels between hops.
+//
+// Payloads are framed in sub-chunks of kSubChunkElems fp32 elements
+// (~256 KiB raw) and each sub-chunk's wire image is SELF-CONTAINED (int8
+// scales ride in a header at the front of their own chunk), so a receiver
+// can dequantize chunk k while chunk k+1 is still on the wire.  Chunk
+// boundaries are a pure function of the element count; sender and
+// receiver never exchange sizes.
+#ifndef HTPU_QUANTIZE_H_
+#define HTPU_QUANTIZE_H_
+
+#include <cstdint>
+#include <string>
+
+namespace htpu {
+
+// Wire dtype ids. kWireRaw passes the payload through untouched (any
+// payload dtype); the compressed wires require a float32 payload.
+enum WireId {
+  kWireRaw = 0,
+  kWireBf16 = 1,
+  kWireFp16 = 2,
+  kWireInt8 = 3,
+};
+
+// Elements per int8 quantization block (one fp32 absmax scale each).
+constexpr int64_t kInt8BlockElems = 1024;
+
+// Elements per pipelined sub-chunk: 256 KiB of fp32, a multiple of
+// kInt8BlockElems so blocks never straddle chunks.
+constexpr int64_t kSubChunkElems = 64 * 1024;
+
+// Parse a wire-dtype name ("", "fp32", "bf16", "bfloat16", "fp16",
+// "float16", "int8", ...) to a WireId; -1 on unknown names.
+int WireDtypeId(const std::string& wire_dtype);
+
+// Wire bytes for one self-contained chunk of n fp32 elements
+// (n <= kSubChunkElems).
+int64_t WireChunkBytes(int wire_id, int64_t n);
+
+// Total wire bytes for a segment of n fp32 elements, framed in
+// kSubChunkElems sub-chunks.
+int64_t WireSegmentBytes(int wire_id, int64_t n);
+
+// Encode one chunk of n fp32 elements into its wire image
+// (WireChunkBytes(wire_id, n) bytes).  wire_id must not be kWireRaw.
+void EncodeWireChunk(int wire_id, const float* in, int64_t n, char* out);
+
+// Decode one chunk's wire image and ADD into the fp32 accumulator —
+// the reduce-scatter hop: dequantize + sum (the subsequent send
+// re-encodes, completing EQuARX's dequantize-sum-requantize).
+void DecodeWireChunkAdd(int wire_id, const char* in, int64_t n, float* acc);
+
+// Decode one chunk's wire image, overwriting the fp32 output — the
+// allgather hop's final fp32 materialization.
+void DecodeWireChunk(int wire_id, const char* in, int64_t n, float* out);
+
+}  // namespace htpu
+
+#endif  // HTPU_QUANTIZE_H_

@@ -74,6 +74,7 @@ void SerializeRequest(const Request& r, std::string* out) {
   PutI32(out, r.device);
   PutI32(out, int32_t(r.tensor_shape.size()));
   for (int64_t d : r.tensor_shape) PutI64(out, d);
+  PutStr(out, r.wire_dtype);
 }
 
 bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out) {
@@ -89,6 +90,7 @@ bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out) {
   out->tensor_shape.resize(size_t(ndims));
   for (int i = 0; i < ndims; ++i)
     if (!GetI64(data, len, pos, &out->tensor_shape[size_t(i)])) return false;
+  if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
   return true;
 }
 
@@ -101,6 +103,7 @@ void SerializeResponse(const Response& r, std::string* out) {
   for (int32_t d : r.devices) PutI32(out, d);
   PutI32(out, int32_t(r.tensor_sizes.size()));
   for (int64_t s : r.tensor_sizes) PutI64(out, s);
+  PutStr(out, r.wire_dtype);
 }
 
 bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
@@ -121,6 +124,7 @@ bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
   out->tensor_sizes.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
     if (!GetI64(data, len, pos, &out->tensor_sizes[size_t(i)])) return false;
+  if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
   return true;
 }
 

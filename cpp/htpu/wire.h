@@ -11,9 +11,9 @@
 // vec<T> = i32 count + elements.
 //
 // Request  := rank:i32 type:i32 name:str dtype:str root:i32 device:i32
-//             shape:vec<i64>
+//             shape:vec<i64> wire_dtype:str
 // Response := type:i32 names:vec<str> error:str devices:vec<i32>
-//             sizes:vec<i64>
+//             sizes:vec<i64> wire_dtype:str
 // RequestList  := shutdown:i8 requests:vec<Request>
 // ResponseList := shutdown:i8 responses:vec<Response>
 #ifndef HTPU_WIRE_H_
@@ -40,6 +40,10 @@ struct Request {
   int32_t root_rank = -1;
   int32_t device = -1;
   std::vector<int64_t> tensor_shape;
+  // Requested wire compression for the ring data plane ("" = raw fp32;
+  // "bf16" / "fp16" / "int8" — quantize.h).  Validated across ranks like
+  // tensor_type.
+  std::string wire_dtype;
 };
 
 struct Response {
@@ -49,6 +53,9 @@ struct Response {
   std::vector<int32_t> devices;
   // Allgather: dim0 contribution per rank, indexed by rank.
   std::vector<int64_t> tensor_sizes;
+  // Negotiated wire compression (uniform across ranks by validation);
+  // fusion only merges responses with equal wire dtypes.
+  std::string wire_dtype;
 };
 
 struct RequestList {

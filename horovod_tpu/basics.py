@@ -183,6 +183,16 @@ def controller():
     return _require_init().controller
 
 
+def wire_dtype() -> str:
+    """Effective process-wide default for the cross-process ring's wire
+    compression (``HOROVOD_TPU_WIRE_DTYPE``): "" = raw fp32, or
+    "bf16"/"fp16"/"int8".  Per-call ``allreduce(..., compression=...)``
+    overrides it; all ranks must agree per tensor or negotiation raises a
+    coordinated error."""
+    from horovod_tpu.core import default_wire_dtype
+    return default_wire_dtype()
+
+
 def mpi_threads_supported() -> bool:
     """Parity shim for ``hvd.mpi_threads_supported()``
     (reference ``horovod/common/__init__.py:140-154``).

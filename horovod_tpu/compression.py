@@ -59,9 +59,48 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Per-block absmax int8 quantization (EQuARX-style: 1024-element
+    blocks, fp32 scales — same grid as the host ring's int8 wire,
+    ``cpp/htpu/quantize.cc``).
+
+    On the mesh path the quantized values cannot ride a ``psum`` as raw
+    int8 (sums overflow, and per-block scales don't commute with the
+    reduction), so ``compress`` snaps the tensor onto the int8 grid and
+    returns it **dequantized in bfloat16**: a single sum-safe array that
+    still halves the bytes on the wire.  True 4x int8 bytes-on-wire
+    lives on the cross-process host ring — request it with
+    ``allreduce(..., compression=Compression.int8)`` or process-wide via
+    ``HOROVOD_TPU_WIRE_DTYPE=int8``.
+    """
+
+    block_elems = 1024
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = jnp.result_type(tensor)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            return tensor, None
+        n = tensor.size
+        blocks = -(-n // cls.block_elems)
+        flat = jnp.ravel(tensor).astype(jnp.float32)
+        padded = jnp.pad(flat, (0, blocks * cls.block_elems - n))
+        grid = padded.reshape(blocks, cls.block_elems)
+        absmax = jnp.max(jnp.abs(grid), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(grid / scale), -127, 127)
+        deq = (q * scale).reshape(-1)[:n].reshape(tensor.shape)
+        return deq.astype(jnp.bfloat16), dtype
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
 class Compression:
     """Namespace parity with ``hvd.Compression`` (reference
     ``compression.py:62-75``)."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -126,6 +126,33 @@ def shape_debug_string(shape: Sequence[int]) -> str:
     return "[" + ", ".join(str(d) for d in shape) + "]"
 
 
+# Canonical ring wire-compression names ("" = raw fp32); alias mapping
+# matches WireDtypeId in cpp/htpu/quantize.cc so both sides agree on what
+# a request means before it hits the wire.
+_WIRE_DTYPE_ALIASES = {
+    "": "", "fp32": "", "float32": "", "none": "",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp16": "fp16", "float16": "fp16",
+    "int8": "int8",
+}
+
+
+def normalize_wire_dtype(wire_dtype: str) -> str:
+    """Canonicalize a wire-compression name; raises on unknown names."""
+    key = (wire_dtype or "").strip().lower()
+    if key not in _WIRE_DTYPE_ALIASES:
+        raise ValueError(
+            f"Unknown wire dtype {wire_dtype!r}: expected one of "
+            "fp32/none, bf16, fp16, int8.")
+    return _WIRE_DTYPE_ALIASES[key]
+
+
+def default_wire_dtype() -> str:
+    """Process-wide ring compression default from HOROVOD_TPU_WIRE_DTYPE
+    ("" when unset → raw fp32 wire)."""
+    return normalize_wire_dtype(os.environ.get("HOROVOD_TPU_WIRE_DTYPE", ""))
+
+
 @dataclasses.dataclass
 class Request:
     """One rank's announcement that a named tensor is ready
@@ -137,6 +164,9 @@ class Request:
     tensor_shape: Tuple[int, ...]
     root_rank: int = -1
     device: int = -1                       # global device rank (or -1 host)
+    # Requested ring wire compression ("" = raw fp32; "bf16"/"fp16"/"int8"
+    # — cpp/htpu/quantize.h).  Validated across ranks like tensor_type.
+    wire_dtype: str = ""
 
 
 @dataclasses.dataclass
@@ -150,6 +180,9 @@ class Response:
     # For allgather: dim0 size contributed by each rank, indexed by rank
     # (reference mpi_message.h tensor_sizes).
     tensor_sizes: List[int] = dataclasses.field(default_factory=list)
+    # Negotiated wire compression (uniform across ranks by validation);
+    # fusion only merges responses with equal wire dtypes.
+    wire_dtype: str = ""
 
 
 # --------------------------------------------------------------------------
@@ -221,6 +254,18 @@ class MessageTable:
                 error = (f"Mismatched data types: One rank had type {data_type}, "
                          f"but another rank had type {r.tensor_type}.")
                 break
+
+        # Wire compression must be uniform too: the ring's hops re-encode
+        # with the negotiated wire dtype, so disagreeing ranks would desync
+        # the byte stream.  Same coordinated-error style as the dtype check.
+        if error is None:
+            wire0 = requests[0].wire_dtype
+            for r in requests[1:]:
+                if r.wire_dtype != wire0:
+                    error = ("Mismatched wire compression: One rank requested "
+                             f"wire dtype {wire0 or 'fp32'}, but another rank "
+                             f"requested wire dtype {r.wire_dtype or 'fp32'}.")
+                    break
 
         message_type = requests[0].request_type
         if error is None:
@@ -305,15 +350,19 @@ class MessageTable:
 
         del self._table[name]
 
+        wire_dtype = requests[0].wire_dtype
         if error is not None:
             return Response(ResponseType.ERROR, [name], error_message=error,
-                            devices=devices)
+                            devices=devices, wire_dtype=wire_dtype)
         if message_type == RequestType.ALLGATHER:
             return Response(ResponseType.ALLGATHER, [name],
-                            tensor_sizes=tensor_sizes, devices=devices)
+                            tensor_sizes=tensor_sizes, devices=devices,
+                            wire_dtype=wire_dtype)
         if message_type == RequestType.ALLREDUCE:
-            return Response(ResponseType.ALLREDUCE, [name], devices=devices)
-        return Response(ResponseType.BROADCAST, [name], devices=devices)
+            return Response(ResponseType.ALLREDUCE, [name], devices=devices,
+                            wire_dtype=wire_dtype)
+        return Response(ResponseType.BROADCAST, [name], devices=devices,
+                        wire_dtype=wire_dtype)
 
 
 # --------------------------------------------------------------------------
@@ -353,13 +402,17 @@ def plan_fusion(responses: List[Response],
             nbytes = sum(entry_bytes(n) for n in nxt.tensor_names)
             if entry_dtype(nxt.tensor_names[0]) != dtype:
                 break
+            # A fused buffer rides the ring as one payload with one wire
+            # format — only merge entries that negotiated the same one.
+            if nxt.wire_dtype != r.wire_dtype:
+                break
             if total + nbytes > threshold:
                 break
             names.extend(nxt.tensor_names)
             total += nbytes
             j += 1
         fused.append(Response(ResponseType.ALLREDUCE, names,
-                              devices=r.devices))
+                              devices=r.devices, wire_dtype=r.wire_dtype))
         i = j
     return fused
 
@@ -461,6 +514,9 @@ class TensorTableEntry:
     root_rank: int
     average: bool
     callback: Callable[[Status, object], None]
+    # Ring wire compression for the cross-process data plane ("" = raw
+    # fp32; "bf16"/"fp16"/"int8").  Negotiated across ranks like dtype.
+    wire_dtype: str = ""
 
 
 class Controller:
@@ -722,6 +778,7 @@ class Controller:
                 tensor_shape=tuple(contrib.shape),
                 root_rank=entry.root_rank,
                 device=first_rank + i,
+                wire_dtype=entry.wire_dtype,
             ))
         with self._lock:
             # Shutdown is checked under the same lock stop() takes while

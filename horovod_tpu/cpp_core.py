@@ -83,6 +83,14 @@ def _configure(lib) -> None:
     lib.htpu_control_allreduce.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_allreduce_wire.restype = ctypes.c_int
+    lib.htpu_control_allreduce_wire.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_wire_roundtrip.restype = ctypes.c_longlong
+    lib.htpu_wire_roundtrip.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_void_p]
     lib.htpu_control_allgather.restype = ctypes.c_int
     lib.htpu_control_allgather.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
@@ -241,6 +249,24 @@ def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
     return fused
 
 
+def wire_roundtrip(wire_dtype: str, values):
+    """Encode → decode a float32 array through the ring wire codec
+    (chunked exactly like the data plane); returns ``(decoded, wire_bytes)``.
+    Unit-test hook for the quantizers — no sockets involved."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core not available")
+    arr = np.ascontiguousarray(values, dtype=np.float32)
+    out = np.empty_like(arr)
+    nbytes = lib.htpu_wire_roundtrip(
+        wire_dtype.encode("utf-8"), arr.ctypes.data, arr.size,
+        out.ctypes.data)
+    if nbytes < 0:
+        raise ValueError(f"unknown wire dtype: {wire_dtype!r}")
+    return out, int(nbytes)
+
+
 def _parse_stall_records(data: bytes):
     import struct
     result, pos = [], 0
@@ -294,11 +320,13 @@ class CppControlPlane:
             raise ConnectionError("control-plane tick failed")
         return _take_buffer(self._lib, out, n)
 
-    def allreduce(self, dtype: str, data) -> bytes:
+    def allreduce(self, dtype: str, data, wire_dtype: str = "") -> bytes:
         """Ring-allreduce ``data`` (bytes, or a C-contiguous numpy array —
         arrays are read straight from their buffer, skipping a
         ``tobytes`` copy; the payload path is copy-bound at multi-MB
-        gradients)."""
+        gradients).  ``wire_dtype`` selects the ring wire compression
+        ("" = raw; "bf16"/"fp16"/"int8", float32 payloads only — see
+        cpp/htpu/quantize.h)."""
         import numpy as np
         if isinstance(data, np.ndarray):
             if not data.flags["C_CONTIGUOUS"]:
@@ -307,11 +335,13 @@ class CppControlPlane:
         else:
             ptr, length = data, len(data)
         out = ctypes.c_void_p()
-        n = self._lib.htpu_control_allreduce(
-            self._ptr, dtype.encode("utf-8"), ptr, length,
-            ctypes.byref(out))
+        n = self._lib.htpu_control_allreduce_wire(
+            self._ptr, dtype.encode("utf-8"), wire_dtype.encode("utf-8"),
+            ptr, length, ctypes.byref(out))
         if n < 0:
-            raise ConnectionError("data-plane allreduce failed")
+            raise ConnectionError(
+                "data-plane allreduce failed"
+                + (f" (wire dtype {wire_dtype!r})" if wire_dtype else ""))
         return _take_buffer(self._lib, out, n)
 
     def allgather(self, data: bytes) -> bytes:

@@ -163,7 +163,18 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
             handles.append((vh, ih, leaf.dense_shape))
             ctxs.append(None)
             continue
-        c, ctx = compression.compress(_as_leaf(leaf))
+        arr = _as_leaf(leaf)
+        if jnp.result_type(arr) == jnp.float32:
+            # float32 leaves keep their dtype and compress ON THE WIRE of
+            # the cross-process ring instead (full-precision accumulate,
+            # compressed transfer; also how HOROVOD_TPU_WIRE_DTYPE and
+            # Compression.int8 take effect on the eager path).
+            ctxs.append(None)
+            handles.append(_eager.allreduce_async(
+                arr, average=average, name=f"{name_prefix}.{i}",
+                compression=compression))
+            continue
+        c, ctx = compression.compress(arr)
         ctxs.append(ctx)
         handles.append(_eager.allreduce_async(
             c, average=average, name=f"{name_prefix}.{i}"))

@@ -29,7 +29,8 @@ import numpy as np
 
 from horovod_tpu import basics
 from horovod_tpu.core import (Request, RequestType, Status, TensorTableEntry,
-                              dtype_name)
+                              default_wire_dtype, dtype_name,
+                              normalize_wire_dtype)
 
 
 @dataclasses.dataclass
@@ -79,9 +80,38 @@ def _normalize(tensor, name_prefix: str, name: Optional[str]):
     return vals, (name if name is not None else _auto_name(name_prefix))
 
 
+def _wire_dtype_for(compression, dtype, request_type: RequestType) -> str:
+    """Resolve the ring wire compression for a submission.
+
+    ``compression`` is a :class:`horovod_tpu.compression.Compressor`
+    (class or instance), a wire-dtype string, or ``None`` → the process
+    default (``HOROVOD_TPU_WIRE_DTYPE``).  Compressed wires only apply to
+    float32 allreduces — everything else rides the wire raw (the codecs in
+    cpp/htpu/quantize.cc are fp32-in/fp32-out)."""
+    if request_type != RequestType.ALLREDUCE or np.dtype(dtype) != np.float32:
+        return ""
+    if compression is None:
+        return default_wire_dtype()
+    if isinstance(compression, str):
+        return normalize_wire_dtype(compression)
+    from horovod_tpu import compression as _comp
+    cls = compression if isinstance(compression, type) else type(compression)
+    # NoneCompressor means "no explicit choice" — the env default still
+    # applies; force a raw wire despite the env with compression="none".
+    wire = {_comp.NoneCompressor: default_wire_dtype(),
+            _comp.BF16Compressor: "bf16",
+            _comp.FP16Compressor: "fp16",
+            _comp.Int8Compressor: "int8"}.get(cls)
+    if wire is None:
+        raise ValueError(f"Unknown compression {compression!r}: expected "
+                         "Compression.none/bf16/fp16/int8 or a wire dtype "
+                         "string.")
+    return wire
+
+
 def _submit(request_type: RequestType, tensor, name: Optional[str],
             name_prefix: str, *, average: bool = False,
-            root_rank: int = -1) -> int:
+            root_rank: int = -1, compression=None) -> int:
     ctrl = basics.controller()
     per_rank, resolved = _normalize(tensor, name_prefix, name)
     from horovod_tpu.ops.executor import _needs_host_path
@@ -99,6 +129,8 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
         root_rank=root_rank,
         average=average,
         callback=callback,
+        wire_dtype=_wire_dtype_for(compression, per_rank[0].dtype,
+                                   request_type),
     )
     status = ctrl.enqueue(entry)
     if not status.ok():
@@ -109,16 +141,24 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
 # ------------------------------------------------------------------- public
 
 def allreduce_async(tensor, *, average: bool = True,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, compression=None) -> int:
     """Start an allreduce; returns a handle for ``poll``/``synchronize``
-    (reference ``horovod/torch/mpi_ops.py:86-135``)."""
+    (reference ``horovod/torch/mpi_ops.py:86-135``).
+
+    ``compression`` selects the cross-process ring's wire format
+    (``Compression.bf16``/``Compression.int8``, or a string like
+    ``"int8"``): float32 payloads are compressed per hop on the host
+    ring and materialized back to fp32 — the result dtype is unchanged.
+    Default (``None``) honours ``HOROVOD_TPU_WIRE_DTYPE``; all ranks must
+    agree or negotiation raises a coordinated :class:`CollectiveError`."""
     return _submit(RequestType.ALLREDUCE, tensor, name, "allreduce",
-                   average=average)
+                   average=average, compression=compression)
 
 
 def allreduce(tensor, *, average: bool = True,
-              name: Optional[str] = None):
-    return synchronize(allreduce_async(tensor, average=average, name=name))
+              name: Optional[str] = None, compression=None):
+    return synchronize(allreduce_async(tensor, average=average, name=name,
+                                       compression=compression))
 
 
 def allgather_async(tensor, *, name: Optional[str] = None) -> int:

@@ -540,11 +540,17 @@ class DistributedExecutor(Executor):
             buf = np.asarray(fn(tuple(
                 tuple(e.per_rank[r] for e in entries)
                 for r in range(nlocal))))
+        # The negotiated ring wire compression is uniform across the fused
+        # entries (the planner only merges matching wire dtypes).
+        wire_dtype = getattr(entries[0], "wire_dtype", "")
         if self.timeline:
+            from horovod_tpu.timeline import wire_activity
             self.timeline.activity_end_all(entries)
-            self.timeline.activity_start_all(entries, "TCP_ALLREDUCE")
+            self.timeline.activity_start_all(
+                entries, wire_activity("TCP_ALLREDUCE", wire_dtype))
         reduced = np.frombuffer(
-            self._control.allreduce(str(dtype), np.ascontiguousarray(buf)),
+            self._control.allreduce(str(dtype), np.ascontiguousarray(buf),
+                                    wire_dtype),
             dtype=dtype)
         if self.timeline:
             self.timeline.activity_end_all(entries)

@@ -80,6 +80,53 @@ def _flash_vmem_mb() -> int:
         return default
 
 
+# The fully-unrolled forward's Mosaic stack crosses the default scoped-VMEM
+# budget past T=2048 (measured 44.4 MB at T=4096) — it needs at least this
+# much or it stands down to the unrolled-KV form.
+_FWD_MIN_VMEM_MB = 64
+
+
+def _flash_fwd_vmem_mb() -> int:
+    """VMEM budget (MB) for the fully-unrolled forward at 2048<T.
+
+    ``HOROVOD_TPU_FLASH_FWD_VMEM_MB`` rules when set (the forward's own
+    knob, honored as given).  Otherwise an explicitly set shared
+    ``HOROVOD_TPU_FLASH_VMEM_MB`` rules — but its documented default
+    (32) targets the grouped backward, so pinning that value would stand
+    the forward down as a side effect the user never asked for: warn
+    when that happens (an explicit 0 = compiler default stays silent —
+    that is a deliberate opt-out).  With neither set, auto-grant 64
+    where the hardware backs it."""
+    raw = os.environ.get("HOROVOD_TPU_FLASH_FWD_VMEM_MB")
+    if raw is not None:
+        try:
+            val = int(raw)
+            if val < 0:
+                raise ValueError
+            return val
+        except ValueError:
+            import warnings
+            default = _FWD_MIN_VMEM_MB if _vmem_headroom_ok() else 0
+            warnings.warn(
+                f"HOROVOD_TPU_FLASH_FWD_VMEM_MB={raw!r} is not a "
+                f"non-negative integer; using the default {default}",
+                RuntimeWarning, stacklevel=3)
+            return default
+    if os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB") is None:
+        return _FWD_MIN_VMEM_MB if _vmem_headroom_ok() else 0
+    val = _flash_vmem_mb()
+    if 0 < val < _FWD_MIN_VMEM_MB:
+        import warnings
+        warnings.warn(
+            f"HOROVOD_TPU_FLASH_VMEM_MB={val} is below the "
+            f"{_FWD_MIN_VMEM_MB} MB the fully-unrolled forward needs "
+            "past T=2048, so that form stands down (the unrolled-KV "
+            "form takes over). Set HOROVOD_TPU_FLASH_FWD_VMEM_MB to "
+            "budget the forward separately from the grouped backward.",
+            RuntimeWarning, stacklevel=3)
+    return val
+
+
 # TPU generations with only 16 MB of physical VMEM per core — the raised
 # grouped-kernel budget cannot be backed there, so auto-selection stands
 # down (explicit HOROVOD_TPU_FLASH_BWD_GROUP still applies as given).
@@ -93,7 +140,16 @@ def _vmem_headroom_ok() -> bool:
         return True
     if d.platform != "tpu":
         return True   # CPU/interpret: the limit is not enforced
-    kind = getattr(d, "device_kind", "").lower()
+    try:
+        kind = (d.device_kind or "").lower()
+    except Exception:   # noqa: BLE001 — runtime refused the query
+        kind = ""
+    if not kind:
+        # A TPU whose generation cannot be read could be a v2/v3 with
+        # 16 MB of physical VMEM: fail closed — a stood-down raised
+        # budget costs a slower kernel form, an over-request fails the
+        # whole compile.
+        return False
     return not any(g in kind for g in _SMALL_VMEM_DEVICE_KINDS)
 
 
@@ -459,22 +515,18 @@ def _fwd_packed(q, k, v, H, D, *, scale, causal, block_q, block_k,
     # Mosaic's stack for the unrolled body scales ~T² (f32 s/p
     # temporaries per live block pair): measured ≤16 MB at T=2048 but
     # 44.4 MB at T=4096, which overflows the default scoped-VMEM budget.
-    # Past 2048 the kernel therefore needs a ≥64 MB budget: by default
-    # granted where the hardware backs it (v4+; v2/v3's 16 MB physical
-    # VMEM cannot), and when HOROVOD_TPU_FLASH_VMEM_MB is set
-    # explicitly, the user's figure rules — a value below 64 (including
-    # 0 = compiler default) stands this form down instead of silently
-    # requesting more than asked.  Either way the unrolled-KV form
-    # below takes over when this one is refused.
+    # Past 2048 the kernel therefore needs a raised budget — resolution
+    # order and stand-down semantics live in _flash_fwd_vmem_mb (its
+    # own knob, then the shared one with a warning, then the hardware
+    # auto-grant).  A budget below the floor stands this form down
+    # instead of silently requesting more than asked; the unrolled-KV
+    # form below takes over when this one is refused.
     if T <= 2048:
         _fwd_vmem_mb = 0                 # default budget suffices
         _fwd_ok = True
-    elif os.environ.get("HOROVOD_TPU_FLASH_VMEM_MB") is None:
-        _fwd_vmem_mb = 64 if _vmem_headroom_ok() else 0
-        _fwd_ok = _fwd_vmem_mb > 0
     else:
-        _fwd_vmem_mb = _flash_vmem_mb()
-        _fwd_ok = _fwd_vmem_mb >= 64
+        _fwd_vmem_mb = _flash_fwd_vmem_mb()
+        _fwd_ok = _fwd_vmem_mb >= _FWD_MIN_VMEM_MB
     if (T <= _FULL_UNROLL_MAX_T and T % fb == 0
             and T // fb <= _FULL_UNROLL_MAX_NQ
             and not (interpret and in_vma)

@@ -104,6 +104,17 @@ def _mode_layout(mode: str, n: int, chunk: int):
     if n // k > chunk:
         k = n // _pick_chunk(n, chunk)
     if k > _MAX_UNROLL_CHUNKS:
+        if save:
+            import warnings
+            warnings.warn(
+                f"HOROVOD_TPU_XENT_MODE={mode!r}: the chunk bound "
+                f"({chunk} rows over n={n} tokens) needs {k} unrolled "
+                f"bodies, past the limit of {_MAX_UNROLL_CHUNKS}; "
+                "falling back to the scan recompute schedule — the "
+                "save-logits residual is dropped and the backward "
+                "recomputes the head matmul. Raise the chunk bound or "
+                "use fewer chunks to keep the residual.",
+                RuntimeWarning, stacklevel=3)
         return False, None, min(chunk, n // k)
     return save, k, chunk
 

@@ -17,10 +17,14 @@ from jax import lax
 
 def _to_varying(v, axis: str):
     # jax >= 0.9 spells this lax.pcast(..., to='varying'); pvary is the
-    # deprecated spelling kept as a fallback.
+    # deprecated spelling kept as a fallback.  Versions predating vma
+    # tracking altogether have neither — there the invariant/varying
+    # distinction does not exist and marking is a no-op.
     try:
         return lax.pcast(v, axis, to="varying")
     except (AttributeError, TypeError):
+        if not hasattr(lax, "pvary"):
+            return v
         return lax.pvary(v, axis)
 
 

@@ -27,6 +27,14 @@ import time
 from typing import Dict, List, Optional
 
 
+def wire_activity(base: str, wire_dtype: str) -> str:
+    """Activity name for a data-plane transfer, tagged with the negotiated
+    ring wire compression — ``TCP_ALLREDUCE[int8]`` — so traces show what
+    actually rode the wire.  Raw fp32 transfers keep the bare name (no
+    ``[fp32]`` suffix: pre-compression traces stay comparable)."""
+    return f"{base}[{wire_dtype}]" if wire_dtype else base
+
+
 class Timeline:
     FLUSH_EVERY_S = 1.0   # reference timeline.h:32
 

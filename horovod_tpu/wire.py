@@ -61,6 +61,7 @@ def serialize_request(r: Request) -> bytes:
     out += struct.pack("<i", len(r.tensor_shape))
     for d in r.tensor_shape:
         out += struct.pack("<q", d)
+    _put_str(out, r.wire_dtype)
     return bytes(out)
 
 
@@ -73,9 +74,10 @@ def parse_request(rd: _Reader) -> Request:
     device = rd.i32()
     ndims = rd.i32()
     shape = tuple(rd.i64() for _ in range(ndims))
+    wire_dtype = rd.str_()
     return Request(request_rank=rank, request_type=rtype, tensor_name=name,
                    tensor_type=dtype, tensor_shape=shape, root_rank=root,
-                   device=device)
+                   device=device, wire_dtype=wire_dtype)
 
 
 def serialize_response(r: Response) -> bytes:
@@ -91,6 +93,7 @@ def serialize_response(r: Response) -> bytes:
     out += struct.pack("<i", len(r.tensor_sizes))
     for s in r.tensor_sizes:
         out += struct.pack("<q", s)
+    _put_str(out, r.wire_dtype)
     return bytes(out)
 
 
@@ -100,8 +103,10 @@ def parse_response(rd: _Reader) -> Response:
     error = rd.str_()
     devices = [rd.i32() for _ in range(rd.i32())]
     sizes = [rd.i64() for _ in range(rd.i32())]
+    wire_dtype = rd.str_()
     return Response(response_type=rtype, tensor_names=names,
-                    error_message=error, devices=devices, tensor_sizes=sizes)
+                    error_message=error, devices=devices, tensor_sizes=sizes,
+                    wire_dtype=wire_dtype)
 
 
 def serialize_request_list(requests: List[Request],

@@ -22,10 +22,10 @@ pytestmark = pytest.mark.skipif(
 
 
 def req(rank, rtype=RequestType.ALLREDUCE, name="t", dtype="float32",
-        shape=(4, 2), root=-1):
+        shape=(4, 2), root=-1, wire=""):
     return Request(request_rank=rank, request_type=rtype, tensor_name=name,
                    tensor_type=dtype, tensor_shape=tuple(shape),
-                   root_rank=root, device=rank)
+                   root_rank=root, device=rank, wire_dtype=wire)
 
 
 def both_tables(size):
@@ -51,6 +51,7 @@ def run_both(size, requests):
         assert a.error_message == b.error_message
         assert list(a.devices) == list(b.devices)
         assert list(a.tensor_sizes) == list(b.tensor_sizes)
+        assert a.wire_dtype == b.wire_dtype
     return py_resps
 
 
@@ -215,6 +216,141 @@ class TestFusionParity:
         cpp = cpp_core.cpp_plan_fusion(resps, sizes, dtypes, 1 << 20)
         assert [list(r.tensor_names) for r in py] == \
             [list(r.tensor_names) for r in cpp] == [["a"], ["bc"], ["b"]]
+
+
+class TestWireCompressionNegotiation:
+    def test_wire_dtype_mismatch_coordinated_error(self):
+        resps = run_both(2, [req(0, wire="bf16"), req(1, wire="int8")])
+        assert resps[0].response_type == ResponseType.ERROR
+        assert resps[0].error_message == (
+            "Mismatched wire compression: One rank requested wire dtype "
+            "bf16, but another rank requested wire dtype int8.")
+
+    def test_raw_vs_compressed_mismatch_names_fp32(self):
+        # "" displays as fp32 so the error names both choices readably.
+        resps = run_both(2, [req(0, wire=""), req(1, wire="int8")])
+        assert resps[0].response_type == ResponseType.ERROR
+        assert ("wire dtype fp32" in resps[0].error_message
+                and "wire dtype int8" in resps[0].error_message)
+
+    def test_agreed_wire_dtype_lands_on_response(self):
+        resps = run_both(3, [req(r, wire="int8") for r in range(3)])
+        assert resps[0].response_type == ResponseType.ALLREDUCE
+        assert resps[0].wire_dtype == "int8"
+
+    def test_wire_dtype_rides_the_wire_format(self):
+        r = req(1, wire="bf16")
+        blob = wire.serialize_request_list([r])
+        parsed, _ = wire.parse_request_list(blob)
+        assert parsed[0].wire_dtype == "bf16"
+        resp = Response(ResponseType.ALLREDUCE, ["t"], devices=[0, 1],
+                        wire_dtype="int8")
+        parsed, _ = wire.parse_response_list(
+            wire.serialize_response_list([resp]))
+        assert parsed[0].wire_dtype == "int8"
+
+    def test_fusion_only_merges_matching_wire_dtypes(self):
+        resps = [Response(ResponseType.ALLREDUCE, [n], devices=[0],
+                          wire_dtype=w)
+                 for n, w in (("a", "bf16"), ("b", "bf16"), ("c", ""),
+                              ("d", ""), ("e", "int8"))]
+        sizes = (lambda n: 8)
+        dtypes = (lambda n: "float32")
+        py = plan_fusion(resps, sizes, dtypes, 1 << 20)
+        cpp = cpp_core.cpp_plan_fusion(resps, sizes, dtypes, 1 << 20)
+        want = [["a", "b"], ["c", "d"], ["e"]]
+        assert [list(r.tensor_names) for r in py] == want
+        assert [list(r.tensor_names) for r in cpp] == want
+        assert [r.wire_dtype for r in py] == [r.wire_dtype for r in cpp] \
+            == ["bf16", "", "int8"]
+
+
+class TestWireCodec:
+    """Unit tests for the ring's wire quantizers (cpp/htpu/quantize.cc)
+    through the htpu_wire_roundtrip hook — encode → decode, chunked exactly
+    like the data plane, no sockets."""
+
+    def _payload(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.standard_normal(n) * 10).astype(np.float32)
+
+    def test_raw_is_exact(self):
+        x = self._payload(1000)
+        out, nbytes = cpp_core.wire_roundtrip("", x)
+        np.testing.assert_array_equal(out, x)
+        assert nbytes == x.nbytes
+
+    def test_bf16_halves_bytes(self):
+        x = self._payload(4096)
+        out, nbytes = cpp_core.wire_roundtrip("bf16", x)
+        assert nbytes == x.nbytes // 2
+        # bf16 has 8 mantissa bits: ~2^-8 relative per element.
+        np.testing.assert_allclose(out, x, rtol=2 ** -8, atol=0)
+
+    def test_fp16_halves_bytes(self):
+        x = self._payload(4096)
+        out, nbytes = cpp_core.wire_roundtrip("fp16", x)
+        assert nbytes == x.nbytes // 2
+        np.testing.assert_allclose(out, x, rtol=2 ** -10, atol=1e-3)
+
+    def test_int8_quarter_bytes_with_scale_header(self):
+        n = 8 * 1024
+        x = self._payload(n)
+        out, nbytes = cpp_core.wire_roundtrip("int8", x)
+        # [blocks x fp32 scale][n x int8]: ~0.2510x of fp32.
+        assert nbytes == (n // 1024) * 4 + n
+        assert nbytes / x.nbytes <= 0.30
+        # Per-block absmax grid: error bounded by half a quantization step.
+        assert np.max(np.abs(out - x)) <= np.max(np.abs(x)) / 127.0
+
+    @pytest.mark.parametrize("n", [1, 3, 1023, 1024, 1025, 4097,
+                                   64 * 1024, 64 * 1024 + 7])
+    def test_int8_ragged_sizes(self, n):
+        # Odd block tails and multi-sub-chunk sizes (kSubChunkElems = 64k)
+        # must all decode to the same grid as a whole-array quantization.
+        x = self._payload(n, seed=n)
+        out, nbytes = cpp_core.wire_roundtrip("int8", x)
+        blocks = -(-n // 1024)
+        # Chunked framing: per-chunk headers, chunk = 64k elems.
+        assert nbytes == blocks * 4 + n
+        scale = np.zeros(blocks, np.float32)
+        for b in range(blocks):
+            blk = x[b * 1024:(b + 1) * 1024]
+            m = np.max(np.abs(blk))
+            scale[b] = m / 127.0 if m > 0 else 1.0
+            np.testing.assert_allclose(
+                out[b * 1024:(b + 1) * 1024], blk, atol=scale[b] / 2 + 1e-7)
+
+    def test_int8_all_zero_block_stays_zero(self):
+        x = np.zeros(2048, np.float32)
+        out, _ = cpp_core.wire_roundtrip("int8", x)
+        np.testing.assert_array_equal(out, x)
+
+    def test_unknown_wire_dtype_raises(self):
+        with pytest.raises(ValueError, match="unknown wire dtype"):
+            cpp_core.wire_roundtrip("int4", self._payload(16))
+
+
+class TestNativeBuild:
+    """The test path rebuilds the native core (cpp_core.load() reruns make
+    on import) — verify the build step itself works and produced the
+    symbols this PR added, so a stale prebuilt .so can't pass silently."""
+
+    def test_make_rebuild_and_new_symbols(self):
+        import shutil
+        import subprocess
+        cxx = (os.environ.get("CXX") or shutil.which("c++")
+               or shutil.which("g++"))
+        if cxx is None or shutil.which("make") is None:
+            pytest.skip("no C++ toolchain available")
+        cpp_dir = os.path.join(os.path.dirname(__file__), os.pardir, "cpp")
+        proc = subprocess.run(["make", "-C", cpp_dir], capture_output=True,
+                              text=True, timeout=180)
+        assert proc.returncode == 0, proc.stderr
+        lib = cpp_core.load()
+        assert lib is not None
+        for sym in ("htpu_control_allreduce_wire", "htpu_wire_roundtrip"):
+            assert hasattr(lib, sym), f"rebuilt library missing {sym}"
 
 
 class TestCppTimeline:

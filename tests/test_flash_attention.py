@@ -578,3 +578,73 @@ class TestHeadGroupBwd:
         for g, w in zip(got, want):
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestVmemGates:
+    """Budget-resolution policy for the raised flash VMEM budgets —
+    pure env/probe logic, no kernel launch."""
+
+    class _Dev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self._kind = kind
+
+        @property
+        def device_kind(self):
+            if isinstance(self._kind, Exception):
+                raise self._kind
+            return self._kind
+
+    def _probe(self, monkeypatch, dev):
+        from horovod_tpu.ops import flash_attention as fa
+        monkeypatch.setattr(fa.jax, "local_devices", lambda: [dev])
+        return fa._vmem_headroom_ok()
+
+    def test_headroom_fails_closed_on_unreadable_tpu_kind(self,
+                                                          monkeypatch):
+        """A TPU whose generation cannot be read could be a 16 MB-VMEM
+        v2/v3 — the gate must refuse the raised budget, not fail the
+        compile."""
+        assert not self._probe(monkeypatch, self._Dev("tpu", ""))
+        assert not self._probe(monkeypatch,
+                               self._Dev("tpu", RuntimeError("boom")))
+
+    def test_headroom_reads_kind_when_available(self, monkeypatch):
+        assert not self._probe(monkeypatch, self._Dev("tpu", "TPU v3"))
+        assert self._probe(monkeypatch, self._Dev("tpu", "TPU v4"))
+        assert self._probe(monkeypatch, self._Dev("cpu", ""))
+
+    def test_fwd_budget_own_knob_rules(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_FWD_VMEM_MB", "128")
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_VMEM_MB", "32")
+        assert fa._flash_fwd_vmem_mb() == 128
+
+    def test_fwd_budget_shared_substandard_warns(self, monkeypatch):
+        """Pinning the shared knob to its documented default (32, the
+        grouped-backward figure) stands the fully-unrolled forward down
+        past T=2048 — that side effect must be audible."""
+        from horovod_tpu.ops import flash_attention as fa
+        monkeypatch.delenv("HOROVOD_TPU_FLASH_FWD_VMEM_MB", raising=False)
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_VMEM_MB", "32")
+        with pytest.warns(RuntimeWarning, match="stands down"):
+            assert fa._flash_fwd_vmem_mb() == 32
+
+    def test_fwd_budget_explicit_zero_is_silent(self, monkeypatch):
+        import warnings
+
+        from horovod_tpu.ops import flash_attention as fa
+        monkeypatch.delenv("HOROVOD_TPU_FLASH_FWD_VMEM_MB", raising=False)
+        monkeypatch.setenv("HOROVOD_TPU_FLASH_VMEM_MB", "0")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert fa._flash_fwd_vmem_mb() == 0
+
+    def test_fwd_budget_auto_grant_follows_headroom(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+        monkeypatch.delenv("HOROVOD_TPU_FLASH_FWD_VMEM_MB", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_FLASH_VMEM_MB", raising=False)
+        monkeypatch.setattr(fa, "_vmem_headroom_ok", lambda: True)
+        assert fa._flash_fwd_vmem_mb() == fa._FWD_MIN_VMEM_MB
+        monkeypatch.setattr(fa, "_vmem_headroom_ok", lambda: False)
+        assert fa._flash_fwd_vmem_mb() == 0

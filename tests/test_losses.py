@@ -129,3 +129,36 @@ class TestFusedXent:
             labels.reshape(-1)).mean()
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestModeLayoutDegrade:
+    def test_save_degrade_to_scan_warns(self):
+        """A saveK request whose chunk bound forces more than
+        _MAX_UNROLL_CHUNKS unrolled bodies degrades to the scan
+        recompute schedule — audibly, since the caller opted into
+        keeping the logits residual and is not getting it."""
+        from horovod_tpu.ops import losses
+
+        # n=4096 at chunk=64 needs 64 bodies > _MAX_UNROLL_CHUNKS.
+        with pytest.warns(RuntimeWarning,
+                          match="scan recompute.*residual is dropped"):
+            save, k, scan_chunk = losses._mode_layout("save2", 4096, 64)
+        assert (save, k) == (False, None)
+
+    def test_unroll_degrade_stays_silent(self):
+        """The same degrade from an unrollK mode loses nothing the user
+        asked for (no residual in that mode) — no warning."""
+        import warnings
+
+        from horovod_tpu.ops import losses
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            save, k, scan_chunk = losses._mode_layout("unroll2", 4096, 64)
+        assert (save, k) == (False, None)
+
+    def test_save_within_limit_keeps_residual(self):
+        from horovod_tpu.ops import losses
+
+        save, k, _ = losses._mode_layout("save2", 4096, 2048)
+        assert save and k == 2
