@@ -330,6 +330,18 @@ HTPU_API void htpu_control_set_timeline(void* cp, void* timeline) {
       static_cast<htpu::Timeline*>(timeline));
 }
 
+// Attribution of the most recent failure on this process: writes the
+// offending process's first global rank (-1 = nothing failed) into *rank
+// and the root-cause string into *out (htpu_free it); returns the string
+// length or -1 on allocation failure.
+HTPU_API int htpu_control_last_error(void* cp, int* rank, void** out) {
+  int32_t r = -1;
+  std::string reason;
+  static_cast<htpu::ControlPlane*>(cp)->LastError(&r, &reason);
+  *rank = int(r);
+  return CopyOut(reason, out);
+}
+
 // Coordinator-side stall scan; same length-prefixed record format as
 // htpu_table_stalled.
 HTPU_API int htpu_control_stalled(void* cp, double age_s, void** out) {

@@ -1,14 +1,19 @@
 #include "htpu/control.h"
 
+#include <poll.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <future>
 #include <numeric>
 #include <stdexcept>
+#include <thread>
 
 #include "htpu/fusion.h"
 #include "htpu/quantize.h"
@@ -76,6 +81,19 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
   cp->process_count_ = process_count;
   cp->first_rank_ = first_rank;
   cp->timeout_ms_ = timeout_ms;
+  // Liveness deadline for the coordinator's per-tick gather: the tick
+  // stream itself is the heartbeat (an idle healthy worker still ticks
+  // every cycle), so a worker silent for HOROVOD_TPU_HEARTBEAT_S is dead.
+  // The default is generous because per-process jit compilation can stall
+  // a worker's loop; it never exceeds the overall control timeout.
+  long hb_s = 30;
+  if (const char* e = getenv("HOROVOD_TPU_HEARTBEAT_S")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) hb_s = v;
+  }
+  cp->heartbeat_ms_ = int(std::min<long long>(hb_s * 1000LL, timeout_ms));
+  cp->ParseFaultEnv();
 
   if (process_index == 0) {
     cp->table_.reset(new MessageTable(nranks_total));
@@ -244,6 +262,38 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
 }
 
 ControlPlane::~ControlPlane() {
+  if (aborted_ && is_coordinator()) {
+    // Linger: a worker may still have a request frame in flight toward
+    // us.  If we close() now, that frame hits a dead socket and the
+    // resulting RST destroys the abort broadcast sitting unread in the
+    // worker's receive queue — it would then blame the coordinator
+    // instead of the rank that actually failed.  Half-close our send
+    // side (the abort frame is already flushed) and drain inbound bytes
+    // for a short bounded window so the kernel never emits that RST.
+    std::vector<pollfd> pfds;
+    for (int fd : worker_fds_) {
+      if (fd < 0) continue;
+      shutdown(fd, SHUT_WR);
+      pfds.push_back(pollfd{fd, POLLIN, 0});
+    }
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(250);
+    while (!pfds.empty() && std::chrono::steady_clock::now() < deadline) {
+      if (poll(pfds.data(), nfds_t(pfds.size()), 50) <= 0) continue;
+      for (size_t i = 0; i < pfds.size();) {
+        char sink[4096];
+        ssize_t n = (pfds[i].revents & (POLLIN | POLLHUP | POLLERR))
+                        ? read(pfds[i].fd, sink, sizeof(sink))
+                        : 1;
+        if (n == 0 || (n < 0 && errno != EINTR && errno != EAGAIN)) {
+          pfds.erase(pfds.begin() + long(i));  // peer finished or gone
+        } else {
+          pfds[i].revents = 0;
+          ++i;
+        }
+      }
+    }
+  }
   for (int fd : worker_fds_) CloseFd(fd);
   CloseFd(coord_fd_);
   CloseFd(listen_fd_);
@@ -251,20 +301,174 @@ ControlPlane::~ControlPlane() {
   CloseFd(ring_prev_fd_);
 }
 
+// --------------------------------------------------------------- abort/fault
+
+void ControlPlane::ParseFaultEnv() {
+  // HOROVOD_TPU_FAULT=mode:rank=R:tick=T with mode one of
+  // crash/hang/drop_conn; R matches a process's FIRST global rank.  The
+  // Python side (core.parse_fault_spec) validates strictly and raises on
+  // malformed specs; this independent parse is lenient — a spec the
+  // strict parser rejected can only get here via raw env tampering, and a
+  // fault layer must never take down a healthy job.
+  const char* f = getenv("HOROVOD_TPU_FAULT");
+  if (!f || !*f) return;
+  std::string s(f);
+  size_t c = s.find(':');
+  std::string mode = s.substr(0, c);
+  long long rank = -1, tick = -1;
+  while (c != std::string::npos) {
+    size_t next = s.find(':', c + 1);
+    std::string kv = s.substr(
+        c + 1, next == std::string::npos ? std::string::npos : next - c - 1);
+    if (kv.rfind("rank=", 0) == 0) rank = atoll(kv.c_str() + 5);
+    else if (kv.rfind("tick=", 0) == 0) tick = atoll(kv.c_str() + 5);
+    c = next;
+  }
+  int m = mode == "crash" ? 1 : mode == "hang" ? 2
+          : mode == "drop_conn" ? 3 : 0;
+  if (m && rank >= 0 && tick > 0) {
+    fault_mode_ = m;
+    fault_rank_ = int(rank);
+    fault_tick_ = tick;
+  } else {
+    fprintf(stderr, "htpu control: ignoring malformed HOROVOD_TPU_FAULT=%s "
+            "(want crash|hang|drop_conn:rank=R:tick=T)\n", f);
+  }
+}
+
+void ControlPlane::MaybeInjectFault() {
+  if (!fault_mode_ || fault_rank_ != first_rank_ ||
+      tick_count_ != uint64_t(fault_tick_)) {
+    return;
+  }
+  if (fault_mode_ == 1) {
+    fprintf(stderr, "htpu fault injection: crashing rank %d at tick %llu\n",
+            first_rank_, (unsigned long long)tick_count_);
+    fflush(stderr);
+    _exit(42);
+  }
+  if (fault_mode_ == 2) {
+    fprintf(stderr, "htpu fault injection: hanging rank %d at tick %llu\n",
+            first_rank_, (unsigned long long)tick_count_);
+    fflush(stderr);
+    // Block the tick thread forever with sockets left open: the silent-
+    // worker case only the heartbeat deadline can catch.
+    for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
+  }
+  fprintf(stderr,
+          "htpu fault injection: dropping connections of rank %d at tick "
+          "%llu\n", first_rank_, (unsigned long long)tick_count_);
+  fflush(stderr);
+  fault_mode_ = 0;  // fires once
+  for (int fd : worker_fds_) {
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+  }
+  if (coord_fd_ >= 0) shutdown(coord_fd_, SHUT_RDWR);
+  if (ring_next_fd_ >= 0) shutdown(ring_next_fd_, SHUT_RDWR);
+  if (ring_prev_fd_ >= 0) shutdown(ring_prev_fd_, SHUT_RDWR);
+}
+
+void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
+  if (aborted_) return;   // first cause wins
+  aborted_ = true;
+  abort_rank_ = rank;
+  abort_reason_ = reason;
+}
+
+void ControlPlane::SerializeAbort(std::string* blob) const {
+  ResponseList out;
+  out.abort_rank = abort_rank_;
+  out.abort_reason = abort_reason_;
+  SerializeResponseList(out, blob);
+}
+
+bool ControlPlane::AbortedFailFast() {
+  if (!aborted_) return false;
+  last_error_rank_ = abort_rank_;
+  last_error_ = "job aborted: " + abort_reason_;
+  return true;
+}
+
+bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
+                            size_t send_len, int recv_fd, char* recv_buf,
+                            size_t recv_len) {
+  int failed = -1;
+  if (DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
+                     recv_len, timeout_ms_, &failed)) {
+    return true;
+  }
+  // Attribute to the ring neighbour whose fd died; a plain timeout most
+  // often means upstream stopped feeding us, so default to the recv side.
+  int peer = failed >= 0 ? failed : (recv_fd >= 0 ? recv_fd : send_fd);
+  int next = (process_index_ + 1) % process_count_;
+  int prev = (process_index_ - 1 + process_count_) % process_count_;
+  int32_t rank = -1;
+  if (peer == ring_next_fd_ && size_t(next) < all_first_ranks_.size()) {
+    rank = all_first_ranks_[size_t(next)];
+  } else if (peer == ring_prev_fd_ &&
+             size_t(prev) < all_first_ranks_.size()) {
+    rank = all_first_ranks_[size_t(prev)];
+  }
+  last_error_rank_ = rank >= 0 ? rank : first_rank_;
+  last_error_ =
+      (failed >= 0
+           ? "ring data-plane transfer failed: peer process of rank "
+           : "ring data-plane transfer timed out waiting on rank ") +
+      std::to_string(last_error_rank_) +
+      (failed >= 0 ? " closed the connection or errored" : "");
+  return false;
+}
+
+// --------------------------------------------------------------------- tick
+
 bool ControlPlane::Tick(const std::string& request_list_blob,
                         int64_t fusion_threshold,
                         std::string* response_list_blob) {
+  ++tick_count_;
+  MaybeInjectFault();
+  if (aborted_) {
+    // Latched: every subsequent tick completes instantly with the original
+    // attributed abort so no waiter is stranded and enqueue fails fast.
+    SerializeAbort(response_list_blob);
+    return true;
+  }
+
   if (!is_coordinator()) {
     // Worker: send our request list, wait for the response list.
-    return SendFrame(coord_fd_, request_list_blob) &&
-           RecvFrame(coord_fd_, response_list_blob, timeout_ms_);
+    if (!SendFrame(coord_fd_, request_list_blob) ||
+        !RecvFrame(coord_fd_, response_list_blob, timeout_ms_)) {
+      // Coordinator link gone: synthesize a local abort naming process 0
+      // so waiters get an attributed error, not a generic tick failure.
+      int32_t coord_rank =
+          all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+      LatchAbort(coord_rank,
+                 "lost connection to the coordinator (rank " +
+                     std::to_string(coord_rank) + ", process 0)");
+      SerializeAbort(response_list_blob);
+      return true;
+    }
+    // Latch a broadcast ABORT natively so the data plane fails fast too.
+    ResponseList parsed;
+    if (ParseResponseList(
+            reinterpret_cast<const uint8_t*>(response_list_blob->data()),
+            response_list_blob->size(), &parsed) &&
+        parsed.abort_rank >= 0) {
+      LatchAbort(parsed.abort_rank, parsed.abort_reason);
+    }
+    return true;
   }
 
   // Coordinator: gather lists (own + one frame per worker, any order of
-  // arrival but deterministic processing order by process index).
+  // arrival but deterministic processing order by process index).  The
+  // per-worker deadline is the HEARTBEAT, not the full control timeout:
+  // a healthy worker ticks every cycle even when idle, so silence for
+  // heartbeat_ms_ means the worker crashed (EOF, detected instantly) or
+  // hung.  Either way the job aborts with attribution instead of every
+  // rank timing out separately with no cause.
   bool shutdown = false;
+  int32_t abort_rank = -1;
+  std::string abort_reason;
   std::vector<Request> all_requests;
-  std::unordered_map<std::string, const Request*> shape_info;
 
   auto absorb = [&](const std::string& blob) -> bool {
     RequestList list;
@@ -274,15 +478,40 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       return false;
     }
     shutdown = shutdown || list.shutdown;
+    if (list.abort_rank >= 0 && abort_rank < 0) {
+      // A worker reported a local transport/executor failure.
+      abort_rank = list.abort_rank;
+      abort_reason = list.abort_reason;
+    }
     for (auto& r : list.requests) all_requests.push_back(std::move(r));
     return true;
   };
 
   if (!absorb(request_list_blob)) return false;
-  for (int i = 1; i < process_count_; ++i) {
+  for (int i = 1; i < process_count_ && abort_rank < 0; ++i) {
     std::string blob;
-    if (!RecvFrame(worker_fds_[size_t(i)], &blob, timeout_ms_)) return false;
-    if (!absorb(blob)) return false;
+    if (!RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_) ||
+        !absorb(blob)) {
+      abort_rank = worker_first_rank_[size_t(i)];
+      abort_reason =
+          "rank " + std::to_string(abort_rank) + " (process " +
+          std::to_string(i) + ") missed the " +
+          std::to_string(heartbeat_ms_ / 1000) +
+          "s heartbeat deadline (crashed, hung, or sent a corrupt frame)";
+    }
+  }
+
+  if (abort_rank >= 0) {
+    // Broadcast the ABORT control message (best effort — some links may
+    // already be dead) so every rank raises the same attributed error.
+    LatchAbort(abort_rank, abort_reason);
+    SerializeAbort(response_list_blob);
+    for (int i = 1; i < process_count_; ++i) {
+      if (worker_fds_[size_t(i)] >= 0) {
+        SendFrame(worker_fds_[size_t(i)], *response_list_blob);
+      }
+    }
+    return true;
   }
 
   ResponseList out;
@@ -350,7 +579,20 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
 
   SerializeResponseList(out, response_list_blob);
   for (int i = 1; i < process_count_; ++i) {
-    if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) return false;
+    if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) {
+      // A worker died between its request and our response: abort the job
+      // with attribution instead of failing this tick generically.  Workers
+      // that already got the normal response read the abort next tick.
+      LatchAbort(worker_first_rank_[size_t(i)],
+                 "rank " + std::to_string(worker_first_rank_[size_t(i)]) +
+                     " (process " + std::to_string(i) +
+                     ") dropped its coordinator connection");
+      SerializeAbort(response_list_blob);
+      for (int j = 1; j < process_count_; ++j) {
+        if (j != i) SendFrame(worker_fds_[size_t(j)], *response_list_blob);
+      }
+      return true;
+    }
   }
   return true;
 }
@@ -399,6 +641,7 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
                                 int64_t nbytes,
                                 const std::string& wire_dtype) {
   if (process_count_ == 1) return true;
+  if (AbortedFailFast()) return false;
   const int wire = WireDtypeId(wire_dtype);
   if (wire < 0) return false;
   // Compressed wire formats are defined over fp32 payloads only (the
@@ -489,9 +732,8 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
         drain();
         return false;
       }
-      if (!DuplexTransfer(ring_next_fd_, sptr, size_t(swire),
-                          ring_prev_fd_, rptr, size_t(rwire),
-                          timeout_ms_)) {
+      if (!RingXfer(ring_next_fd_, sptr, size_t(swire),
+                    ring_prev_fd_, rptr, size_t(rwire))) {
         drain();
         return false;
       }
@@ -536,10 +778,8 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       int recv_seg = (r - s + P) % P;
       int64_t sbytes = seg_elems(send_seg) * elem;
       int64_t rbytes = seg_elems(recv_seg) * elem;
-      if (!DuplexTransfer(ring_next_fd_, seg_base(send_seg),
-                          size_t(sbytes), ring_prev_fd_,
-                          seg_base(recv_seg), size_t(rbytes),
-                          timeout_ms_)) {
+      if (!RingXfer(ring_next_fd_, seg_base(send_seg), size_t(sbytes),
+                    ring_prev_fd_, seg_base(recv_seg), size_t(rbytes))) {
         return false;
       }
       data_bytes_sent_ += sbytes;
@@ -596,9 +836,8 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
         drain();
         return false;
       }
-      if (!DuplexTransfer(ring_next_fd_, sw + s_off, size_t(swire),
-                          ring_prev_fd_, rw + r_off, size_t(rwire),
-                          timeout_ms_)) {
+      if (!RingXfer(ring_next_fd_, sw + s_off, size_t(swire),
+                    ring_prev_fd_, rw + r_off, size_t(rwire))) {
         drain();
         return false;
       }
@@ -631,6 +870,7 @@ bool ControlPlane::Allgather(const std::string& in, std::string* out) {
     *out = in;
     return true;
   }
+  if (AbortedFailFast()) return false;
   return RingAllgather(in, out);
 }
 
@@ -650,12 +890,11 @@ bool ControlPlane::RingAllgather(const std::string& in, std::string* out) {
   for (int s = 0; s < P - 1; ++s) {
     int send_idx = (r - s + P) % P;
     int recv_idx = (r - s - 1 + P) % P;
-    if (!DuplexTransfer(
-            ring_next_fd_,
-            reinterpret_cast<const char*>(&recs[size_t(send_idx)]),
-            sizeof(int64_t), ring_prev_fd_,
-            reinterpret_cast<char*>(&recs[size_t(recv_idx)]),
-            sizeof(int64_t), timeout_ms_)) {
+    if (!RingXfer(ring_next_fd_,
+                  reinterpret_cast<const char*>(&recs[size_t(send_idx)]),
+                  sizeof(int64_t), ring_prev_fd_,
+                  reinterpret_cast<char*>(&recs[size_t(recv_idx)]),
+                  sizeof(int64_t))) {
       return false;
     }
     if (recs[size_t(recv_idx)] < 0 ||
@@ -678,10 +917,10 @@ bool ControlPlane::RingAllgather(const std::string& in, std::string* out) {
     int64_t sbytes = int64_t(parts[size_t(send_idx)].size());
     int64_t rbytes = recs[size_t(recv_idx)];
     parts[size_t(recv_idx)].resize(size_t(rbytes));
-    if (!DuplexTransfer(ring_next_fd_, parts[size_t(send_idx)].data(),
-                        size_t(sbytes), ring_prev_fd_,
-                        rbytes ? &parts[size_t(recv_idx)][0] : nullptr,
-                        size_t(rbytes), timeout_ms_)) {
+    if (!RingXfer(ring_next_fd_, parts[size_t(send_idx)].data(),
+                  size_t(sbytes), ring_prev_fd_,
+                  rbytes ? &parts[size_t(recv_idx)][0] : nullptr,
+                  size_t(rbytes))) {
       return false;
     }
     data_bytes_sent_ += sbytes;
@@ -705,6 +944,7 @@ bool ControlPlane::Broadcast(int root_process, const std::string& in,
     *out = in;
     return true;
   }
+  if (AbortedFailFast()) return false;
   return RingBroadcast(root_process, in, out);
 }
 
@@ -724,9 +964,8 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
   // Size header travels the chain first.
   uint64_t nbytes = is_root ? in.size() : 0;
   if (!is_root) {
-    if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_,
-                        reinterpret_cast<char*>(&nbytes), sizeof(nbytes),
-                        timeout_ms_)) {
+    if (!RingXfer(-1, nullptr, 0, ring_prev_fd_,
+                  reinterpret_cast<char*>(&nbytes), sizeof(nbytes))) {
       return false;
     }
     // A desynced ring stream (earlier transfer failed mid-flight) yields a
@@ -742,9 +981,8 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
     }
   }
   if (!is_last) {
-    if (!DuplexTransfer(ring_next_fd_,
-                        reinterpret_cast<const char*>(&nbytes),
-                        sizeof(nbytes), -1, nullptr, 0, timeout_ms_)) {
+    if (!RingXfer(ring_next_fd_, reinterpret_cast<const char*>(&nbytes),
+                  sizeof(nbytes), -1, nullptr, 0)) {
       return false;
     }
   }
@@ -764,39 +1002,38 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
 
   if (is_root) {
     for (int64_t k = 0; k < n_chunks; ++k) {
-      if (!DuplexTransfer(ring_next_fd_, chunk_ptr(k), size_t(chunk_len(k)),
-                          -1, nullptr, 0, timeout_ms_)) {
+      if (!RingXfer(ring_next_fd_, chunk_ptr(k), size_t(chunk_len(k)),
+                    -1, nullptr, 0)) {
         return false;
       }
       data_bytes_sent_ += chunk_len(k);
     }
   } else if (is_last) {
     for (int64_t k = 0; k < n_chunks; ++k) {
-      if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(k),
-                          size_t(chunk_len(k)), timeout_ms_)) {
+      if (!RingXfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(k),
+                    size_t(chunk_len(k)))) {
         return false;
       }
       data_bytes_recv_ += chunk_len(k);
     }
   } else {
     // Middle of the chain: receive chunk k while forwarding chunk k-1.
-    if (!DuplexTransfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(0),
-                        size_t(chunk_len(0)), timeout_ms_)) {
+    if (!RingXfer(-1, nullptr, 0, ring_prev_fd_, chunk_ptr(0),
+                  size_t(chunk_len(0)))) {
       return false;
     }
     data_bytes_recv_ += chunk_len(0);
     for (int64_t k = 1; k < n_chunks; ++k) {
-      if (!DuplexTransfer(ring_next_fd_, chunk_ptr(k - 1),
-                          size_t(chunk_len(k - 1)), ring_prev_fd_,
-                          chunk_ptr(k), size_t(chunk_len(k)), timeout_ms_)) {
+      if (!RingXfer(ring_next_fd_, chunk_ptr(k - 1),
+                    size_t(chunk_len(k - 1)), ring_prev_fd_,
+                    chunk_ptr(k), size_t(chunk_len(k)))) {
         return false;
       }
       data_bytes_sent_ += chunk_len(k - 1);
       data_bytes_recv_ += chunk_len(k);
     }
-    if (!DuplexTransfer(ring_next_fd_, chunk_ptr(n_chunks - 1),
-                        size_t(chunk_len(n_chunks - 1)), -1, nullptr, 0,
-                        timeout_ms_)) {
+    if (!RingXfer(ring_next_fd_, chunk_ptr(n_chunks - 1),
+                  size_t(chunk_len(n_chunks - 1)), -1, nullptr, 0)) {
       return false;
     }
     data_bytes_sent_ += chunk_len(n_chunks - 1);

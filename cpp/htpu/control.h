@@ -78,6 +78,20 @@ class ControlPlane {
 
   int process_count() const { return process_count_; }
 
+  // True once a job-wide abort is latched (coordinator-broadcast ABORT,
+  // lost coordinator link, or an injected fault).  After this, Tick
+  // returns the latched abort response and the data plane fails fast.
+  bool aborted() const { return aborted_; }
+
+  // Attribution of the most recent failure on this process: the first
+  // global rank of the offending process (ring-neighbour mapping of the
+  // fd that died, or the latched abort's rank), or -1 when nothing has
+  // failed.  Read by the Python executor to build its abort report.
+  void LastError(int32_t* rank, std::string* reason) const {
+    *rank = last_error_rank_;
+    *reason = last_error_;
+  }
+
   // Transport the ring-next hop rides: "uds" (co-located peer, on-host
   // fast path), "tcp", or "none" (single process).
   const char* ring_transport() const { return ring_transport_; }
@@ -117,10 +131,44 @@ class ControlPlane {
   bool RingBroadcast(int root_process, const std::string& in,
                      std::string* out);
 
+  // Failure-detection / abort machinery (all called from the tick thread;
+  // the data plane runs on the same background thread, so no locking).
+  void ParseFaultEnv();
+  void MaybeInjectFault();
+  void LatchAbort(int32_t rank, const std::string& reason);
+  void SerializeAbort(std::string* blob) const;
+  // True (and records the abort as last_error) when the plane is aborted —
+  // the data-plane entry points fail fast instead of touching dead sockets.
+  bool AbortedFailFast();
+  // DuplexTransfer wrapper that attributes a failure to the ring
+  // neighbour whose fd died (recorded in last_error_*).
+  bool RingXfer(int send_fd, const char* send_buf, size_t send_len,
+                int recv_fd, char* recv_buf, size_t recv_len);
+
   int process_index_ = 0;
   int process_count_ = 0;
   int first_rank_ = 0;
   int timeout_ms_ = 60000;
+
+  // Liveness: the background loop ticks continuously even when idle, so
+  // the tick stream doubles as the heartbeat.  The coordinator's per-worker
+  // gather deadline is heartbeat_ms_ (HOROVOD_TPU_HEARTBEAT_S, clamped to
+  // timeout_ms_) — a worker silent for that long is declared dead.
+  int heartbeat_ms_ = 30000;
+  uint64_t tick_count_ = 0;
+
+  // Fault injection (HOROVOD_TPU_FAULT=mode:rank=R:tick=T, matched
+  // against first_rank_): 0 = none, 1 = crash, 2 = hang, 3 = drop_conn.
+  int fault_mode_ = 0;
+  int fault_rank_ = -1;
+  long long fault_tick_ = -1;
+
+  // Latched job-wide abort + last-failure attribution.
+  bool aborted_ = false;
+  int32_t abort_rank_ = -1;
+  std::string abort_reason_;
+  int32_t last_error_rank_ = -1;
+  std::string last_error_;
 
   // Coordinator: connection fd per worker process (index 1..n-1), ordered
   // by process index; worker: single fd to the coordinator.  Carries

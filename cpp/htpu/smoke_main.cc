@@ -1,0 +1,152 @@
+// Standalone multi-process smoke runner for the native control plane.
+//
+// Built by `make asan` with -fsanitize=address,undefined and run by the
+// slow test in tests/test_asan.py: forks three processes that form a
+// ControlPlane on localhost and exercise, under the sanitizers, exactly
+// the code paths the Python stack drives — ring bootstrap, idle
+// negotiation ticks, the ring data plane in every wire format (raw fp32,
+// bf16, int8), allgather, broadcast, and finally the abort path (process
+// 1 exits without shutdown; the survivors must latch an abort attributed
+// to rank 1 and fail data-plane calls fast).
+//
+// NOT part of the shared library (it has a main()); keep it out of SRCS.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "htpu/control.h"
+#include "htpu/wire.h"
+
+namespace {
+
+constexpr int kProcs = 3;
+
+int FreePort() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  socklen_t len = sizeof(addr);
+  int port = -1;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0 &&
+      getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
+    port = ntohs(addr.sin_port);
+  }
+  close(fd);
+  return port;
+}
+
+int Fail(int pidx, const char* what) {
+  fprintf(stderr, "smoke proc %d: FAILED: %s\n", pidx, what);
+  return 1;
+}
+
+int RunProcess(int pidx, int port) {
+  auto cp = htpu::ControlPlane::Create(pidx, kProcs, "127.0.0.1", port,
+                                       /*first_rank=*/pidx,
+                                       /*nranks_total=*/kProcs,
+                                       /*timeout_ms=*/20000);
+  if (!cp) return Fail(pidx, "ControlPlane::Create");
+
+  htpu::RequestList idle;
+  std::string tick_blob, resp;
+  htpu::SerializeRequestList(idle, &tick_blob);
+  for (int i = 0; i < 3; ++i) {
+    if (!cp->Tick(tick_blob, 0, &resp)) return Fail(pidx, "idle tick");
+  }
+
+  // Ring allreduce in each wire format.  Every process contributes
+  // (pidx + 1) everywhere, so each element must sum to 1 + 2 + 3 = 6
+  // (int8's range-scaled quantization is exact on a constant buffer).
+  for (const char* wd : {"", "bf16", "int8"}) {
+    std::vector<float> buf(1024, float(pidx + 1));
+    if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                          int64_t(buf.size() * sizeof(float)), wd)) {
+      return Fail(pidx, "AllreduceBuf");
+    }
+    for (float v : buf) {
+      if (std::fabs(v - 6.0f) > 0.1f) return Fail(pidx, "allreduce value");
+    }
+  }
+
+  std::string mine(8, char('a' + pidx)), gathered;
+  if (!cp->Allgather(mine, &gathered)) return Fail(pidx, "Allgather");
+  if (gathered != std::string(8, 'a') + std::string(8, 'b') +
+                      std::string(8, 'c')) {
+    return Fail(pidx, "allgather value");
+  }
+
+  std::string bcast_in = pidx == 0 ? "payload" : "", bcast_out;
+  if (!cp->Broadcast(0, bcast_in, &bcast_out)) return Fail(pidx, "Broadcast");
+  if (bcast_out != "payload") return Fail(pidx, "broadcast value");
+
+  // Abort path: process 1 dies without shutdown; survivors keep ticking
+  // until the coordinator's gather hits EOF and the abort propagates.
+  if (pidx == 1) {
+    fflush(nullptr);
+    _exit(0);
+  }
+  for (int i = 0; i < 2000 && !cp->aborted(); ++i) {
+    cp->Tick(tick_blob, 0, &resp);
+  }
+  if (!cp->aborted()) return Fail(pidx, "abort never latched");
+
+  // Data plane must now fail fast with the attributed cause.
+  std::string dead_out;
+  if (cp->Allgather(mine, &dead_out)) return Fail(pidx, "post-abort gather");
+  int32_t rank = -1;
+  std::string reason;
+  cp->LastError(&rank, &reason);
+  if (rank != 1) {
+    fprintf(stderr, "smoke proc %d: got rank=%d reason=%s\n", pidx, rank,
+            reason.c_str());
+    return Fail(pidx, "abort attributed to wrong rank");
+  }
+  if (reason.find("job aborted") == std::string::npos) {
+    return Fail(pidx, "abort reason missing");
+  }
+  fprintf(stderr, "smoke proc %d: abort latched: rank %d: %s\n", pidx, rank,
+          reason.c_str());
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  int port = FreePort();
+  if (port < 0) {
+    fprintf(stderr, "smoke: no free port\n");
+    return 1;
+  }
+  pid_t pids[kProcs];
+  for (int p = 0; p < kProcs; ++p) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (pid == 0) _exit(RunProcess(p, port));
+    pids[p] = pid;
+  }
+  int rc = 0;
+  for (int p = 0; p < kProcs; ++p) {
+    int st = 0;
+    waitpid(pids[p], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr, "smoke: proc %d exited abnormally (status %d)\n", p, st);
+      rc = 1;
+    }
+  }
+  if (rc == 0) fprintf(stderr, "smoke: OK\n");
+  return rc;
+}

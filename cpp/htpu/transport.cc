@@ -40,6 +40,25 @@ bool SendAll(int fd, const void* data, size_t len) {
   return true;
 }
 
+// Robustness options applied to every connected control/ring socket:
+// TCP_NODELAY keeps the per-tick control frames from batching behind
+// Nagle, SO_KEEPALIVE lets the kernel notice a silently vanished peer
+// (host power loss, network partition) even while the plane is idle
+// between collectives.  Both are no-ops (EOPNOTSUPP/ignored) on AF_UNIX.
+void ConfigureConnectedSocket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+#if defined(TCP_KEEPIDLE) && defined(TCP_KEEPINTVL) && defined(TCP_KEEPCNT)
+  // Default kernel keepalive (2h idle) is useless for fast failure
+  // detection; probe after 15s idle, every 5s, give up after 3 misses.
+  int idle = 15, intvl = 5, cnt = 3;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+#endif
+}
+
 bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
   char* p = static_cast<char*>(data);
   while (len > 0) {
@@ -70,8 +89,7 @@ int DialRetry(const std::string& host, int port, int timeout_ms) {
       if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) == 1 &&
           connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
                   sizeof(addr)) == 0) {
-        int one = 1;
-        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        ConfigureConnectedSocket(fd);
         return fd;
       }
       close(fd);
@@ -107,11 +125,7 @@ int Listen(int port, int* out_port) {
 int AcceptOne(int listen_fd, int timeout_ms) {
   if (!WaitReadable(listen_fd, timeout_ms)) return -1;
   int fd = accept(listen_fd, nullptr, nullptr);
-  if (fd >= 0) {
-    int one = 1;
-    // No-op (EOPNOTSUPP) on non-TCP sockets such as AF_UNIX.
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  }
+  if (fd >= 0) ConfigureConnectedSocket(fd);
   return fd;
 }
 
@@ -145,6 +159,7 @@ int DialUnixRetry(const std::string& path, int timeout_ms) {
       std::memcpy(addr.sun_path, path.c_str(), path.size());
       if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
                   sizeof(addr)) == 0) {
+        ConfigureConnectedSocket(fd);
         return fd;
       }
       int err = errno;
@@ -214,8 +229,9 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
 
 bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
                     int recv_fd, char* recv_buf, size_t recv_len,
-                    int timeout_ms) {
+                    int timeout_ms, int* failed_fd) {
   constexpr size_t kSliceBytes = 1 << 20;
+  if (failed_fd) *failed_fd = -1;
   size_t sent = 0, rcvd = 0;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -244,14 +260,20 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
       return false;
     }
     if (pr == 0) return false;  // timeout
-    if (send_slot >= 0 && (fds[send_slot].revents & (POLLOUT | POLLERR))) {
+    // POLLHUP on the send side is peer death: without it a hung-up
+    // downstream neighbour left this loop busy-polling until the timeout
+    // instead of failing the step the moment the kernel knew.
+    if (send_slot >= 0 &&
+        (fds[send_slot].revents & (POLLOUT | POLLERR | POLLHUP))) {
       size_t want = send_len - sent;
       if (want > kSliceBytes) want = kSliceBytes;
       ssize_t n = send(send_fd, send_buf + sent, want,
                        MSG_NOSIGNAL | MSG_DONTWAIT);
       if (n < 0) {
-        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
+          if (failed_fd) *failed_fd = send_fd;
           return false;
+        }
       } else {
         sent += size_t(n);
       }
@@ -261,9 +283,12 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
       ssize_t n =
           recv(recv_fd, recv_buf + rcvd, recv_len - rcvd, MSG_DONTWAIT);
       if (n < 0) {
-        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+        if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
+          if (failed_fd) *failed_fd = recv_fd;
           return false;
+        }
       } else if (n == 0) {
+        if (failed_fd) *failed_fd = recv_fd;
         return false;  // peer closed mid-transfer
       } else {
         rcvd += size_t(n);

@@ -67,9 +67,12 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms);
 // while receiving another from upstream, and blocking send()s around a
 // cycle of processes would deadlock once payloads exceed kernel socket
 // buffers.  Either length may be 0 (pass fd -1 for an unused direction).
+// On failure, `failed_fd` (optional) receives the fd whose peer died or
+// errored (-1 for a plain timeout) so the caller can attribute the
+// failure to a ring neighbour.
 bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
                     int recv_fd, char* recv_buf, size_t recv_len,
-                    int timeout_ms);
+                    int timeout_ms, int* failed_fd = nullptr);
 
 // Local (own-side) IPv4 address of a connected socket — the address this
 // host uses on the route to the peer; empty string on failure.

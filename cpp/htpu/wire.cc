@@ -129,7 +129,13 @@ bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
 }
 
 void SerializeRequestList(const RequestList& l, std::string* out) {
+  // A list is always a whole frame: replace, never append, so callers can
+  // reuse one buffer across ticks (the inner Serialize{Request,Response}
+  // helpers stay append-style).
+  out->clear();
   PutI8(out, l.shutdown ? 1 : 0);
+  PutI32(out, l.abort_rank);
+  PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.requests.size()));
   for (const auto& r : l.requests) SerializeRequest(r, out);
 }
@@ -140,6 +146,8 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   int32_t n;
   if (!GetI8(data, len, &pos, &shutdown)) return false;
   out->shutdown = shutdown != 0;
+  if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
+  if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->requests.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
@@ -148,7 +156,10 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
 }
 
 void SerializeResponseList(const ResponseList& l, std::string* out) {
+  out->clear();  // whole frame — see SerializeRequestList
   PutI8(out, l.shutdown ? 1 : 0);
+  PutI32(out, l.abort_rank);
+  PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.responses.size()));
   for (const auto& r : l.responses) SerializeResponse(r, out);
 }
@@ -159,6 +170,8 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   int32_t n;
   if (!GetI8(data, len, &pos, &shutdown)) return false;
   out->shutdown = shutdown != 0;
+  if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
+  if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->responses.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)

@@ -14,8 +14,16 @@
 //             shape:vec<i64> wire_dtype:str
 // Response := type:i32 names:vec<str> error:str devices:vec<i32>
 //             sizes:vec<i64> wire_dtype:str
-// RequestList  := shutdown:i8 requests:vec<Request>
-// ResponseList := shutdown:i8 responses:vec<Response>
+// RequestList  := shutdown:i8 abort_rank:i32 abort_reason:str
+//                 requests:vec<Request>
+// ResponseList := shutdown:i8 abort_rank:i32 abort_reason:str
+//                 responses:vec<Response>
+//
+// abort_rank = -1 means "no abort".  A worker sets it in its RequestList to
+// report a local transport/executor failure; the coordinator sets it in the
+// broadcast ResponseList (ABORT control message) so every rank latches the
+// same attributed error — the wire-level half of Horovod's coordinated
+// shutdown story.
 #ifndef HTPU_WIRE_H_
 #define HTPU_WIRE_H_
 
@@ -60,11 +68,20 @@ struct Response {
 
 struct RequestList {
   bool shutdown = false;
+  // Worker-reported failure: the first global rank of the failing process
+  // (-1 = none) and a root-cause string, relayed to the coordinator on the
+  // next tick so it can broadcast a job-wide ABORT.
+  int32_t abort_rank = -1;
+  std::string abort_reason;
   std::vector<Request> requests;
 };
 
 struct ResponseList {
   bool shutdown = false;
+  // Coordinator-broadcast ABORT: failed rank (-1 = none) + root cause.
+  // Every receiver latches this and fails identically.
+  int32_t abort_rank = -1;
+  std::string abort_reason;
   std::vector<Response> responses;
 };
 

@@ -67,7 +67,7 @@ from horovod_tpu.basics import (           # noqa: F401
 from horovod_tpu.ops.eager import (        # noqa: F401
     allreduce, allreduce_async, allgather, allgather_async, broadcast,
     broadcast_async, poll, synchronize, PerRank, scatter_ranks,
-    CollectiveError,
+    CollectiveError, HorovodAbortedError,
 )
 from horovod_tpu.ops import injit          # noqa: F401
 from horovod_tpu.ops.injit import (        # noqa: F401

@@ -88,6 +88,63 @@ SHUT_DOWN_ERROR = Status.aborted(
 
 
 # --------------------------------------------------------------------------
+# Fault injection (HOROVOD_TPU_FAULT) — test-only failure triggers
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Parsed HOROVOD_TPU_FAULT=<mode>:rank=<R>:tick=<T> spec.
+
+    The native core parses the same env var itself (control.cc) and fires
+    the fault on the tick thread; this Python-side parse exists to reject
+    malformed specs loudly at init() instead of silently never firing.
+    """
+    mode: str      # "crash" | "hang" | "drop_conn"
+    rank: int      # first global rank of the target process
+    tick: int      # 1-based negotiation tick on which the fault fires
+
+
+_FAULT_MODES = ("crash", "hang", "drop_conn")
+
+
+def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
+    """Strictly parse a fault spec; None for empty, ValueError on malformed."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) != 3 or parts[0] not in _FAULT_MODES:
+        raise ValueError(
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+            "'<crash|hang|drop_conn>:rank=<R>:tick=<T>'.")
+    kv = {}
+    for part in parts[1:]:
+        key, sep, val = part.partition("=")
+        if not sep or key not in ("rank", "tick") or key in kv:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+                "'<crash|hang|drop_conn>:rank=<R>:tick=<T>'.")
+        try:
+            kv[key] = int(val)
+        except ValueError:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: {key!r} must be an "
+                f"integer, got {val!r}.") from None
+    if "rank" not in kv or "tick" not in kv:
+        raise ValueError(
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: both rank= and tick= "
+            "are required.")
+    if kv["rank"] < 0:
+        raise ValueError(
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: rank must be >= 0.")
+    if kv["tick"] <= 0:
+        raise ValueError(
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
+            "(ticks are counted from 1).")
+    return FaultSpec(parts[0], kv["rank"], kv["tick"])
+
+
+# --------------------------------------------------------------------------
 # Wire message equivalents (reference horovod/common/mpi_message.{h,cc})
 # --------------------------------------------------------------------------
 
@@ -421,6 +478,17 @@ def plan_fusion(responses: List[Response],
 # Handle manager (reference horovod/torch/handle_manager.{h,cc})
 # --------------------------------------------------------------------------
 
+DEFAULT_OP_TIMEOUT_S = 600.0
+
+
+def default_op_timeout() -> Optional[float]:
+    """Deadline for HandleManager.wait when the caller passes no timeout
+    (HOROVOD_TPU_OP_TIMEOUT_S; <= 0 restores the old infinite wait)."""
+    t = float(os.environ.get("HOROVOD_TPU_OP_TIMEOUT_S",
+                             str(DEFAULT_OP_TIMEOUT_S)))
+    return t if t > 0 else None
+
+
 class HandleManager:
     """Thread-safe int-handle → Status map for async ops."""
 
@@ -433,14 +501,18 @@ class HandleManager:
         # runtime (everything except host-path 64-bit dtypes) — the set
         # the ordering guard counts.
         self._mesh_hazard: set = set()
+        # Op name per live handle, for wait-timeout diagnostics.
+        self._names: Dict[int, str] = {}
 
-    def allocate(self, mesh_hazard: bool = False) -> int:
+    def allocate(self, mesh_hazard: bool = False, name: str = "") -> int:
         with self._lock:
             h = self._next
             self._next += 1
             self._results[h] = None
             if mesh_hazard:
                 self._mesh_hazard.add(h)
+            if name:
+                self._names[h] = name
             return h
 
     def mark_done(self, handle: int, status: Status, result=None) -> None:
@@ -458,6 +530,7 @@ class HandleManager:
         with self._lock:
             self._results.pop(handle, None)
             self._mesh_hazard.discard(handle)
+            self._names.pop(handle, None)
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -465,17 +538,45 @@ class HandleManager:
             return self._results[handle] is not None
 
     def wait(self, handle: int, timeout: Optional[float] = None):
+        """Block until the handle completes.
+
+        ``timeout=None`` no longer means "wait forever": it resolves to the
+        HOROVOD_TPU_OP_TIMEOUT_S deadline (default 600 s; <= 0 restores the
+        infinite wait).  On that default deadline the handle is ABANDONED
+        (a late completion is discarded by mark_done's unknown-handle
+        no-op) and a TimeoutError naming the op is raised — a wedged
+        collective surfaces as a diagnosable error instead of a silent
+        hang.  An explicit caller-supplied timeout keeps the old contract:
+        TimeoutError without abandoning, so the caller decides.
+        """
+        abandon_on_timeout = False
+        if timeout is None:
+            timeout = default_op_timeout()
+            abandon_on_timeout = timeout is not None
         with self._cv:
             self._check_known(handle)
             if not self._cv.wait_for(
                     lambda: self._results[handle] is not None, timeout):
-                raise TimeoutError(f"handle {handle} did not complete")
+                name = self._names.get(handle, "")
+                op = f" (op '{name}')" if name else ""
+                if abandon_on_timeout:
+                    self._results.pop(handle, None)
+                    self._mesh_hazard.discard(handle)
+                    self._names.pop(handle, None)
+                    raise TimeoutError(
+                        f"handle {handle}{op} did not complete within "
+                        f"{timeout:.0f}s (HOROVOD_TPU_OP_TIMEOUT_S); the "
+                        "handle has been abandoned. A peer rank likely "
+                        "never submitted this collective — check for "
+                        "stall warnings on rank 0.")
+                raise TimeoutError(f"handle {handle}{op} did not complete")
             return self._results[handle]
 
     def release(self, handle: int):
         with self._lock:
             self._results.pop(handle, None)
             self._mesh_hazard.discard(handle)
+            self._names.pop(handle, None)
 
     def outstanding(self) -> int:
         """Handles allocated but not yet completed (still in flight)."""
@@ -541,6 +642,11 @@ class Controller:
         self.stall_warning_time_s = 60.0
         self.stall_check_disabled = env_flag(
             "HOROVOD_TPU_STALL_CHECK_DISABLE")
+
+        # Fail fast on malformed fault specs: the native core parses the
+        # same variable leniently (warn + ignore), which would make a typo'd
+        # injection test silently pass.
+        parse_fault_spec(os.environ.get("HOROVOD_TPU_FAULT", ""))
 
         # Native core (cpp/htpu): message table, fusion planner and timeline
         # run in C++ when the shared library is available; the Python classes
@@ -680,6 +786,15 @@ class Controller:
         self._shutdown = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_stall_check = time.monotonic()
+        # Job-wide abort latch.  Once set, every outstanding handle has
+        # completed with this ABORTED status and enqueue() fails fast with
+        # the same attributed cause (no new work can strand a waiter).
+        self._abort_status: Optional[Status] = None
+        # Failure observed locally (native data-plane error) waiting to ride
+        # the next tick's request list to the coordinator, which turns it
+        # into the job-wide ABORT broadcast.
+        self._pending_report: Optional[Tuple[int, str]] = None
+        self._last_reported: Optional[Tuple[int, str]] = None
 
         if self._control is not None:
             from horovod_tpu.ops.executor import DistributedExecutor
@@ -781,6 +896,11 @@ class Controller:
                 wire_dtype=entry.wire_dtype,
             ))
         with self._lock:
+            # Abort outranks plain shutdown: after a job-wide abort every
+            # enqueue fails fast with the ORIGINAL attributed cause, not the
+            # generic shut-down text.
+            if self._abort_status is not None:
+                return self._abort_status
             # Shutdown is checked under the same lock stop() takes while
             # draining, so an entry can never land in a dead controller.
             if self._shutdown.is_set():
@@ -837,14 +957,25 @@ class Controller:
 
     def _run_loop_once_distributed(self, shutting: bool) -> bool:
         """One negotiation tick over the TCP control plane; returns True if
-        the coordinator announced job shutdown."""
+        the coordinator announced job shutdown (or the job aborted)."""
         from horovod_tpu import wire
         with self._lock:
             pending = list(self._message_queue)
             self._message_queue.clear()
-        blob = wire.serialize_request_list(pending, shutdown=shutting)
+            report = self._pending_report
+            self._pending_report = None
+        abort_rank, abort_reason = report if report is not None else (-1, "")
+        blob = wire.serialize_request_list(
+            pending, shutdown=shutting,
+            abort_rank=abort_rank, abort_reason=abort_reason)
         resp_blob = self._control.tick(blob, self.fusion_threshold)
-        responses, remote_shutdown = wire.parse_response_list(resp_blob)
+        responses, remote_shutdown, abort = wire.parse_response_list(resp_blob)
+        if abort is not None:
+            # Coordinator-broadcast ABORT (or a locally synthesized one when
+            # the coordinator link itself died).  Latch, fail everything
+            # with the attributed cause, and leave the tick loop.
+            self._handle_abort(*abort)
+            return True
         ready = []
         for resp in responses:
             with self._lock:
@@ -882,6 +1013,40 @@ class Controller:
                         e.callback(status, None)
                     except Exception:   # noqa: BLE001 — best-effort
                         pass
+        if ready and self._control is not None:
+            self._note_data_plane_failure()
+
+    def _note_data_plane_failure(self):
+        """Pick up a native ring data-plane failure recorded by the C++ core
+        (attributed to the ring neighbour whose socket died) and queue it to
+        ride the next tick's request list; the coordinator converts the
+        report into the job-wide ABORT broadcast."""
+        try:
+            rank, reason = self._control.last_error()
+        except Exception:   # noqa: BLE001 — diagnostics must not kill the loop
+            return
+        if rank < 0 or not reason or reason.startswith("job aborted:"):
+            return
+        with self._lock:
+            if (self._abort_status is None
+                    and self._last_reported != (rank, reason)):
+                self._pending_report = (rank, reason)
+                self._last_reported = (rank, reason)
+
+    def _handle_abort(self, rank: int, reason: str):
+        """Latch a job-wide abort.  The coordinator broadcast the identical
+        (rank, reason) payload to every process, so all ranks fail their
+        outstanding and future eager work with the SAME attributed ABORTED
+        status — no stranded waiters, no divergent error text."""
+        status = Status.aborted(
+            f"Horovod job aborted: rank {rank} failed: {reason}")
+        with self._lock:
+            if self._abort_status is None:
+                self._abort_status = status
+            else:
+                status = self._abort_status
+            self._shutdown.set()
+        self._fail_all(status)
 
     def _maybe_check_stalls_distributed(self):
         if self.stall_check_disabled or self.topology.process_index != 0:

@@ -102,6 +102,10 @@ def _configure(lib) -> None:
     lib.htpu_control_stalled.restype = ctypes.c_int
     lib.htpu_control_stalled.argtypes = [
         ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_last_error.restype = ctypes.c_int
+    lib.htpu_control_last_error.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_control_data_bytes.restype = None
     lib.htpu_control_data_bytes.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
@@ -245,7 +249,7 @@ def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
     out = ctypes.c_void_p()
     rc = lib.htpu_plan_fusion(blob, len(blob), name_arr, bytes_arr, dtype_arr,
                               n, threshold, ctypes.byref(out))
-    fused, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
+    fused, _, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
     return fused
 
 
@@ -382,6 +386,18 @@ class CppControlPlane:
         n = self._lib.htpu_control_stalled(self._ptr, age_s,
                                            ctypes.byref(out))
         return _parse_stall_records(_take_buffer(self._lib, out, n))
+
+    def last_error(self):
+        """Attribution of the most recent native failure on this process:
+        ``(failed_first_rank, reason)`` — rank is -1 when nothing failed.
+        Read after a ConnectionError from the data plane to build the
+        worker's abort report."""
+        rank = ctypes.c_int(-1)
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_control_last_error(self._ptr, ctypes.byref(rank),
+                                              ctypes.byref(out))
+        reason = _take_buffer(self._lib, out, n).decode("utf-8", "replace")
+        return rank.value, reason
 
     def close(self):
         if getattr(self, "_leaked", False):

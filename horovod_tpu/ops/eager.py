@@ -28,9 +28,9 @@ import jax
 import numpy as np
 
 from horovod_tpu import basics
-from horovod_tpu.core import (Request, RequestType, Status, TensorTableEntry,
-                              default_wire_dtype, dtype_name,
-                              normalize_wire_dtype)
+from horovod_tpu.core import (Request, RequestType, Status, StatusType,
+                              TensorTableEntry, default_wire_dtype,
+                              dtype_name, normalize_wire_dtype)
 
 
 @dataclasses.dataclass
@@ -43,6 +43,14 @@ class CollectiveError(RuntimeError):
     """A negotiated collective failed validation or was aborted; carries the
     coordinator's error message (reference raises framework-level
     errors with the same text, e.g. ``tf.errors.FailedPreconditionError``)."""
+
+
+class HorovodAbortedError(CollectiveError):
+    """The job was aborted — a rank died, hung past the heartbeat deadline,
+    or dropped its connections — and the coordinator broadcast the failure
+    to every surviving rank.  The message names the failed rank and the
+    root cause; every rank raises the same text.  Subclasses
+    :class:`CollectiveError` so existing handlers keep working."""
 
 
 _name_counter = [0]
@@ -116,7 +124,7 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
     per_rank, resolved = _normalize(tensor, name_prefix, name)
     from horovod_tpu.ops.executor import _needs_host_path
     handle = ctrl.handle_manager.allocate(
-        mesh_hazard=not _needs_host_path(per_rank[0].dtype))
+        mesh_hazard=not _needs_host_path(per_rank[0].dtype), name=resolved)
 
     def callback(status: Status, result):
         ctrl.handle_manager.mark_done(handle, status, result)
@@ -208,6 +216,8 @@ def synchronize(handle: int, timeout: Optional[float] = 300.0,
     else:
         hm.release(handle)
     if not status.ok():
+        if status.type == StatusType.ABORTED:
+            raise HorovodAbortedError(status.reason)
         raise CollectiveError(status.reason)
     return result
 

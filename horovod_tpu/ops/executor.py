@@ -267,13 +267,16 @@ class Executor:
             else:
                 raise ValueError(f"bad response type {response.response_type}")
         except Exception as exc:   # noqa: BLE001 — propagate as status
-            status = Status(StatusType.UNKNOWN_ERROR, repr(exc))
+            status = self._failure_status(exc)
             for e in entries:
                 e.callback(status, None)
         finally:
             if self.timeline:
                 for e in entries:
                     self.timeline.end(e.name)
+
+    def _failure_status(self, exc: Exception) -> Status:
+        return Status(StatusType.UNKNOWN_ERROR, repr(exc))
 
     # ------------------------------------------------------------- allreduce
 
@@ -443,6 +446,22 @@ class DistributedExecutor(Executor):
         self._mesh_is_global = any(
             d.process_index != jax.process_index()
             for d in np.asarray(mesh.devices).flat)
+
+    def _failure_status(self, exc: Exception) -> Status:
+        """A TCP data-plane failure means a peer process died mid-collective:
+        attribute it to the ring neighbour the native core recorded, so this
+        rank's error carries the same (rank, reason) every other rank will
+        get from the coordinator's ABORT broadcast."""
+        if isinstance(exc, ConnectionError):
+            try:
+                rank, reason = self._control.last_error()
+            except Exception:   # noqa: BLE001 — attribution is best-effort
+                rank, reason = -1, ""
+            if rank >= 0 and reason:
+                return Status.aborted(
+                    f"Horovod job aborted: rank {rank} failed: {reason}")
+            return Status.aborted(str(exc) or repr(exc))
+        return super()._failure_status(exc)
 
     def _allreduce(self, response: Response, entries: List[TensorTableEntry]):
         dtype = np.dtype(entries[0].dtype)

@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def free_port() -> int:
@@ -49,6 +51,10 @@ def main(argv=None):
                    help="first process index on this host (multi-host)")
     p.add_argument("--process-count", type=int, default=0,
                    help="total processes in the job (default: -np)")
+    p.add_argument("--kill-on-failure-grace", type=float, default=10.0,
+                   help="seconds survivors get to exit on their own after a "
+                        "process fails (the abort broadcast normally takes "
+                        "them down) before SIGTERM, then SIGKILL")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to run (prefix with --)")
     args = p.parse_args(argv)
@@ -78,15 +84,67 @@ def main(argv=None):
         })
         procs.append(subprocess.Popen(cmd, env=env))
 
-    rc = 0
+    # Fast-fail supervision (mpirun semantics): poll ALL children
+    # concurrently; the moment one exits non-zero, give the survivors a
+    # grace window to raise their own attributed abort (the coordinator's
+    # ABORT broadcast normally takes them down within a heartbeat), then
+    # escalate SIGTERM → SIGKILL so a wedged job can never outlive its
+    # first failure.  The old sequential wait() blocked on child 0 while a
+    # later child's crash left the job running until the control timeout.
     try:
-        for proc in procs:
-            rc = proc.wait() or rc
+        return _supervise(procs, args.kill_on_failure_grace)
     except KeyboardInterrupt:
-        for proc in procs:
-            proc.terminate()
-        rc = 130
-    return rc
+        _reap(procs, sig=signal.SIGTERM, grace_s=5.0)
+        return 130
+
+
+def _supervise(procs, grace_s: float) -> int:
+    first_rc = 0
+    failed_at = None
+    while True:
+        running = False
+        for i, proc in enumerate(procs):
+            rc = proc.poll()
+            if rc is None:
+                running = True
+            elif rc != 0 and first_rc == 0:
+                first_rc = rc
+                failed_at = time.monotonic()
+                print(f"horovod_tpu.run: process {i} (pid {proc.pid}) "
+                      f"exited with code {rc}; waiting up to {grace_s:.0f}s "
+                      "for the remaining processes before terminating them",
+                      file=sys.stderr)
+        if not running:
+            return first_rc
+        if failed_at is not None and time.monotonic() - failed_at > grace_s:
+            survivors = [p.pid for p in procs if p.poll() is None]
+            if survivors:
+                print("horovod_tpu.run: terminating surviving processes "
+                      f"{survivors} after the "
+                      f"{grace_s:.0f}s --kill-on-failure-grace window",
+                      file=sys.stderr)
+            _reap(procs, sig=signal.SIGTERM, grace_s=5.0)
+            return first_rc
+        time.sleep(0.1)
+
+
+def _reap(procs, sig, grace_s: float):
+    """Signal all still-running children, give them ``grace_s`` to exit,
+    then SIGKILL whatever remains."""
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_s
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
 
 
 if __name__ == "__main__":

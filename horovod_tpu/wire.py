@@ -12,9 +12,14 @@ control plane.
 from __future__ import annotations
 
 import struct
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from horovod_tpu.core import Request, RequestType, Response, ResponseType
+
+# Abort marker carried by both list formats: (failed_rank, root_cause) or
+# None.  A worker reports a local failure via its RequestList; the
+# coordinator broadcasts the job-wide ABORT via the ResponseList.
+Abort = Optional[Tuple[int, str]]
 
 
 def _put_str(out: bytearray, s: str) -> None:
@@ -110,45 +115,59 @@ def parse_response(rd: _Reader) -> Response:
 
 
 def serialize_request_list(requests: List[Request],
-                           shutdown: bool = False) -> bytes:
+                           shutdown: bool = False,
+                           abort_rank: int = -1,
+                           abort_reason: str = "") -> bytes:
     out = bytearray()
     out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<i", abort_rank)
+    _put_str(out, abort_reason)
     out += struct.pack("<i", len(requests))
     for r in requests:
         out += serialize_request(r)
     return bytes(out)
 
 
-def parse_request_list(data: bytes) -> Tuple[List[Request], bool]:
+def parse_request_list(data: bytes) -> Tuple[List[Request], bool, Abort]:
     rd = _Reader(data)
     shutdown = rd.i8() != 0
+    abort_rank = rd.i32()
+    abort_reason = rd.str_()
     reqs = [parse_request(rd) for _ in range(rd.i32())]
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in request list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
-    return reqs, shutdown
+    abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return reqs, shutdown, abort
 
 
 def serialize_response_list(responses: List[Response],
-                            shutdown: bool = False) -> bytes:
+                            shutdown: bool = False,
+                            abort_rank: int = -1,
+                            abort_reason: str = "") -> bytes:
     out = bytearray()
     out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<i", abort_rank)
+    _put_str(out, abort_reason)
     out += struct.pack("<i", len(responses))
     for r in responses:
         out += serialize_response(r)
     return bytes(out)
 
 
-def parse_response_list(data: bytes) -> Tuple[List[Response], bool]:
+def parse_response_list(data: bytes) -> Tuple[List[Response], bool, Abort]:
     rd = _Reader(data)
     shutdown = rd.i8() != 0
+    abort_rank = rd.i32()
+    abort_reason = rd.str_()
     resps = [parse_response(rd) for _ in range(rd.i32())]
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in response list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
-    return resps, shutdown
+    abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return resps, shutdown, abort
 
 
 def parse_single_response(data: bytes) -> Response:

@@ -172,8 +172,9 @@ class TestWireFormat:
             Response(ResponseType.ALLGATHER, ["d"], tensor_sizes=[5, 6]),
         ]
         blob = wire.serialize_response_list(rs, shutdown=True)
-        parsed, shutdown = wire.parse_response_list(blob)
+        parsed, shutdown, abort = wire.parse_response_list(blob)
         assert shutdown
+        assert abort is None
         assert [p.response_type for p in parsed] == \
             [r.response_type for r in rs]
         assert parsed[1].error_message == "boom"
@@ -183,11 +184,28 @@ class TestWireFormat:
         rs = [req(0, name="α/unicode"), req(1, RequestType.BROADCAST,
                                             name="b", root=1)]
         blob = wire.serialize_request_list(rs, shutdown=False)
-        parsed, shutdown = wire.parse_request_list(blob)
+        parsed, shutdown, abort = wire.parse_request_list(blob)
         assert not shutdown
+        assert abort is None
         assert parsed[0].tensor_name == "α/unicode"
         assert parsed[1].root_rank == 1
         assert parsed[0].tensor_shape == (4, 2)
+
+    def test_abort_fields_ride_both_lists(self):
+        # The ABORT protocol rides the existing list formats: a worker's
+        # failure report goes coordinator-ward on the RequestList, the
+        # coordinator's broadcast comes back on the ResponseList.
+        blob = wire.serialize_request_list(
+            [req(0)], shutdown=False, abort_rank=2, abort_reason="boom at 2")
+        parsed, shutdown, abort = wire.parse_request_list(blob)
+        assert abort == (2, "boom at 2")
+        assert parsed[0].tensor_name == "t"
+        blob = wire.serialize_response_list(
+            [], shutdown=False, abort_rank=0,
+            abort_reason="rank 0 dropped its coordinator connection")
+        parsed, shutdown, abort = wire.parse_response_list(blob)
+        assert parsed == [] and not shutdown
+        assert abort == (0, "rank 0 dropped its coordinator connection")
 
 
 class TestFusionParity:
@@ -241,11 +259,11 @@ class TestWireCompressionNegotiation:
     def test_wire_dtype_rides_the_wire_format(self):
         r = req(1, wire="bf16")
         blob = wire.serialize_request_list([r])
-        parsed, _ = wire.parse_request_list(blob)
+        parsed, _, _ = wire.parse_request_list(blob)
         assert parsed[0].wire_dtype == "bf16"
         resp = Response(ResponseType.ALLREDUCE, ["t"], devices=[0, 1],
                         wire_dtype="int8")
-        parsed, _ = wire.parse_response_list(
+        parsed, _, _ = wire.parse_response_list(
             wire.serialize_response_list([resp]))
         assert parsed[0].wire_dtype == "int8"
 
@@ -349,7 +367,8 @@ class TestNativeBuild:
         assert proc.returncode == 0, proc.stderr
         lib = cpp_core.load()
         assert lib is not None
-        for sym in ("htpu_control_allreduce_wire", "htpu_wire_roundtrip"):
+        for sym in ("htpu_control_allreduce_wire", "htpu_wire_roundtrip",
+                    "htpu_control_last_error"):
             assert hasattr(lib, sym), f"rebuilt library missing {sym}"
 
 
