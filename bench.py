@@ -567,14 +567,23 @@ def tcp_worker():
     np.asarray(loss)
 
     from horovod_tpu import basics
+    from horovod_tpu import metrics as hvd_metrics
     from horovod_tpu.compression import Compression
     control = getattr(basics.controller(), "_control", None)
 
-    def measured_loop(params, opt_state, compression):
+    def _wire_bytes(wire):
+        """Per-dtype bytes-on-wire from the unified metrics registry —
+        the same counters the JSONL/Prometheus exporters publish, so the
+        bench numbers and the live telemetry can never disagree."""
+        c = hvd_metrics.snapshot().get("counters", {})
+        return (c.get(f"ring.allreduce.bytes_sent#wire={wire}", 0),
+                c.get(f"ring.allreduce.bytes_recv#wire={wire}", 0))
+
+    def measured_loop(params, opt_state, compression, wire):
         """One timed window of the training loop; returns throughput,
         comm fraction, and the data-plane bytes that actually rode the
         ring wire (compressed bytes when a wire dtype is active)."""
-        s0, r0 = control.data_bytes() if control is not None else (0, 0)
+        s0, r0 = _wire_bytes(wire)
         t_comm = 0.0
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -588,7 +597,7 @@ def tcp_worker():
             params, opt_state = apply_fn(params, opt_state, grads)
         np.asarray(loss)
         dt = time.perf_counter() - t0
-        s1, r1 = control.data_bytes() if control is not None else (0, 0)
+        s1, r1 = _wire_bytes(wire)
         return params, opt_state, dt, t_comm, s1 - s0, r1 - r0
 
     # fp32 ring leg first (the headline numbers keep their meaning), then
@@ -601,7 +610,7 @@ def tcp_worker():
                        ("bf16", Compression.bf16),
                        ("int8", Compression.int8)):
         params, opt_state, dt, t_comm, sent, recvd = measured_loop(
-            params, opt_state, comp)
+            params, opt_state, comp, wire)
         stats = {
             "images_per_sec_per_proc": round(batch * iters / dt, 2),
             "comm_fraction": round(t_comm / dt, 4),
@@ -637,6 +646,7 @@ def tcp_worker():
         transport = (control.ring_transport()
                      if control is not None
                      and hasattr(control, "ring_transport") else "none")
+        snap = hvd.metrics()
         print("TCPLEG " + json.dumps({
             "n_proc": n,
             "images_per_sec_per_proc": round(batch * iters / dt_raw, 2),
@@ -644,6 +654,11 @@ def tcp_worker():
             "ring_transport": transport,
             "pinned": pinned,
             "wire_compression": wire_stats,
+            # Full counter/gauge state at the end of the run, straight
+            # from the unified registry (histograms are left to the
+            # JSONL/Prometheus exporters to keep this line readable).
+            "metrics": {"counters": snap.get("counters", {}),
+                        "gauges": snap.get("gauges", {})},
         }), flush=True)
     hvd.shutdown()
 

@@ -17,6 +17,7 @@
 #include "htpu/control.h"
 #include "htpu/fusion.h"
 #include "htpu/message_table.h"
+#include "htpu/metrics.h"
 #include "htpu/quantize.h"
 #include "htpu/timeline.h"
 #include "htpu/wire.h"
@@ -30,6 +31,31 @@ int CopyOut(const std::string& s, void** out) {
   memcpy(buf, s.data(), s.size());
   *out = buf;
   return int(s.size());
+}
+
+// Shared serializer for the two stall endpoints: repeated
+// { name_len:i32 name:bytes age:f64 n_missing:i32 ranks:i32[n] },
+// everything little-endian (mirrored by cpp_core._parse_stall_records).
+std::string SerializeStallRecords(const std::vector<htpu::StallInfo>& stalled) {
+  std::string buf;
+  auto put_i32 = [&buf](int32_t v) {
+    for (int i = 0; i < 4; ++i)
+      buf.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+  };
+  auto put_f64 = [&buf](double v) {
+    uint64_t bits;
+    memcpy(&bits, &v, sizeof(bits));
+    for (int i = 0; i < 8; ++i)
+      buf.push_back(char((bits >> (8 * i)) & 0xff));
+  };
+  for (const auto& s : stalled) {
+    put_i32(int32_t(s.name.size()));
+    buf += s.name;
+    put_f64(s.age_s);
+    put_i32(int32_t(s.missing_ranks.size()));
+    for (int r : s.missing_ranks) put_i32(r);
+  }
+  return buf;
 }
 
 }  // namespace
@@ -89,21 +115,10 @@ HTPU_API void htpu_table_clear(void* t) {
 }
 
 // Stalled entries, length-prefixed (names may contain any byte):
-// repeated { name_len:i32 name:bytes n_missing:i32 ranks:i32[n_missing] }.
+// repeated { name_len:i32 name:bytes age:f64 n_missing:i32 ranks:i32[n] }.
 HTPU_API int htpu_table_stalled(void* t, double age_s, void** out) {
   auto stalled = static_cast<htpu::MessageTable*>(t)->Stalled(age_s);
-  std::string buf;
-  auto put_i32 = [&buf](int32_t v) {
-    for (int i = 0; i < 4; ++i)
-      buf.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
-  };
-  for (const auto& kv : stalled) {
-    put_i32(int32_t(kv.first.size()));
-    buf += kv.first;
-    put_i32(int32_t(kv.second.size()));
-    for (int r : kv.second) put_i32(r);
-  }
-  return CopyOut(buf, out);
+  return CopyOut(SerializeStallRecords(stalled), out);
 }
 
 // ------------------------------------------------------------------- fusion
@@ -186,6 +201,17 @@ HTPU_API void htpu_timeline_activity_start(void* tl, const char* name,
 
 HTPU_API void htpu_timeline_activity_end(void* tl, const char* name) {
   static_cast<htpu::Timeline*>(tl)->ActivityEnd(name);
+}
+
+// Chrome-trace counter track sample ("ph": "C") — queue depth, bytes in
+// flight — plotted by Perfetto as rate graphs alongside the spans.
+HTPU_API void htpu_timeline_counter(void* tl, const char* name,
+                                    long long value) {
+  static_cast<htpu::Timeline*>(tl)->Counter(name, value);
+}
+
+HTPU_API void htpu_timeline_flush(void* tl) {
+  static_cast<htpu::Timeline*>(tl)->Flush();
 }
 
 HTPU_API void htpu_timeline_close(void* tl) {
@@ -346,18 +372,20 @@ HTPU_API int htpu_control_last_error(void* cp, int* rank, void** out) {
 // htpu_table_stalled.
 HTPU_API int htpu_control_stalled(void* cp, double age_s, void** out) {
   auto stalled = static_cast<htpu::ControlPlane*>(cp)->Stalled(age_s);
-  std::string buf;
-  auto put_i32 = [&buf](int32_t v) {
-    for (int i = 0; i < 4; ++i)
-      buf.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
-  };
-  for (const auto& kv : stalled) {
-    put_i32(int32_t(kv.first.size()));
-    buf += kv.first;
-    put_i32(int32_t(kv.second.size()));
-    for (int r : kv.second) put_i32(r);
-  }
-  return CopyOut(buf, out);
+  return CopyOut(SerializeStallRecords(stalled), out);
 }
+
+// ------------------------------------------------------------------ metrics
+
+// JSON snapshot of the process-wide native registry (metrics.h):
+// {"counters":{...},"gauges":{...},"histograms":{...}}.  Buffer contract
+// as everywhere else: malloc'd, htpu_free to release; returns the length.
+HTPU_API int htpu_metrics_snapshot(void** out) {
+  return CopyOut(htpu::Metrics::Get().SnapshotJson(), out);
+}
+
+// Zero every value (tests/bench isolation); registered metrics survive so
+// cached counter pointers inside hot paths stay valid.
+HTPU_API void htpu_metrics_reset() { htpu::Metrics::Get().Reset(); }
 
 }  // extern "C"

@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "htpu/fusion.h"
+#include "htpu/metrics.h"
 #include "htpu/quantize.h"
 #include "htpu/reduce.h"
 #include "htpu/timeline.h"
@@ -373,6 +374,8 @@ void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
   aborted_ = true;
   abort_rank_ = rank;
   abort_reason_ = reason;
+  Metrics::Get().Counter("control.aborts")->fetch_add(
+      1, std::memory_order_relaxed);
 }
 
 void ControlPlane::SerializeAbort(std::string* blob) const {
@@ -424,6 +427,10 @@ bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
 bool ControlPlane::Tick(const std::string& request_list_blob,
                         int64_t fusion_threshold,
                         std::string* response_list_blob) {
+  ScopedTimer tick_timer("control.tick_seconds");
+  static std::atomic<long long>* ticks =
+      Metrics::Get().Counter("control.ticks");
+  ticks->fetch_add(1, std::memory_order_relaxed);
   ++tick_count_;
   MaybeInjectFault();
   if (aborted_) {
@@ -488,6 +495,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   };
 
   if (!absorb(request_list_blob)) return false;
+  auto gather_t0 = std::chrono::steady_clock::now();
   for (int i = 1; i < process_count_ && abort_rank < 0; ++i) {
     std::string blob;
     if (!RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_) ||
@@ -499,6 +507,21 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
           std::to_string(heartbeat_ms_ / 1000) +
           "s heartbeat deadline (crashed, hung, or sent a corrupt frame)";
     }
+  }
+  {
+    auto gather_t1 = std::chrono::steady_clock::now();
+    Metrics::Get().Observe(
+        "control.gather_seconds",
+        std::chrono::duration<double>(gather_t1 - gather_t0).count());
+    // Staleness of the liveness signal: the gap between consecutive
+    // successful gathers (~one tick interval in a healthy job).
+    if (last_gather_done_.time_since_epoch().count() != 0) {
+      Metrics::Get().SetGauge(
+          "control.heartbeat_age_s",
+          std::chrono::duration<double>(gather_t1 - last_gather_done_)
+              .count());
+    }
+    last_gather_done_ = gather_t1;
   }
 
   if (abort_rank >= 0) {
@@ -576,8 +599,11 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   };
   out.responses =
       PlanFusion(out.responses, entry_bytes, entry_dtype, fusion_threshold);
+  Metrics::Get().SetGauge("control.pending_tensors",
+                          static_cast<double>(table_->NumPending()));
 
   SerializeResponseList(out, response_list_blob);
+  ScopedTimer bcast_timer("control.bcast_seconds");
   for (int i = 1; i < process_count_; ++i) {
     if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) {
       // A worker died between its request and our response: abort the job
@@ -653,6 +679,25 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   if (elem <= 0 || nbytes % elem != 0) return false;
   const int64_t n_elems = nbytes / elem;
   if (n_elems == 0) return true;
+
+  // Per-wire-dtype traffic counters, looked up once per collective and
+  // bumped per sub-chunk at exactly the sites that feed data_bytes_*, so
+  // the per-dtype sum always reconciles with DataBytes().  raw_bytes_* is
+  // the fp32-equivalent payload, so compression ratio falls out as
+  // raw_bytes / bytes.
+  const std::string wire_label =
+      wire_dtype.empty() ? std::string("fp32") : wire_dtype;
+  Metrics& mx = Metrics::Get();
+  std::atomic<long long>* c_sent =
+      mx.Counter("ring.allreduce.bytes_sent#wire=" + wire_label);
+  std::atomic<long long>* c_recv =
+      mx.Counter("ring.allreduce.bytes_recv#wire=" + wire_label);
+  std::atomic<long long>* c_raw_sent =
+      mx.Counter("ring.allreduce.raw_bytes_sent#wire=" + wire_label);
+  std::atomic<long long>* c_raw_recv =
+      mx.Counter("ring.allreduce.raw_bytes_recv#wire=" + wire_label);
+  std::atomic<long long>* c_chunks =
+      mx.Counter("ring.allreduce.chunks_sent#wire=" + wire_label);
 
   // Segment boundaries by element count (segments may be empty when
   // n_elems < P).
@@ -739,6 +784,11 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       }
       data_bytes_sent_ += swire;
       data_bytes_recv_ += rwire;
+      c_sent->fetch_add(swire, std::memory_order_relaxed);
+      c_recv->fetch_add(rwire, std::memory_order_relaxed);
+      c_raw_sent->fetch_add(s_len * elem, std::memory_order_relaxed);
+      c_raw_recv->fetch_add(r_len * elem, std::memory_order_relaxed);
+      c_chunks->fetch_add(1, std::memory_order_relaxed);
       if (r_len > 0) {
         if (wire == kWireRaw) {
           char* acc = recv_base + r_lo * elem;
@@ -784,6 +834,11 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       }
       data_bytes_sent_ += sbytes;
       data_bytes_recv_ += rbytes;
+      c_sent->fetch_add(sbytes, std::memory_order_relaxed);
+      c_recv->fetch_add(rbytes, std::memory_order_relaxed);
+      c_raw_sent->fetch_add(sbytes, std::memory_order_relaxed);
+      c_raw_recv->fetch_add(rbytes, std::memory_order_relaxed);
+      c_chunks->fetch_add(1, std::memory_order_relaxed);
     }
     return true;
   }
@@ -843,6 +898,11 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       }
       data_bytes_sent_ += swire;
       data_bytes_recv_ += rwire;
+      c_sent->fetch_add(swire, std::memory_order_relaxed);
+      c_recv->fetch_add(rwire, std::memory_order_relaxed);
+      c_raw_sent->fetch_add(s_len * elem, std::memory_order_relaxed);
+      c_raw_recv->fetch_add(r_len * elem, std::memory_order_relaxed);
+      c_chunks->fetch_add(1, std::memory_order_relaxed);
       if (r_len > 0) {
         const char* src = rw + r_off;
         float* dst = out_base + r_lo;
@@ -925,6 +985,10 @@ bool ControlPlane::RingAllgather(const std::string& in, std::string* out) {
     }
     data_bytes_sent_ += sbytes;
     data_bytes_recv_ += rbytes;
+    Metrics::Get().Counter("ring.allgather.bytes_sent")->fetch_add(
+        sbytes, std::memory_order_relaxed);
+    Metrics::Get().Counter("ring.allgather.bytes_recv")->fetch_add(
+        rbytes, std::memory_order_relaxed);
   }
 
   // Concatenate in global-rank order (placement map from ring setup).
@@ -960,6 +1024,10 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
   const bool is_root = (r == root_process);
   // The chain ends at the process whose ring-next is the root.
   const bool is_last = ((r + 1) % P == root_process);
+  std::atomic<long long>* bc_sent =
+      Metrics::Get().Counter("ring.broadcast.bytes_sent");
+  std::atomic<long long>* bc_recv =
+      Metrics::Get().Counter("ring.broadcast.bytes_recv");
 
   // Size header travels the chain first.
   uint64_t nbytes = is_root ? in.size() : 0;
@@ -1007,6 +1075,7 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
         return false;
       }
       data_bytes_sent_ += chunk_len(k);
+      bc_sent->fetch_add(chunk_len(k), std::memory_order_relaxed);
     }
   } else if (is_last) {
     for (int64_t k = 0; k < n_chunks; ++k) {
@@ -1015,6 +1084,7 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
         return false;
       }
       data_bytes_recv_ += chunk_len(k);
+      bc_recv->fetch_add(chunk_len(k), std::memory_order_relaxed);
     }
   } else {
     // Middle of the chain: receive chunk k while forwarding chunk k-1.
@@ -1023,6 +1093,7 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
       return false;
     }
     data_bytes_recv_ += chunk_len(0);
+    bc_recv->fetch_add(chunk_len(0), std::memory_order_relaxed);
     for (int64_t k = 1; k < n_chunks; ++k) {
       if (!RingXfer(ring_next_fd_, chunk_ptr(k - 1),
                     size_t(chunk_len(k - 1)), ring_prev_fd_,
@@ -1031,18 +1102,20 @@ bool ControlPlane::RingBroadcast(int root_process, const std::string& in,
       }
       data_bytes_sent_ += chunk_len(k - 1);
       data_bytes_recv_ += chunk_len(k);
+      bc_sent->fetch_add(chunk_len(k - 1), std::memory_order_relaxed);
+      bc_recv->fetch_add(chunk_len(k), std::memory_order_relaxed);
     }
     if (!RingXfer(ring_next_fd_, chunk_ptr(n_chunks - 1),
                   size_t(chunk_len(n_chunks - 1)), -1, nullptr, 0)) {
       return false;
     }
     data_bytes_sent_ += chunk_len(n_chunks - 1);
+    bc_sent->fetch_add(chunk_len(n_chunks - 1), std::memory_order_relaxed);
   }
   return true;
 }
 
-std::vector<std::pair<std::string, std::vector<int>>> ControlPlane::Stalled(
-    double age_s) const {
+std::vector<StallInfo> ControlPlane::Stalled(double age_s) const {
   if (!table_) return {};
   return table_->Stalled(age_s);
 }

@@ -73,8 +73,7 @@ class ControlPlane {
   bool Broadcast(int root_process, const std::string& in, std::string* out);
 
   // Coordinator-side stall scan (empty on workers).
-  std::vector<std::pair<std::string, std::vector<int>>> Stalled(
-      double age_s) const;
+  std::vector<StallInfo> Stalled(double age_s) const;
 
   int process_count() const { return process_count_; }
 
@@ -156,6 +155,10 @@ class ControlPlane {
   // timeout_ms_) — a worker silent for that long is declared dead.
   int heartbeat_ms_ = 30000;
   uint64_t tick_count_ = 0;
+  // Coordinator: end of the last successful worker gather; the gap between
+  // consecutive gathers is the control.heartbeat_age_s gauge (how stale the
+  // liveness signal is — in a healthy job, roughly one tick interval).
+  std::chrono::steady_clock::time_point last_gather_done_{};
 
   // Fault injection (HOROVOD_TPU_FAULT=mode:rank=R:tick=T, matched
   // against first_rank_): 0 = none, 1 = crash, 2 = hang, 3 = drop_conn.

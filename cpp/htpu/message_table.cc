@@ -3,6 +3,8 @@
 #include <sstream>
 #include <stdexcept>
 
+#include "htpu/metrics.h"
+
 namespace htpu {
 
 namespace {
@@ -175,6 +177,13 @@ Response MessageTable::ConstructResponse(const std::string& name) {
   std::vector<int32_t> devices(requests.size(), 0);
   for (const auto& r : requests) devices[size_t(r.request_rank)] = r.device;
 
+  // Negotiation latency: first request seen -> response constructed.
+  Metrics::Get().Observe(
+      "control.negotiate_seconds",
+      std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                    it->second.first_seen)
+          .count());
+
   table_.erase(it);
 
   resp.tensor_names = {name};
@@ -194,9 +203,8 @@ Response MessageTable::ConstructResponse(const std::string& name) {
   return resp;
 }
 
-std::vector<std::pair<std::string, std::vector<int>>> MessageTable::Stalled(
-    double age_s) const {
-  std::vector<std::pair<std::string, std::vector<int>>> out;
+std::vector<StallInfo> MessageTable::Stalled(double age_s) const {
+  std::vector<StallInfo> out;
   auto now = std::chrono::steady_clock::now();
   for (const auto& kv : table_) {
     double age = std::chrono::duration<double>(now - kv.second.first_seen)
@@ -205,11 +213,15 @@ std::vector<std::pair<std::string, std::vector<int>>> MessageTable::Stalled(
     std::vector<bool> have(size_t(size_), false);
     for (const auto& r : kv.second.requests)
       have[size_t(r.request_rank)] = true;
-    std::vector<int> missing;
+    StallInfo info;
+    info.name = kv.first;
+    info.age_s = age;
     for (int r = 0; r < size_; ++r)
-      if (!have[size_t(r)]) missing.push_back(r);
-    out.emplace_back(kv.first, std::move(missing));
+      if (!have[size_t(r)]) info.missing_ranks.push_back(r);
+    out.push_back(std::move(info));
   }
+  Metrics::Get().SetGauge("control.stalled_tensors",
+                          static_cast<double>(out.size()));
   return out;
 }
 

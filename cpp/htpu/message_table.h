@@ -16,6 +16,14 @@
 
 namespace htpu {
 
+// One stalled negotiation: how long the tensor has been waiting and
+// which ranks have not reported yet.
+struct StallInfo {
+  std::string name;
+  double age_s = 0.0;
+  std::vector<int> missing_ranks;
+};
+
 class MessageTable {
  public:
   explicit MessageTable(int size) : size_(size) {}
@@ -28,9 +36,9 @@ class MessageTable {
   // removing the entry. Preconditions: Increment returned true for `name`.
   Response ConstructResponse(const std::string& name);
 
-  // Names pending longer than age_s, with the ranks still missing.
-  std::vector<std::pair<std::string, std::vector<int>>> Stalled(
-      double age_s) const;
+  // Names pending longer than age_s, with each tensor's wait age and the
+  // ranks still missing.  Also refreshes the control.stalled_tensors gauge.
+  std::vector<StallInfo> Stalled(double age_s) const;
 
   size_t NumPending() const { return table_.size(); }
   void Clear() { table_.clear(); }

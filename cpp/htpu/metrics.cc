@@ -1,0 +1,186 @@
+#include "htpu/metrics.h"
+
+#include <chrono>
+#include <cstdio>
+
+namespace htpu {
+
+namespace {
+
+double NowSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Exponential-ish seconds buckets: 1us .. 10s.
+const std::vector<double>& DefaultSecondsBounds() {
+  static const std::vector<double>* b = new std::vector<double>{
+      1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0,
+      10.0};
+  return *b;
+}
+
+void AppendJsonString(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"':
+        *out += "\\\"";
+        break;
+      case '\\':
+        *out += "\\\\";
+        break;
+      case '\n':
+        *out += "\\n";
+        break;
+      case '\t':
+        *out += "\\t";
+        break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void AppendDouble(double v, std::string* out) {
+  char buf[32];
+  // %.17g round-trips doubles; json has no Inf/NaN, clamp to null.
+  if (v != v || v > 1.7e308 || v < -1.7e308) {
+    *out += "null";
+    return;
+  }
+  snprintf(buf, sizeof(buf), "%.17g", v);
+  *out += buf;
+}
+
+void AtomicAddDouble(std::atomic<double>* a, double v) {
+  double cur = a->load(std::memory_order_relaxed);
+  while (!a->compare_exchange_weak(cur, cur + v, std::memory_order_relaxed)) {
+  }
+}
+
+}  // namespace
+
+Histogram::Histogram(std::vector<double> b)
+    : bounds(std::move(b)), counts(bounds.size() + 1) {
+  for (auto& c : counts) c.store(0, std::memory_order_relaxed);
+}
+
+void Histogram::Observe(double v) {
+  size_t i = 0;
+  while (i < bounds.size() && v > bounds[i]) ++i;
+  counts[i].fetch_add(1, std::memory_order_relaxed);
+  count.fetch_add(1, std::memory_order_relaxed);
+  AtomicAddDouble(&sum, v);
+}
+
+Metrics& Metrics::Get() {
+  static Metrics* m = new Metrics();  // never destroyed: usable at exit
+  return *m;
+}
+
+std::atomic<long long>* Metrics::Counter(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& slot = counters_[name];
+  if (!slot) slot.reset(new std::atomic<long long>(0));
+  return slot.get();
+}
+
+void Metrics::SetGauge(const std::string& name, double value) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& slot = gauges_[name];
+  if (!slot) slot.reset(new std::atomic<double>(0.0));
+  slot->store(value, std::memory_order_relaxed);
+}
+
+void Metrics::Observe(const std::string& name, double value) {
+  Observe(name, value, DefaultSecondsBounds());
+}
+
+void Metrics::Observe(const std::string& name, double value,
+                      const std::vector<double>& bounds) {
+  Histogram* h;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& slot = histograms_[name];
+    if (!slot) slot.reset(new Histogram(bounds));
+    h = slot.get();
+  }
+  h->Observe(value);
+}
+
+std::string Metrics::SnapshotJson() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::string out = "{\"counters\":{";
+  bool first = true;
+  for (const auto& kv : counters_) {
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(kv.first, &out);
+    out += ":";
+    out += std::to_string(kv.second->load(std::memory_order_relaxed));
+  }
+  out += "},\"gauges\":{";
+  first = true;
+  for (const auto& kv : gauges_) {
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(kv.first, &out);
+    out += ":";
+    AppendDouble(kv.second->load(std::memory_order_relaxed), &out);
+  }
+  out += "},\"histograms\":{";
+  first = true;
+  for (const auto& kv : histograms_) {
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(kv.first, &out);
+    out += ":{\"bounds\":[";
+    const Histogram& h = *kv.second;
+    for (size_t i = 0; i < h.bounds.size(); ++i) {
+      if (i) out += ",";
+      AppendDouble(h.bounds[i], &out);
+    }
+    out += "],\"counts\":[";
+    for (size_t i = 0; i < h.counts.size(); ++i) {
+      if (i) out += ",";
+      out += std::to_string(h.counts[i].load(std::memory_order_relaxed));
+    }
+    out += "],\"sum\":";
+    AppendDouble(h.sum.load(std::memory_order_relaxed), &out);
+    out += ",\"count\":";
+    out += std::to_string(h.count.load(std::memory_order_relaxed));
+    out += "}";
+  }
+  out += "}}";
+  return out;
+}
+
+void Metrics::Reset() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : counters_) kv.second->store(0, std::memory_order_relaxed);
+  for (auto& kv : gauges_) kv.second->store(0.0, std::memory_order_relaxed);
+  for (auto& kv : histograms_) {
+    Histogram& h = *kv.second;
+    for (auto& c : h.counts) c.store(0, std::memory_order_relaxed);
+    h.count.store(0, std::memory_order_relaxed);
+    h.sum.store(0.0, std::memory_order_relaxed);
+  }
+}
+
+ScopedTimer::ScopedTimer(const char* name)
+    : name_(name), start_(NowSeconds()) {}
+
+ScopedTimer::~ScopedTimer() {
+  Metrics::Get().Observe(name_, NowSeconds() - start_);
+}
+
+}  // namespace htpu

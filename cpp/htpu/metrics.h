@@ -1,0 +1,89 @@
+// Process-wide metrics registry for the native core: atomic counters,
+// gauges, and fixed-bucket histograms, snapshotted as JSON through
+// htpu_metrics_snapshot() (c_api.cc) and merged with the Python-side
+// registry by horovod_tpu/metrics.py.
+//
+// Naming convention shared with the Python layer: a metric name is
+// "family" or "family#label=value[,label2=value2]" — e.g.
+// "ring.allreduce.bytes_sent#wire=int8".  The Prometheus renderer (in
+// Python) splits on '#' to recover labels; everything here treats the
+// full string as an opaque key.
+//
+// Concurrency: Counter() returns a pointer that stays valid for the
+// process lifetime (the map only grows; Reset() zeroes values without
+// erasing entries), so hot paths look a counter up once and then do
+// relaxed fetch_add per event.  The registry map itself is guarded by a
+// mutex; snapshots may race with increments and read each atomic
+// individually — fine for monitoring.
+#ifndef HTPU_METRICS_H_
+#define HTPU_METRICS_H_
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace htpu {
+
+// One fixed-bucket histogram: counts[i] is the number of observations
+// <= bounds[i]; counts.back() is the +Inf overflow bucket.
+struct Histogram {
+  explicit Histogram(std::vector<double> b);
+  void Observe(double v);
+
+  const std::vector<double> bounds;
+  std::vector<std::atomic<long long>> counts;  // bounds.size() + 1
+  std::atomic<long long> count{0};
+  std::atomic<double> sum{0.0};
+};
+
+class Metrics {
+ public:
+  static Metrics& Get();
+
+  // Stable pointer; cache it in hot paths.
+  std::atomic<long long>* Counter(const std::string& name);
+
+  void SetGauge(const std::string& name, double value);
+
+  // Default bounds cover 1us..10s latencies; pass explicit bounds for
+  // non-latency histograms (e.g. ratios).
+  void Observe(const std::string& name, double value);
+  void Observe(const std::string& name, double value,
+               const std::vector<double>& bounds);
+
+  // {"counters":{...},"gauges":{...},"histograms":{name:{"bounds":[..],
+  //  "counts":[..],"sum":s,"count":n}}}
+  std::string SnapshotJson();
+
+  // Zero every value but keep all map entries (cached Counter()
+  // pointers stay valid).
+  void Reset();
+
+ private:
+  Metrics() = default;
+
+  std::mutex mu_;
+  std::map<std::string, std::unique_ptr<std::atomic<long long>>> counters_;
+  std::map<std::string, std::unique_ptr<std::atomic<double>>> gauges_;
+  std::map<std::string, std::unique_ptr<Histogram>> histograms_;
+};
+
+// RAII seconds timer feeding Metrics::Observe on destruction; covers
+// every early return of the scoped function.
+class ScopedTimer {
+ public:
+  explicit ScopedTimer(const char* name);
+  ~ScopedTimer();
+
+ private:
+  const char* name_;
+  double start_;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_METRICS_H_

@@ -18,12 +18,18 @@
 
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
 
 #include "htpu/control.h"
 #include "htpu/wire.h"
+
+// c_api.cc is linked into this binary too; exercise the exported metrics
+// snapshot exactly as ctypes would, under the sanitizers.
+extern "C" int htpu_metrics_snapshot(void** out);
+extern "C" void htpu_free(void* p);
 
 namespace {
 
@@ -89,6 +95,44 @@ int RunProcess(int pidx, int port) {
   std::string bcast_in = pidx == 0 ? "payload" : "", bcast_out;
   if (!cp->Broadcast(0, bcast_in, &bcast_out)) return Fail(pidx, "Broadcast");
   if (bcast_out != "payload") return Fail(pidx, "broadcast value");
+
+  // Metrics snapshot after the collective pass: must be well-formed JSON
+  // (balanced braces) with non-zero per-wire byte counters for the int8
+  // allreduce that just ran.
+  {
+    void* buf = nullptr;
+    int len = htpu_metrics_snapshot(&buf);
+    if (len <= 0 || !buf) return Fail(pidx, "metrics snapshot");
+    std::string js(static_cast<const char*>(buf), size_t(len));
+    htpu_free(buf);
+    if (js.front() != '{' || js.back() != '}') {
+      return Fail(pidx, "metrics snapshot not a JSON object");
+    }
+    long depth = 0;
+    bool in_str = false, esc = false;
+    for (char c : js) {
+      if (esc) { esc = false; continue; }
+      if (in_str) {
+        if (c == '\\') esc = true;
+        else if (c == '"') in_str = false;
+        continue;
+      }
+      if (c == '"') in_str = true;
+      else if (c == '{') ++depth;
+      else if (c == '}') --depth;
+      if (depth < 0) break;
+    }
+    if (depth != 0 || in_str) {
+      return Fail(pidx, "metrics snapshot braces unbalanced");
+    }
+    const std::string key = "\"ring.allreduce.bytes_sent#wire=int8\":";
+    size_t at = js.find(key);
+    if (at == std::string::npos) {
+      return Fail(pidx, "metrics snapshot missing int8 byte counter");
+    }
+    long long v = atoll(js.c_str() + at + key.size());
+    if (v <= 0) return Fail(pidx, "int8 byte counter is zero");
+  }
 
   // Abort path: process 1 dies without shutdown; survivors keep ticking
   // until the coordinator's gather hits EOF and the abort propagates.

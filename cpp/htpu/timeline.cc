@@ -148,6 +148,18 @@ void Timeline::ActivityEnd(const std::string& tensor_name) {
   NegotiateEnd(tensor_name);
 }
 
+void Timeline::Counter(const std::string& name, int64_t value) {
+  std::ostringstream os;
+  os << "{\"ph\": \"C\", \"pid\": 0, \"ts\": " << TsUs() << ", \"name\": \""
+     << JsonEscape(name) << "\", \"args\": {\"value\": " << value << "}}";
+  Emit(os.str());
+}
+
+void Timeline::Flush() {
+  std::lock_guard<std::mutex> l(mu_);
+  if (!closed_ && file_) fflush(file_);
+}
+
 void Timeline::Close() {
   std::lock_guard<std::mutex> l(mu_);
   if (!closed_ && file_) {

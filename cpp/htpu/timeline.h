@@ -34,6 +34,10 @@ class Timeline {
   void ActivityStart(const std::string& tensor_name,
                      const std::string& activity);
   void ActivityEnd(const std::string& tensor_name);
+  // Chrome-trace counter track ("ph": "C") — plotted by Perfetto as a
+  // rate graph alongside the spans (queue depth, bytes in flight).
+  void Counter(const std::string& name, int64_t value);
+  void Flush();
   void Close();
 
  private:

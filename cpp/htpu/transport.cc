@@ -9,10 +9,13 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <thread>
+
+#include "htpu/metrics.h"
 
 namespace htpu {
 
@@ -208,7 +211,18 @@ bool SendFrame(int fd, const std::string& payload) {
   uint32_t len = uint32_t(payload.size());
   char hdr[4];
   for (int i = 0; i < 4; ++i) hdr[i] = char((len >> (8 * i)) & 0xff);
-  return SendAll(fd, hdr, 4) && SendAll(fd, payload.data(), payload.size());
+  if (!(SendAll(fd, hdr, 4) &&
+        SendAll(fd, payload.data(), payload.size()))) {
+    return false;
+  }
+  static std::atomic<long long>* frames =
+      Metrics::Get().Counter("transport.frames_sent");
+  static std::atomic<long long>* bytes =
+      Metrics::Get().Counter("transport.frame_bytes_sent");
+  frames->fetch_add(1, std::memory_order_relaxed);
+  bytes->fetch_add(4 + static_cast<long long>(len),
+                   std::memory_order_relaxed);
+  return true;
 }
 
 bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
@@ -224,7 +238,17 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
     return false;
   }
   payload->resize(len);
-  return len == 0 || RecvAll(fd, &(*payload)[0], len, timeout_ms);
+  if (len != 0 && !RecvAll(fd, &(*payload)[0], len, timeout_ms)) {
+    return false;
+  }
+  static std::atomic<long long>* frames =
+      Metrics::Get().Counter("transport.frames_recv");
+  static std::atomic<long long>* bytes =
+      Metrics::Get().Counter("transport.frame_bytes_recv");
+  frames->fetch_add(1, std::memory_order_relaxed);
+  bytes->fetch_add(4 + static_cast<long long>(len),
+                   std::memory_order_relaxed);
+  return true;
 }
 
 bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
@@ -233,6 +257,20 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
   constexpr size_t kSliceBytes = 1 << 20;
   if (failed_fd) *failed_fd = -1;
   size_t sent = 0, rcvd = 0;
+  // Count whatever actually moved on every exit path (success, timeout,
+  // peer death) — a torn transfer's bytes still crossed the wire.
+  struct ByteGuard {
+    const size_t& s;
+    const size_t& r;
+    ~ByteGuard() {
+      static std::atomic<long long>* ds =
+          Metrics::Get().Counter("transport.duplex_bytes_sent");
+      static std::atomic<long long>* dr =
+          Metrics::Get().Counter("transport.duplex_bytes_recv");
+      ds->fetch_add(static_cast<long long>(s), std::memory_order_relaxed);
+      dr->fetch_add(static_cast<long long>(r), std::memory_order_relaxed);
+    }
+  } byte_guard{sent, rcvd};
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (sent < send_len || rcvd < recv_len) {

@@ -91,6 +91,8 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
                 _state.topology,
                 local_rank_override=_state.controller.host_local_rank)
         _state.controller.start()
+        from horovod_tpu import metrics as _metrics_mod
+        _metrics_mod.start_exporters(_state.topology.rank)
         if not _state.atexit_registered:
             atexit.register(shutdown)
             _state.atexit_registered = True
@@ -108,6 +110,8 @@ def shutdown() -> None:
             if _state.controller is not None:
                 _state.controller.stop()
         finally:
+            from horovod_tpu import metrics as _metrics_mod
+            _metrics_mod.stop_exporters()
             _state.controller = None
             _state.topology = None
             _state.mesh = None
@@ -181,6 +185,17 @@ def get_topology():
 
 def controller():
     return _require_init().controller
+
+
+def metrics() -> dict:
+    """One merged metrics snapshot: the native core's registry (ring bytes
+    per wire dtype, tick/gather/negotiation latency, aborts, stalls) plus
+    the controller-side series (enqueues/ops by type, handle wait time,
+    fusion-buffer utilization), as ``{"counters", "gauges", "histograms",
+    "ts", "rank"}``.  Works before init too (native counters may already
+    exist); see docs/observability.md."""
+    from horovod_tpu import metrics as _metrics_mod
+    return _metrics_mod.snapshot()
 
 
 def wire_dtype() -> str:

@@ -36,6 +36,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from horovod_tpu import metrics as _metrics
+
 
 # --------------------------------------------------------------------------
 # Status (mirrors horovod/common/common.h:37-53)
@@ -281,17 +283,19 @@ class MessageTable:
             self._timeline.negotiate_end(name)
         return ready
 
-    def pending_names_older_than(self, age_s: float) -> List[Tuple[str, List[int]]]:
-        """(name, missing_ranks) for entries older than ``age_s`` — the stall
-        detector's input (``CheckForStalledTensors``,
-        ``operations.cc:1366-1412``)."""
+    def pending_names_older_than(
+            self, age_s: float) -> List[Tuple[str, float, List[int]]]:
+        """(name, age_s, missing_ranks) for entries older than ``age_s`` —
+        the stall detector's input (``CheckForStalledTensors``,
+        ``operations.cc:1366-1412``).  Same record shape as the native
+        table's stall report (cpp/htpu/message_table.h StallInfo)."""
         now = time.monotonic()
         out = []
         for name, (reqs, t0) in self._table.items():
             if now - t0 > age_s:
                 have = {r.request_rank for r in reqs}
                 missing = [r for r in range(self._size) if r not in have]
-                out.append((name, missing))
+                out.append((name, now - t0, missing))
         return out
 
     def construct_response(self, name: str) -> Response:
@@ -553,24 +557,31 @@ class HandleManager:
         if timeout is None:
             timeout = default_op_timeout()
             abandon_on_timeout = timeout is not None
-        with self._cv:
-            self._check_known(handle)
-            if not self._cv.wait_for(
-                    lambda: self._results[handle] is not None, timeout):
-                name = self._names.get(handle, "")
-                op = f" (op '{name}')" if name else ""
-                if abandon_on_timeout:
-                    self._results.pop(handle, None)
-                    self._mesh_hazard.discard(handle)
-                    self._names.pop(handle, None)
-                    raise TimeoutError(
-                        f"handle {handle}{op} did not complete within "
-                        f"{timeout:.0f}s (HOROVOD_TPU_OP_TIMEOUT_S); the "
-                        "handle has been abandoned. A peer rank likely "
-                        "never submitted this collective — check for "
-                        "stall warnings on rank 0.")
-                raise TimeoutError(f"handle {handle}{op} did not complete")
-            return self._results[handle]
+        t0 = time.monotonic()
+        try:
+            with self._cv:
+                self._check_known(handle)
+                if not self._cv.wait_for(
+                        lambda: self._results[handle] is not None, timeout):
+                    name = self._names.get(handle, "")
+                    op = f" (op '{name}')" if name else ""
+                    if abandon_on_timeout:
+                        self._results.pop(handle, None)
+                        self._mesh_hazard.discard(handle)
+                        self._names.pop(handle, None)
+                        raise TimeoutError(
+                            f"handle {handle}{op} did not complete within "
+                            f"{timeout:.0f}s (HOROVOD_TPU_OP_TIMEOUT_S); the "
+                            "handle has been abandoned. A peer rank likely "
+                            "never submitted this collective — check for "
+                            "stall warnings on rank 0.")
+                    raise TimeoutError(f"handle {handle}{op} did not complete")
+                return self._results[handle]
+        finally:
+            # Time-to-result from the framework thread's point of view —
+            # recorded on timeouts too, so stalls show in the tail.
+            _metrics.registry.observe("controller.handle_wait_seconds",
+                                      time.monotonic() - t0)
 
     def release(self, handle: int):
         with self._lock:
@@ -771,6 +782,13 @@ class Controller:
             # per-rank ready instants) appear exactly as in the
             # single-process mode (reference timeline model, §5.1).
             self.timeline.attach_to_control(self._control)
+        if self.timeline is not None:
+            # Durability guard: a process that dies without shutdown()
+            # (uncaught exception, sys.exit in user code) still gets its
+            # trace closed into loadable JSON.  close() is idempotent, so
+            # the normal stop() path is unaffected.
+            import atexit
+            atexit.register(self._close_timeline)
 
         self.handle_manager = HandleManager()
         if self._use_cpp:
@@ -786,6 +804,13 @@ class Controller:
         self._shutdown = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_stall_check = time.monotonic()
+        # Stall-warning dedupe: name -> frozenset(missing ranks) at the
+        # last warning.  A tensor re-warns only when its missing-rank set
+        # changes; resolved names drop out on the next check.
+        self._stall_warned: Dict[str, frozenset] = {}
+        # Last timeline counter-track values — counter events are emitted
+        # only on change so idle ticks don't bloat the trace.
+        self._last_counters: Dict[str, int] = {}
         # Job-wide abort latch.  Once set, every outstanding handle has
         # completed with this ABORTED status and enqueue() fails fast with
         # the same attributed cause (no new work can strand a waiter).
@@ -911,6 +936,10 @@ class Controller:
                     "A collective for this tensor is already in progress.")
             self._tensor_table[entry.name] = entry
             self._message_queue.extend(requests)
+        _metrics.registry.inc(
+            "controller.enqueued#type="
+            f"{request_type_name(entry.request_type).lower()},"
+            f"dtype={entry.dtype}", len(requests))
         return Status.OK()
 
     # ------------------------------------------------------- background loop
@@ -993,6 +1022,7 @@ class Controller:
                 self.timeline.activity_start_all(entries, "QUEUE")
         self._execute_ready(ready)
         self._maybe_check_stalls_distributed()
+        self._tick_telemetry()
         return remote_shutdown
 
     def _execute_ready(self, ready):
@@ -1002,6 +1032,16 @@ class Controller:
         entries: their callbacks would never fire and no stall scan could
         see them, so convert the failure and keep going."""
         for resp, entries in ready:
+            _metrics.registry.inc(
+                "controller.ops#type="
+                + ResponseType(resp.response_type).name.lower())
+            if (resp.response_type == ResponseType.ALLREDUCE
+                    and self.fusion_threshold > 0 and entries):
+                nbytes = sum(int(e.per_rank[0].nbytes) for e in entries)
+                _metrics.registry.observe(
+                    "controller.fusion_fill_ratio",
+                    min(1.0, nbytes / self.fusion_threshold),
+                    bounds=_metrics.RATIO_BOUNDS)
             if self.timeline:
                 self.timeline.activity_end_all(entries)
             try:
@@ -1043,6 +1083,7 @@ class Controller:
         with self._lock:
             if self._abort_status is None:
                 self._abort_status = status
+                _metrics.registry.inc("controller.aborts")
             else:
                 status = self._abort_status
             self._shutdown.set()
@@ -1055,9 +1096,7 @@ class Controller:
         if now - self._last_stall_check < self.stall_warning_time_s:
             return
         self._last_stall_check = now
-        stalled = self._control.stalled(self.stall_warning_time_s)
-        if stalled:
-            self._warn_stalled(stalled)
+        self._warn_stalled(self._control.stalled(self.stall_warning_time_s))
 
     def _run_loop_once(self):
         with self._lock:
@@ -1076,6 +1115,7 @@ class Controller:
 
         if not responses:
             self._maybe_check_stalls()
+            self._tick_telemetry()
             return
 
         def entry_bytes(name: str) -> int:
@@ -1101,6 +1141,7 @@ class Controller:
         self._execute_ready(ready)
 
         self._maybe_check_stalls()
+        self._tick_telemetry()
 
     def _maybe_check_stalls(self):
         """Warn (once per minute) about tensors some ranks never submitted
@@ -1111,13 +1152,29 @@ class Controller:
         if now - self._last_stall_check < self.stall_warning_time_s:
             return
         self._last_stall_check = now
-        stalled = self._message_table.pending_names_older_than(
-            self.stall_warning_time_s)
-        if stalled:
-            self._warn_stalled(stalled)
+        self._warn_stalled(self._message_table.pending_names_older_than(
+            self.stall_warning_time_s))
 
     def _warn_stalled(self, stalled):
+        """``stalled`` is a list of (name, age_s, missing_ranks) records —
+        the shape both the Python table and the native control plane
+        report.  Identical warnings dedupe on the missing-rank set: a
+        long-lived stall prints once, and re-warns only when the set of
+        absent ranks changes; resolved tensors drop out so they may warn
+        again on a later stall."""
         import sys
+        _metrics.registry.set_gauge("controller.stalled_tensors",
+                                    len(stalled))
+        fresh = []
+        current: Dict[str, frozenset] = {}
+        for name, age, missing in stalled:
+            key = frozenset(missing)
+            current[name] = key
+            if self._stall_warned.get(name) != key:
+                fresh.append((name, age, missing))
+        self._stall_warned = current
+        if not fresh:
+            return
         msg = ["WARNING: One or more tensors were submitted to be "
                "reduced, gathered or broadcasted by subset of ranks and "
                "are waiting for remainder of ranks for more than "
@@ -1125,9 +1182,9 @@ class Controller:
                "indicate that different ranks are trying to submit "
                "different tensors or that only subset of ranks is "
                "submitting tensors, which will cause deadlock."]
-        for name, missing in stalled:
-            msg.append(f"Stalled op: {name} [missing ranks: "
-                       f"{', '.join(map(str, missing))}]")
+        for name, age, missing in fresh:
+            msg.append(f"Stalled op: {name} [waiting {age:.0f}s; "
+                       f"missing ranks: {', '.join(map(str, missing))}]")
         print("\n".join(msg), file=sys.stderr)
 
     def _fail_all(self, status: Status):
@@ -1140,3 +1197,47 @@ class Controller:
             self._message_table.clear()
         for e in entries:
             e.callback(status, None)
+        # Keep the trace on disk usable while the job is failing: this
+        # covers both the abort-broadcast path and tick-loop exceptions
+        # (the atexit guard closes the JSON on process death).
+        tl = self.timeline
+        if tl is not None and hasattr(tl, "flush"):
+            try:
+                tl.flush()
+            except Exception:   # noqa: BLE001 — best-effort on failure path
+                pass
+
+    def _tick_telemetry(self):
+        """Per-tick observability: queue-depth / outstanding-handle gauges
+        in the metrics registry plus Chrome-trace counter tracks (queue
+        depth, bytes in flight) on the timeline.  Counter events are
+        emitted only when the value changes so idle ticks cost nothing in
+        the trace."""
+        with self._lock:
+            depth = len(self._tensor_table)
+            in_flight = sum(int(c.nbytes)
+                            for e in self._tensor_table.values()
+                            for c in e.per_rank)
+        _metrics.registry.set_gauge("controller.queue_depth", depth)
+        _metrics.registry.set_gauge("controller.outstanding_handles",
+                                    self.handle_manager.outstanding())
+        tl = self.timeline
+        if tl is not None and hasattr(tl, "counter"):
+            for name, val in (("queue_depth", depth),
+                              ("bytes_in_flight", in_flight)):
+                if self._last_counters.get(name) != val:
+                    self._last_counters[name] = val
+                    tl.counter(name, val)
+
+    def _close_timeline(self):
+        """atexit / teardown hook: close the timeline into loadable JSON
+        if it is still open.  Safe after stop() — close() is idempotent in
+        both implementations, and a leaked native timeline (wedged
+        shutdown) makes this a no-op."""
+        tl = self.timeline
+        if tl is None:
+            return
+        try:
+            tl.close()
+        except Exception:   # noqa: BLE001 — best-effort at interpreter exit
+            pass

@@ -69,6 +69,11 @@ def _configure(lib) -> None:
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.htpu_timeline_activity_start.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.htpu_timeline_counter.restype = None
+    lib.htpu_timeline_counter.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.htpu_timeline_flush.restype = None
+    lib.htpu_timeline_flush.argtypes = [ctypes.c_void_p]
     lib.htpu_timeline_close.argtypes = [ctypes.c_void_p]
     lib.htpu_control_create.restype = ctypes.c_void_p
     lib.htpu_control_create.argtypes = [
@@ -115,6 +120,10 @@ def _configure(lib) -> None:
     lib.htpu_control_set_timeline.restype = None
     lib.htpu_control_set_timeline.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p]
+    lib.htpu_metrics_snapshot.restype = ctypes.c_int
+    lib.htpu_metrics_snapshot.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_metrics_reset.restype = None
+    lib.htpu_metrics_reset.argtypes = []
 
 
 def load():
@@ -272,6 +281,9 @@ def wire_roundtrip(wire_dtype: str, values):
 
 
 def _parse_stall_records(data: bytes):
+    """Decode the stall wire format (c_api.cc SerializeStallRecords):
+    repeated { name_len:i32 name age:f64 n_missing:i32 ranks:i32[n] },
+    little-endian.  Returns ``(name, age_s, missing_ranks)`` triples."""
     import struct
     result, pos = [], 0
     while pos < len(data):
@@ -279,12 +291,36 @@ def _parse_stall_records(data: bytes):
         pos += 4
         name = data[pos:pos + nlen].decode("utf-8")
         pos += nlen
+        (age,) = struct.unpack_from("<d", data, pos)
+        pos += 8
         (nmiss,) = struct.unpack_from("<i", data, pos)
         pos += 4
         ranks = list(struct.unpack_from(f"<{nmiss}i", data, pos))
         pos += 4 * nmiss
-        result.append((name, ranks))
+        result.append((name, age, ranks))
     return result
+
+
+def metrics_snapshot() -> dict:
+    """JSON snapshot of the native metrics registry (cpp/htpu/metrics.h):
+    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}``.
+    Empty dict when the native core is unavailable."""
+    import json
+    lib = load()
+    if lib is None:
+        return {}
+    out = ctypes.c_void_p()
+    n = lib.htpu_metrics_snapshot(ctypes.byref(out))
+    if n < 0:
+        return {}
+    return json.loads(_take_buffer(lib, out, n).decode("utf-8"))
+
+
+def metrics_reset() -> None:
+    """Zero every native counter/gauge/histogram (tests, bench windows)."""
+    lib = load()
+    if lib is not None:
+        lib.htpu_metrics_reset()
 
 
 class CppControlPlane:
@@ -496,6 +532,18 @@ class CppTimeline:
         for e in entries:
             self._lib.htpu_timeline_activity_end(
                 self._ptr, e.name.encode("utf-8"))
+
+    def counter(self, name: str, value: int) -> None:
+        """Chrome-trace counter sample ("ph": "C") — queue depth, bytes in
+        flight — rendered by Perfetto as a rate track."""
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_counter(
+            self._ptr, name.encode("utf-8"), int(value))
+
+    def flush(self) -> None:
+        if self._ptr:
+            self._lib.htpu_timeline_flush(self._ptr)
 
     def leak(self):
         """Abandon the native writer WITHOUT destroying it — for shutdown

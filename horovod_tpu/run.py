@@ -51,6 +51,14 @@ def main(argv=None):
                    help="first process index on this host (multi-host)")
     p.add_argument("--process-count", type=int, default=0,
                    help="total processes in the job (default: -np)")
+    p.add_argument("--metrics-every", type=float, default=0.0,
+                   help="emit a metrics snapshot line every N seconds to a "
+                        "per-rank JSONL file (sets "
+                        "HOROVOD_TPU_METRICS_EVERY_S in each child; tail "
+                        "with tools/metrics_watch.py)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus text metrics from rank 0 on this "
+                        "port (sets HOROVOD_TPU_METRICS_PORT)")
     p.add_argument("--kill-on-failure-grace", type=float, default=10.0,
                    help="seconds survivors get to exit on their own after a "
                         "process fails (the abort broadcast normally takes "
@@ -82,6 +90,10 @@ def main(argv=None):
             "HOROVOD_TPU_RANK": str(pidx * rpp),
             "HOROVOD_TPU_LOCAL_SIZE": str(rpp),
         })
+        if args.metrics_every > 0:
+            env["HOROVOD_TPU_METRICS_EVERY_S"] = str(args.metrics_every)
+        if args.metrics_port > 0:
+            env["HOROVOD_TPU_METRICS_PORT"] = str(args.metrics_port)
         procs.append(subprocess.Popen(cmd, env=env))
 
     # Fast-fail supervision (mpirun semantics): poll ALL children

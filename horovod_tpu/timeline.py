@@ -118,6 +118,23 @@ class Timeline:
             self._emit({"ph": "E", "pid": self._pid(e.name),
                         "ts": self._ts_us()})
 
+    # ------------------------------------------------------------- counters
+
+    def counter(self, name: str, value: int) -> None:
+        """Chrome-trace counter sample (``"ph": "C"``): Perfetto renders
+        each named series as a rate track alongside the spans (queue
+        depth, bytes in flight).  Counters live on pid 0 — they are
+        job-level series, not per-tensor ones."""
+        self._emit({"ph": "C", "pid": 0, "ts": self._ts_us(),
+                    "name": name, "args": {"value": int(value)}})
+
+    def flush(self) -> None:
+        """Force buffered events to disk — abort paths call this so a
+        trace survives even when the process dies mid-run."""
+        with self._lock:
+            if not self._closed:
+                self._file.flush()
+
     def close(self):
         with self._lock:
             if not self._closed:

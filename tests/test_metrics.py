@@ -1,0 +1,291 @@
+"""Unified metrics registry: Python registry semantics, the Prometheus
+text rendering, the native/controller merge, the exporters, and (slow) a
+2-process run proving the per-dtype bytes-on-wire counters reconcile
+exactly with the ring data plane's transport totals.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import pytest
+
+from horovod_tpu import cpp_core
+from horovod_tpu import metrics as hm
+
+
+@pytest.fixture()
+def registry():
+    r = hm.MetricsRegistry()
+    yield r
+
+
+# ------------------------------------------------------------ registry
+
+
+class TestRegistry:
+    def test_counter_semantics(self, registry):
+        registry.inc("a")
+        registry.inc("a", 4)
+        registry.inc("b#wire=int8", 7)
+        snap = registry.snapshot()
+        assert snap["counters"] == {"a": 5, "b#wire=int8": 7}
+
+    def test_gauge_overwrites(self, registry):
+        registry.set_gauge("g", 1.5)
+        registry.set_gauge("g", 2.5)
+        assert registry.snapshot()["gauges"] == {"g": 2.5}
+
+    def test_histogram_buckets(self, registry):
+        # bounds (1, 2, 4): values land in the first bucket whose bound
+        # is >= value; anything past the last bound goes to +Inf.
+        for v in (0.5, 1.0, 3.0, 100.0):
+            registry.observe("h", v, bounds=(1, 2, 4))
+        h = registry.snapshot()["histograms"]["h"]
+        assert h["bounds"] == [1, 2, 4]
+        assert h["counts"] == [2, 0, 1, 1]   # 0.5+1.0 | - | 3.0 | 100.0
+        assert h["count"] == 4
+        assert h["sum"] == pytest.approx(104.5)
+
+    def test_histogram_matches_native_shape(self, registry):
+        registry.observe("t", 1e-3)
+        h = registry.snapshot()["histograms"]["t"]
+        assert len(h["counts"]) == len(h["bounds"]) + 1
+        assert list(h["bounds"]) == list(hm.DEFAULT_SECONDS_BOUNDS)
+
+    def test_clear(self, registry):
+        registry.inc("a")
+        registry.observe("h", 1.0)
+        registry.clear()
+        snap = registry.snapshot()
+        assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+# ----------------------------------------------------- prometheus text
+
+
+class TestPrometheusText:
+    def test_counters_and_labels(self):
+        snap = {"counters": {"ring.allreduce.bytes_sent#wire=int8": 123,
+                             "control.ticks": 9},
+                "gauges": {}, "histograms": {}}
+        txt = hm.prometheus_text(snap)
+        assert '# TYPE htpu_ring_allreduce_bytes_sent counter' in txt
+        assert 'htpu_ring_allreduce_bytes_sent{wire="int8"} 123' in txt
+        assert "htpu_control_ticks 9" in txt
+
+    def test_type_header_once_per_family(self):
+        snap = {"counters": {"ops#type=a": 1, "ops#type=b": 2},
+                "gauges": {}, "histograms": {}}
+        txt = hm.prometheus_text(snap)
+        assert txt.count("# TYPE htpu_ops counter") == 1
+
+    def test_histogram_is_cumulative_with_inf(self):
+        snap = {"counters": {}, "gauges": {},
+                "histograms": {"lat": {"bounds": [1, 2], "counts": [3, 1, 2],
+                                       "sum": 9.5, "count": 6}}}
+        txt = hm.prometheus_text(snap)
+        assert 'htpu_lat_bucket{le="1"} 3' in txt
+        assert 'htpu_lat_bucket{le="2"} 4' in txt
+        assert 'htpu_lat_bucket{le="+Inf"} 6' in txt
+        assert "htpu_lat_sum 9.5" in txt
+        assert "htpu_lat_count 6" in txt
+
+    def test_parses_as_exposition_format(self):
+        hm.registry.inc("test.parse#k=v")
+        hm.registry.observe("test.parse_lat", 0.01)
+        try:
+            for line in hm.prometheus_text().splitlines():
+                if line.startswith("#"):
+                    _, _, name, kind = line.split(" ", 3)
+                    assert kind in ("counter", "gauge", "histogram")
+                    continue
+                name_labels, _, value = line.rpartition(" ")
+                float(value)   # every sample value is numeric
+                assert name_labels and name_labels[0].isalpha()
+        finally:
+            hm.registry.clear()
+
+
+# ------------------------------------------------------- merge + native
+
+
+class TestSnapshotMerge:
+    def test_merges_both_sources(self, monkeypatch):
+        monkeypatch.setattr(
+            hm, "native_snapshot",
+            lambda: {"counters": {"native.c": 1}, "gauges": {},
+                     "histograms": {}})
+        hm.registry.inc("py.c", 2)
+        try:
+            snap = hm.snapshot()
+        finally:
+            hm.registry.clear()
+        assert snap["counters"]["native.c"] == 1
+        assert snap["counters"]["py.c"] == 2
+        assert "ts" in snap and "rank" in snap
+
+    def test_hvd_metrics_is_callable_module(self):
+        import horovod_tpu as hvd
+        snap = hvd.metrics()
+        assert set(snap) >= {"counters", "gauges", "histograms"}
+        # the machinery stays reachable through the same name
+        assert hvd.metrics.registry is hm.registry
+
+    @pytest.mark.skipif(not cpp_core.available(),
+                        reason="native core not built")
+    def test_native_snapshot_shape(self):
+        snap = cpp_core.metrics_snapshot()
+        assert set(snap) == {"counters", "gauges", "histograms"}
+        for h in snap["histograms"].values():
+            assert len(h["counts"]) == len(h["bounds"]) + 1
+
+
+# ------------------------------------------------------------ exporters
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestExporters:
+    def test_jsonl_emitter(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        em = hm._Emitter(0.05, str(path))
+        em.start()
+        import time
+        time.sleep(0.2)
+        em.stop()
+        lines = path.read_text().splitlines()
+        assert lines, "emitter wrote nothing"
+        for line in lines:
+            snap = json.loads(line)
+            assert set(snap) >= {"counters", "gauges", "histograms", "ts"}
+
+    def test_http_endpoint(self):
+        port = _free_port()
+        server = hm._make_http_server(port)
+        import threading
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                body = r.read().decode()
+            for line in body.splitlines():
+                if line and not line.startswith("#"):
+                    float(line.rpartition(" ")[2])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10) as r:
+                raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------- slow: wire reconciliation
+
+
+METRICS_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+
+    # Exercise every counted ring path: allreduce per wire dtype,
+    # allgather, broadcast.
+    for wire in ("none", "bf16", "int8"):
+        x = np.full(4096, float(rank + 1), np.float32)
+        out = np.asarray(hvd.allreduce(x, average=False,
+                                       name=f"m.{wire}", compression=wire))
+        np.testing.assert_allclose(out, sum(range(1, n + 1)), rtol=0.01)
+    hvd.allgather(np.full((rank + 1, 2), 1.0, np.float32), name="m.gather")
+    hvd.broadcast(np.ones(16, np.float32), root_rank=0, name="m.bcast")
+
+    from horovod_tpu import basics
+    sent, recvd = basics.controller()._control.data_bytes()
+    c = hvd.metrics()["counters"]
+
+    # Per-dtype counters are non-zero for every wire that ran...
+    for wire in ("fp32", "bf16", "int8"):
+        key = f"ring.allreduce.bytes_sent#wire={wire}"
+        assert c.get(key, 0) > 0, (key, c)
+    # ...and their sum reconciles EXACTLY with the transport's own
+    # data-plane totals (the counters are incremented at the same sites).
+    ring_sent = sum(v for k, v in c.items()
+                    if k.startswith("ring.") and ".bytes_sent" in k)
+    ring_recvd = sum(v for k, v in c.items()
+                     if k.startswith("ring.") and ".bytes_recv" in k)
+    assert ring_sent == sent, (ring_sent, sent, c)
+    assert ring_recvd == recvd, (ring_recvd, recvd, c)
+    # int8 moved ~1/4 the bytes of the raw fp32 pass on the same payload.
+    ratio = (c["ring.allreduce.bytes_sent#wire=int8"]
+             / c["ring.allreduce.bytes_sent#wire=fp32"])
+    assert ratio < 0.5, ratio
+    # Frame accounting saw real traffic too.
+    assert c.get("transport.frames_sent", 0) > 0
+    assert c.get("control.ticks", 0) > 0
+
+    print(f"WORKER_OK rank={rank} sent={sent}")
+    hvd.shutdown()
+
+    # The emitter's final line (written on stop) carries the same counters.
+    path = os.environ["HOROVOD_TPU_METRICS_FILE"]
+    last = json.loads(open(path).read().splitlines()[-1])
+    assert last["counters"].get(
+        "ring.allreduce.bytes_sent#wire=int8", 0) > 0, last
+    print(f"JSONL_OK rank={rank}")
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not cpp_core.available(), reason="native core not built")
+def test_wire_bytes_reconcile_two_processes(tmp_path):
+    port = _free_port()
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": "2",
+            "HOROVOD_TPU_SIZE": "2",
+            "HOROVOD_TPU_RANK": str(i),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_METRICS_EVERY_S": "0.2",
+            "HOROVOD_TPU_METRICS_FILE": str(tmp_path / f"m.{i}.jsonl"),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        env.pop("HOROVOD_TPU_WIRE_DTYPE", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", METRICS_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, out
+        assert "WORKER_OK" in out, out
+        assert "JSONL_OK" in out, out

@@ -1,0 +1,108 @@
+"""Timeline format guarantees: the trace parses as JSON, per-tensor pid
+metadata is emitted exactly once, counter tracks use the Chrome-trace
+counter phase, wire-tagged activity names, and both implementations
+(Python fallback and the native writer) agree.
+"""
+
+import json
+
+import pytest
+
+from horovod_tpu import cpp_core
+from horovod_tpu.core import RequestType, ResponseType
+from horovod_tpu.timeline import Timeline, wire_activity
+
+
+class _Entry:
+    def __init__(self, name):
+        self.name = name
+
+
+def load_trace(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class TestWireActivity:
+    def test_compressed_wire_is_tagged(self):
+        assert wire_activity("TCP_ALLREDUCE", "int8") == "TCP_ALLREDUCE[int8]"
+        assert wire_activity("TCP_ALLREDUCE", "bf16") == "TCP_ALLREDUCE[bf16]"
+
+    def test_raw_fp32_stays_bare(self):
+        # Pre-compression traces must stay comparable: no [fp32] suffix.
+        assert wire_activity("TCP_ALLREDUCE", "") == "TCP_ALLREDUCE"
+
+
+class TestPythonTimeline:
+    def test_trace_parses_and_pid_metadata_once(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path))
+        for _ in range(3):   # repeated spans must not repeat the metadata
+            tl.negotiate_start("grad.0", RequestType.ALLREDUCE)
+            tl.negotiate_rank_ready("grad.0", 0)
+            tl.negotiate_end("grad.0")
+            tl.start("grad.0", ResponseType.ALLREDUCE)
+            tl.activity_start_all([_Entry("grad.0")], "XLA_ALLREDUCE")
+            tl.activity_end_all([_Entry("grad.0")])
+            tl.end("grad.0")
+        tl.start("grad.1", ResponseType.ALLGATHER)
+        tl.end("grad.1")
+        tl.close()
+
+        events = load_trace(path)
+        assert isinstance(events, list) and events
+        names = [e for e in events if e.get("name") == "process_name"]
+        assert len(names) == 2   # exactly once per tensor
+        by_pid = {e["pid"]: e["args"]["name"] for e in names}
+        assert sorted(by_pid.values()) == ["grad.0", "grad.1"]
+        sorts = [e for e in events if e.get("name") == "process_sort_index"]
+        assert len(sorts) == 2
+
+    def test_counter_events(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path))
+        tl.counter("queue_depth", 3)
+        tl.counter("bytes_in_flight", 4096)
+        tl.flush()
+        tl.close()
+        counters = [e for e in load_trace(path) if e.get("ph") == "C"]
+        assert len(counters) == 2
+        for e in counters:
+            assert e["pid"] == 0          # job-level track, not per-tensor
+            assert isinstance(e["args"]["value"], int)
+        assert {e["name"] for e in counters} == {"queue_depth",
+                                                 "bytes_in_flight"}
+
+    def test_close_is_idempotent(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path))
+        tl.counter("queue_depth", 1)
+        tl.close()
+        tl.close()            # atexit guard may close after stop()
+        tl.counter("queue_depth", 2)   # late event must be a no-op
+        events = load_trace(path)
+        assert len([e for e in events if e.get("ph") == "C"]) == 1
+
+
+@pytest.mark.skipif(not cpp_core.available(), reason="native core not built")
+class TestNativeTimeline:
+    def test_same_format_as_python(self, tmp_path):
+        path = tmp_path / "native.json"
+        tl = cpp_core.CppTimeline(str(path))
+        tl.negotiate_start("grad.0", int(RequestType.ALLREDUCE))
+        tl.negotiate_rank_ready("grad.0", 0)
+        tl.negotiate_end("grad.0")
+        tl.start("grad.0", int(ResponseType.ALLREDUCE))
+        tl.end("grad.0")
+        tl.counter("queue_depth", 2)
+        tl.flush()
+        tl.close()
+        events = load_trace(path)
+        names = [e for e in events if e.get("name") == "process_name"]
+        assert len(names) == 1
+        assert names[0]["args"]["name"] == "grad.0"
+        counters = [e for e in events if e.get("ph") == "C"]
+        assert len(counters) == 1
+        assert counters[0]["name"] == "queue_depth"
+        assert counters[0]["args"]["value"] == 2
+        assert counters[0]["pid"] == 0

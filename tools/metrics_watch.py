@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Tail and pretty-print per-rank metrics JSONL streams.
+
+The JSONL emitter (``HOROVOD_TPU_METRICS_EVERY_S``, see
+docs/observability.md) appends one snapshot line per interval per rank.
+This tool follows any number of those files and renders a compact,
+rate-annotated view — counters show both the absolute value and the
+delta/s since the previous snapshot of the same rank.
+
+    python tools/metrics_watch.py horovod_tpu_metrics.*.jsonl
+    python tools/metrics_watch.py --once horovod_tpu_metrics.0.jsonl
+    python tools/metrics_watch.py --filter ring. m.0.jsonl m.1.jsonl
+
+Stdlib only, like the exporters it watches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def fmt_value(name: str, value: float, rate=None) -> str:
+    is_bytes = "bytes" in name
+    text = human_bytes(value) if is_bytes else f"{value:g}"
+    if rate is not None and rate > 0:
+        text += (f"  (+{human_bytes(rate)}/s)" if is_bytes
+                 else f"  (+{rate:g}/s)")
+    return text
+
+
+def render(snap: dict, prev: dict | None, name_filter: str) -> str:
+    rank = snap.get("rank", "?")
+    ts = snap.get("ts")
+    dt = (ts - prev["ts"]) if (prev and ts and prev.get("ts")) else None
+    when = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "--"
+    lines = [f"── rank {rank} @ {when} " + "─" * 40]
+
+    counters = snap.get("counters", {})
+    prev_counters = (prev or {}).get("counters", {})
+    for name in sorted(counters):
+        if name_filter and name_filter not in name:
+            continue
+        rate = None
+        if dt and dt > 0 and name in prev_counters:
+            rate = (counters[name] - prev_counters[name]) / dt
+        lines.append(f"  {name:<52} {fmt_value(name, counters[name], rate)}")
+
+    for name in sorted(snap.get("gauges", {})):
+        if name_filter and name_filter not in name:
+            continue
+        lines.append(
+            f"  {name:<52} {fmt_value(name, snap['gauges'][name])}")
+
+    for name in sorted(snap.get("histograms", {})):
+        if name_filter and name_filter not in name:
+            continue
+        h = snap["histograms"][name]
+        count = h.get("count", 0)
+        mean = (h.get("sum", 0.0) / count) if count else 0.0
+        lines.append(f"  {name:<52} n={count} mean={mean:.3g}")
+    return "\n".join(lines)
+
+
+def follow(paths, once: bool, name_filter: str, poll_s: float) -> int:
+    # Per-file read offset and last two parsed snapshots (for rates).
+    offsets = {p: 0 for p in paths}
+    last: dict = {p: None for p in paths}
+
+    while True:
+        printed = False
+        for path in paths:
+            try:
+                with open(path) as f:
+                    f.seek(offsets[path])
+                    chunk = f.read()
+                    offsets[path] = f.tell()
+            except OSError:
+                continue
+            fresh = []
+            for line in chunk.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    fresh.append(json.loads(line))
+                except ValueError:
+                    continue   # torn line mid-write; picked up next poll
+            if not fresh:
+                continue
+            if once:
+                # Only the newest snapshot matters; the one before it
+                # (when present) supplies the rates.
+                prev = fresh[-2] if len(fresh) > 1 else last[path]
+                print(render(fresh[-1], prev, name_filter))
+            else:
+                for snap in fresh:
+                    print(render(snap, last[path], name_filter))
+                    last[path] = snap
+            printed = True
+        if once:
+            return 0 if printed else 1
+        try:
+            time.sleep(poll_s)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Tail and pretty-print horovod_tpu metrics JSONL "
+                    "files (see docs/observability.md).")
+    p.add_argument("files", nargs="+", help="per-rank .jsonl files")
+    p.add_argument("--once", action="store_true",
+                   help="print the latest snapshot per file and exit")
+    p.add_argument("--filter", default="", metavar="SUBSTR",
+                   help="only show metric names containing this substring")
+    p.add_argument("--poll", type=float, default=1.0,
+                   help="poll interval in seconds when following")
+    args = p.parse_args(argv)
+    return follow(args.files, args.once, args.filter, args.poll)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
