@@ -210,6 +210,12 @@ HTPU_API void htpu_timeline_counter(void* tl, const char* name,
   static_cast<htpu::Timeline*>(tl)->Counter(name, value);
 }
 
+// Complete-event span marking a negotiation tick served entirely from the
+// response cache (distinct from NEGOTIATE_* spans in the trace viewer).
+HTPU_API void htpu_timeline_cache_hit_tick(void* tl, long long dur_us) {
+  static_cast<htpu::Timeline*>(tl)->CacheHitTick(dur_us);
+}
+
 HTPU_API void htpu_timeline_flush(void* tl) {
   static_cast<htpu::Timeline*>(tl)->Flush();
 }

@@ -95,9 +95,19 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
   }
   cp->heartbeat_ms_ = int(std::min<long long>(hb_s * 1000LL, timeout_ms));
   cp->ParseFaultEnv();
+  // Negotiation response cache (0 disables; frames then stay byte-identical
+  // to the pre-cache wire format and ticks run the exact legacy path).
+  long cache_cap = 1024;
+  if (const char* e = getenv("HOROVOD_TPU_CACHE_CAPACITY")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v >= 0) cache_cap = v;
+  }
+  cp->cache_capacity_ = cache_cap;
 
   if (process_index == 0) {
     cp->table_.reset(new MessageTable(nranks_total));
+    cp->cache_.reset(new ResponseCache(cache_cap, process_count));
     if (process_count > 1) {
       cp->listen_fd_ = Listen(coord_port, nullptr);
       if (cp->listen_fd_ < 0) return nullptr;
@@ -374,8 +384,23 @@ void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
   aborted_ = true;
   abort_rank_ = rank;
   abort_reason_ = reason;
+  // Cached response sets and slot assignments are dead with the job —
+  // a restarted control plane must renegotiate everything from scratch.
+  CacheFlushAll();
   Metrics::Get().Counter("control.aborts")->fetch_add(
       1, std::memory_order_relaxed);
+}
+
+void ControlPlane::CacheFlushAll() {
+  cache_client_slots_.clear();
+  cache_client_index_.clear();
+  cache_last_sent_.clear();
+  cache_set_.clear();
+  cache_bits_in_flight_.clear();
+  cache_compressed_in_flight_.clear();
+  cache_resend_.clear();
+  if (cache_) cache_->Flush();
+  cache_sets_broadcast_.clear();
 }
 
 void ControlPlane::SerializeAbort(std::string* blob) const {
@@ -422,6 +447,162 @@ bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
   return false;
 }
 
+// ----------------------------------------------------- response cache client
+
+void ControlPlane::CompressRequestFrame(const std::string& in,
+                                        std::string* out) {
+  *out = in;
+  cache_bits_in_flight_.clear();
+  cache_compressed_in_flight_.clear();
+  if (!CacheEnabled()) return;
+  static std::atomic<long long>* hits =
+      Metrics::Get().Counter("control.cache_hits");
+  static std::atomic<long long>* misses =
+      Metrics::Get().Counter("control.cache_misses");
+  RequestList list;
+  if (!ParseRequestList(reinterpret_cast<const uint8_t*>(in.data()),
+                        in.size(), &list)) {
+    return;   // corrupt frames pass through verbatim; the receiver rejects
+  }
+  bool resent = !cache_resend_.empty();
+  if (resent) {
+    // Requests whose bits a flush dropped go out again as full requests,
+    // ahead of this tick's fresh work (they are older).
+    list.requests.insert(list.requests.begin(),
+                         std::make_move_iterator(cache_resend_.begin()),
+                         std::make_move_iterator(cache_resend_.end()));
+    cache_resend_.clear();
+  }
+  if (list.shutdown || list.abort_rank >= 0) {
+    // Control frames bypass compression entirely.
+    if (resent) SerializeRequestList(list, out);
+    return;
+  }
+  if (list.requests.empty()) return;   // idle tick: verbatim, no extension
+  // Serialized request group per name, in first-appearance order — the
+  // byte-exact hit test against the group each client slot was assigned
+  // from (shape / dtype / wire-dtype / root / device changes all miss).
+  std::vector<std::string> order;
+  std::unordered_map<std::string, std::string> sigs;
+  for (const Request& r : list.requests) {
+    auto ins = sigs.emplace(r.tensor_name, std::string());
+    if (ins.second) order.push_back(r.tensor_name);
+    SerializeRequest(r, &ins.first->second);
+  }
+  std::unordered_set<std::string> hit_names;
+  int32_t max_slot = -1;
+  std::vector<int32_t> hit_slots;
+  for (const auto& name : order) {
+    auto it = cache_client_index_.find(name);
+    if (it != cache_client_index_.end() &&
+        cache_client_slots_[it->second].second == sigs[name]) {
+      hit_names.insert(name);
+      hit_slots.push_back(it->second);
+      if (it->second > max_slot) max_slot = it->second;
+    } else {
+      cache_last_sent_[name] = std::move(sigs[name]);
+    }
+  }
+  hits->fetch_add(long(hit_names.size()), std::memory_order_relaxed);
+  misses->fetch_add(long(order.size() - hit_names.size()),
+                    std::memory_order_relaxed);
+  if (hit_slots.empty() && !resent) return;   // untouched: out == in
+  RequestList outl;
+  outl.shutdown = list.shutdown;
+  outl.abort_rank = list.abort_rank;
+  outl.abort_reason = list.abort_reason;
+  // Stragglers keep their original submission order (fusion-plan
+  // determinism); hit names compress to bits and are remembered for a
+  // flush-triggered resend.
+  for (Request& r : list.requests) {
+    if (hit_names.count(r.tensor_name)) {
+      cache_compressed_in_flight_.push_back(std::move(r));
+    } else {
+      outl.requests.push_back(std::move(r));
+    }
+  }
+  if (!hit_slots.empty()) {
+    outl.has_cache_ext = true;
+    outl.cache_epoch = cache_client_epoch_;
+    outl.cache_bits.assign(size_t(max_slot / 8 + 1), '\0');
+    for (int32_t s : hit_slots)
+      outl.cache_bits[size_t(s / 8)] |= char(1 << (s % 8));
+    cache_bits_in_flight_ = outl.cache_bits;
+  }
+  SerializeRequestList(outl, out);
+}
+
+bool ControlPlane::ApplyResponseFrame(const ResponseList& parsed,
+                                      std::string* blob) {
+  if (!CacheEnabled()) return true;
+  if (parsed.abort_rank >= 0) return true;   // LatchAbort flushes instead
+  if (parsed.has_cache_ext) {
+    if (parsed.cache_flags & kCacheServed) {
+      auto it = cache_set_.find(cache_bits_in_flight_);
+      if (cache_bits_in_flight_.empty() || it == cache_set_.end()) {
+        return false;   // nothing stored to replay: protocol error
+      }
+      *blob = it->second;
+      cache_client_epoch_ = parsed.cache_epoch;
+      cache_compressed_in_flight_.clear();
+      cache_bits_in_flight_.clear();
+      return true;
+    }
+    if (parsed.cache_flags & kCacheFlush) {
+      cache_client_slots_.clear();
+      cache_client_index_.clear();
+      // The bits we compressed this tick were dropped with the server's
+      // slot table — resend them as full requests next tick so no
+      // negotiation strands (deadlock safety under epoch divergence).
+      for (Request& r : cache_compressed_in_flight_)
+        cache_resend_.push_back(std::move(r));
+      cache_compressed_in_flight_.clear();
+    }
+    for (int32_t s : parsed.cache_evictions) {
+      auto it = cache_client_slots_.find(s);
+      if (it != cache_client_slots_.end()) {
+        cache_client_index_.erase(it->second.first);
+        cache_client_slots_.erase(it);
+      }
+    }
+    for (const auto& a : parsed.cache_assignments) {
+      auto ls = cache_last_sent_.find(a.second);
+      if (ls == cache_last_sent_.end()) continue;  // heals via divergence evict
+      cache_client_index_[a.second] = a.first;
+      cache_client_slots_[a.first] = {a.second, std::move(ls->second)};
+      cache_last_sent_.erase(ls);
+    }
+    if ((parsed.cache_flags & kCacheFlush) || !parsed.cache_evictions.empty()
+        || !parsed.cache_assignments.empty()) {
+      cache_set_.clear();   // slot mutation: bit-key meaning changed
+    }
+    if ((parsed.cache_flags & kCacheStoreSet) &&
+        !cache_bits_in_flight_.empty()) {
+      // Store the set as a plain (extension-free) frame so replayed blobs
+      // are byte-identical to an uncached tick's response.
+      ResponseList clean = parsed;
+      clean.has_cache_ext = false;
+      clean.cache_epoch = 0;
+      clean.cache_flags = 0;
+      clean.cache_assignments.clear();
+      clean.cache_evictions.clear();
+      std::string cb;
+      SerializeResponseList(clean, &cb);
+      if (cache_set_.size() >= 16) cache_set_.clear();  // bounded, rebuilt fast
+      cache_set_[cache_bits_in_flight_] = std::move(cb);
+    }
+    cache_client_epoch_ = parsed.cache_epoch;
+  }
+  // Names whose response landed without an assignment never got a slot
+  // this round — drop the sig record so the map stays bounded by
+  // in-flight names.
+  for (const auto& r : parsed.responses)
+    for (const auto& n : r.tensor_names) cache_last_sent_.erase(n);
+  cache_compressed_in_flight_.clear();
+  cache_bits_in_flight_.clear();
+  return true;
+}
+
 // --------------------------------------------------------------------- tick
 
 bool ControlPlane::Tick(const std::string& request_list_blob,
@@ -430,6 +611,8 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   ScopedTimer tick_timer("control.tick_seconds");
   static std::atomic<long long>* ticks =
       Metrics::Get().Counter("control.ticks");
+  static std::atomic<long long>* neg_bytes =
+      Metrics::Get().Counter("control.negotiation_bytes");
   ticks->fetch_add(1, std::memory_order_relaxed);
   ++tick_count_;
   MaybeInjectFault();
@@ -441,8 +624,11 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   }
 
   if (!is_coordinator()) {
-    // Worker: send our request list, wait for the response list.
-    if (!SendFrame(coord_fd_, request_list_blob) ||
+    // Worker: send our (bit-compressed when cached) request list, wait for
+    // the response list.
+    std::string frame;
+    CompressRequestFrame(request_list_blob, &frame);
+    if (!SendFrame(coord_fd_, frame) ||
         !RecvFrame(coord_fd_, response_list_blob, timeout_ms_)) {
       // Coordinator link gone: synthesize a local abort naming process 0
       // so waiters get an attributed error, not a generic tick failure.
@@ -454,13 +640,22 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       SerializeAbort(response_list_blob);
       return true;
     }
+    neg_bytes->fetch_add(
+        (long long)(frame.size() + response_list_blob->size()),
+        std::memory_order_relaxed);
     // Latch a broadcast ABORT natively so the data plane fails fast too.
     ResponseList parsed;
     if (ParseResponseList(
             reinterpret_cast<const uint8_t*>(response_list_blob->data()),
-            response_list_blob->size(), &parsed) &&
-        parsed.abort_rank >= 0) {
-      LatchAbort(parsed.abort_rank, parsed.abort_reason);
+            response_list_blob->size(), &parsed)) {
+      if (parsed.abort_rank >= 0) {
+        LatchAbort(parsed.abort_rank, parsed.abort_reason);
+      } else if (!ApplyResponseFrame(parsed, response_list_blob)) {
+        LatchAbort(first_rank_,
+                   "response cache protocol error: coordinator replayed a "
+                   "set this worker never stored");
+        SerializeAbort(response_list_blob);
+      }
     }
     return true;
   }
@@ -471,41 +666,50 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   // a healthy worker ticks every cycle even when idle, so silence for
   // heartbeat_ms_ means the worker crashed (EOF, detected instantly) or
   // hung.  Either way the job aborts with attribution instead of every
-  // rank timing out separately with no cause.
+  // rank timing out separately with no cause.  Frames are kept per process
+  // (not merged) so the response cache can expand each process's slot bits
+  // against that process's stored requests.
   bool shutdown = false;
   int32_t abort_rank = -1;
   std::string abort_reason;
-  std::vector<Request> all_requests;
-
-  auto absorb = [&](const std::string& blob) -> bool {
-    RequestList list;
+  std::vector<RequestList> frames(static_cast<size_t>(process_count_));
+  {
+    // The coordinator is a cache client of its own frame too, so a steady
+    // state tick sees P uniform bits-only frames.
+    std::string self_frame;
+    CompressRequestFrame(request_list_blob, &self_frame);
     if (!ParseRequestList(
-            reinterpret_cast<const uint8_t*>(blob.data()), blob.size(),
-            &list)) {
+            reinterpret_cast<const uint8_t*>(self_frame.data()),
+            self_frame.size(), &frames[0])) {
       return false;
     }
-    shutdown = shutdown || list.shutdown;
-    if (list.abort_rank >= 0 && abort_rank < 0) {
-      // A worker reported a local transport/executor failure.
-      abort_rank = list.abort_rank;
-      abort_reason = list.abort_reason;
+    shutdown = frames[0].shutdown;
+    if (frames[0].abort_rank >= 0) {
+      abort_rank = frames[0].abort_rank;
+      abort_reason = frames[0].abort_reason;
     }
-    for (auto& r : list.requests) all_requests.push_back(std::move(r));
-    return true;
-  };
-
-  if (!absorb(request_list_blob)) return false;
+  }
   auto gather_t0 = std::chrono::steady_clock::now();
   for (int i = 1; i < process_count_ && abort_rank < 0; ++i) {
     std::string blob;
     if (!RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_) ||
-        !absorb(blob)) {
+        !ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
+                          blob.size(), &frames[size_t(i)])) {
       abort_rank = worker_first_rank_[size_t(i)];
       abort_reason =
           "rank " + std::to_string(abort_rank) + " (process " +
           std::to_string(i) + ") missed the " +
           std::to_string(heartbeat_ms_ / 1000) +
           "s heartbeat deadline (crashed, hung, or sent a corrupt frame)";
+    } else {
+      neg_bytes->fetch_add((long long)blob.size(),
+                           std::memory_order_relaxed);
+      shutdown = shutdown || frames[size_t(i)].shutdown;
+      if (frames[size_t(i)].abort_rank >= 0 && abort_rank < 0) {
+        // A worker reported a local transport/executor failure.
+        abort_rank = frames[size_t(i)].abort_rank;
+        abort_reason = frames[size_t(i)].abort_reason;
+      }
     }
   }
   {
@@ -544,9 +748,134 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   // mid-loop.  A stale non-null value is safe — the writer is closed,
   // not destroyed, and closed writers no-op under their own mutex.
   Timeline* timeline = timeline_.load(std::memory_order_acquire);
+
+  // ---- response cache: server half ----
+  bool cache_flush = false;
+  std::vector<int32_t> evictions;
+  std::vector<std::pair<int32_t, std::string>> assignments;
+  static std::atomic<long long>* cache_evs =
+      Metrics::Get().Counter("control.cache_evictions");
+  if (CacheEnabled()) {
+    // Epoch or bit-validity divergence (cannot happen in the lockstep
+    // protocol; defensive): drop the whole slot table and have every
+    // client resend its compressed names as full requests next tick —
+    // nothing strands, the cache just rebuilds.
+    for (const auto& f : frames) {
+      if (f.has_cache_ext && (f.cache_epoch != cache_->epoch() ||
+                              !cache_->Validate(f.cache_bits))) {
+        cache_flush = true;
+        break;
+      }
+    }
+    if (cache_flush) {
+      cache_evs->fetch_add((long long)cache_->Flush(),
+                           std::memory_order_relaxed);
+      cache_sets_broadcast_.clear();
+      for (auto& f : frames) {
+        f.has_cache_ext = false;
+        f.cache_bits.clear();
+      }
+    } else {
+      // Fast path: P uniform bits-only frames over an empty table whose
+      // full response set already went out with kCacheStoreSet.  Skip
+      // request-list construction, fusion planning and response
+      // serialization entirely: every rank (this one included) replays
+      // its stored fused responses.
+      bool fast = !shutdown && table_->NumPending() == 0;
+      for (const auto& f : frames) {
+        if (!f.has_cache_ext || f.cache_bits.empty() ||
+            !f.requests.empty() ||
+            f.cache_bits != frames[0].cache_bits) {
+          fast = false;
+          break;
+        }
+      }
+      if (fast && cache_sets_broadcast_.count(frames[0].cache_bits)) {
+        cache_->Touch(frames[0].cache_bits, tick_count_);
+        ResponseList mini;
+        mini.has_cache_ext = true;
+        mini.cache_epoch = cache_->epoch();
+        mini.cache_flags = kCacheServed;
+        SerializeResponseList(mini, response_list_blob);
+        // Clock gather-done -> response-blob-ready: the pre-gather span
+        // is waiting on peers and the post-serialize span is the
+        // broadcast write — both identical either way, and either would
+        // drown the construction/fusion/serialization work the cache
+        // actually skips.
+        double dur = std::chrono::duration<double>(
+                         std::chrono::steady_clock::now() -
+                         last_gather_done_)
+                         .count();
+        Metrics::Get().Observe("control.tick_seconds#cached=1", dur);
+        if (timeline) timeline->CacheHitTick(int64_t(dur * 1e6));
+        if (!BroadcastResponse(response_list_blob)) return true;
+        if (!ApplyResponseFrame(mini, response_list_blob)) {
+          LatchAbort(first_rank_,
+                     "response cache protocol error: coordinator lost its "
+                     "own stored response set");
+          SerializeAbort(response_list_blob);
+          return true;
+        }
+        return true;
+      }
+    }
+  }
+
+  // Expand every frame's slot bits into the stored per-process requests
+  // (ascending slot order, ahead of that frame's stragglers — the same
+  // order the warmup tick negotiated in, so the fusion plan replays
+  // identically).  Then evict slots named by a FULL request (the sender's
+  // serialized group diverged: shape/dtype/wire-dtype change) — after
+  // expansion, since other processes' bits still reference them — letting
+  // full negotiation and a fresh assignment take over for that name.
+  std::vector<std::vector<Request>> expanded(
+      static_cast<size_t>(process_count_));
+  if (CacheEnabled() && !cache_flush) {
+    for (int p = 0; p < process_count_; ++p) {
+      const auto& f = frames[size_t(p)];
+      if (f.has_cache_ext && !f.cache_bits.empty()) {
+        cache_->Expand(f.cache_bits, p, &expanded[size_t(p)], tick_count_);
+      }
+    }
+    std::unordered_set<std::string> diverged;
+    for (const auto& f : frames) {
+      for (const auto& r : f.requests) {
+        if (cache_->SlotOf(r.tensor_name) >= 0 &&
+            diverged.insert(r.tensor_name).second) {
+          cache_->Evict(r.tensor_name, &evictions);
+        }
+      }
+    }
+  }
+
+  const bool track_cache = CacheEnabled() && !cache_flush && !shutdown;
+  std::vector<Request> all_requests;
+  std::vector<int> req_process;
+  for (int p = 0; p < process_count_; ++p) {
+    for (auto& r : expanded[size_t(p)]) {
+      all_requests.push_back(std::move(r));
+      req_process.push_back(p);
+    }
+    for (auto& r : frames[size_t(p)].requests) {
+      all_requests.push_back(std::move(r));
+      req_process.push_back(p);
+    }
+  }
+
+  // Per-tick provenance for cache assignment: a name becomes cacheable
+  // only when EVERY process contributed its requests in this same tick
+  // (multi-tick stragglers would pin stale groups into the slot store).
+  std::unordered_map<std::string, std::vector<std::vector<Request>>> contrib;
+  std::vector<std::string> ready_ok;   // non-ERROR completions, in order
   std::unordered_map<std::string, Request> first_request;
-  for (const Request& r : all_requests) {
+  for (size_t qi = 0; qi < all_requests.size(); ++qi) {
+    const Request& r = all_requests[qi];
     first_request.emplace(r.tensor_name, r);
+    if (track_cache) {
+      auto& c = contrib[r.tensor_name];
+      if (c.empty()) c.resize(size_t(process_count_));
+      c[size_t(req_process[qi])].push_back(r);
+    }
     bool ready;
     try {
       ready = table_->Increment(r);
@@ -580,9 +909,15 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       if (negotiating_.erase(r.tensor_name) && timeline) {
         timeline->NegotiateEnd(r.tensor_name);
       }
-      out.responses.push_back(table_->ConstructResponse(r.tensor_name));
+      Response resp = table_->ConstructResponse(r.tensor_name);
+      if (track_cache && resp.response_type != ResponseType::ERROR) {
+        ready_ok.push_back(r.tensor_name);
+      }
+      out.responses.push_back(std::move(resp));
     }
   }
+  const bool had_errors =
+      track_cache && ready_ok.size() != out.responses.size();
 
   // Fusion: payload sizes derived from the negotiated request shapes.
   auto entry_bytes = [&](const std::string& name) -> int64_t {
@@ -602,7 +937,80 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   Metrics::Get().SetGauge("control.pending_tensors",
                           static_cast<double>(table_->NumPending()));
 
+  if (track_cache) {
+    for (const std::string& name : ready_ok) {
+      if (cache_->SlotOf(name) >= 0) continue;   // named by bits this tick
+      auto& c = contrib[name];
+      bool full = !c.empty();
+      for (const auto& v : c) {
+        if (v.empty()) {
+          full = false;
+          break;
+        }
+      }
+      if (!full) continue;
+      int32_t slot = cache_->Assign(name, std::move(c), tick_count_,
+                                    &evictions);
+      if (slot >= 0) assignments.emplace_back(slot, name);
+    }
+  }
+  if (CacheEnabled()) {
+    cache_evs->fetch_add((long long)evictions.size(),
+                         std::memory_order_relaxed);
+    const bool mutated =
+        cache_flush || !assignments.empty() || !evictions.empty();
+    // Store-set: the normal tick whose frames were ALL bits-only with one
+    // agreed bitvector and whose negotiation fully drained the table with
+    // no errors — its serialized response IS the cached set; every rank
+    // stores it and later identical ticks replay it without this side
+    // ever re-serializing.
+    bool store = track_cache && !mutated && !had_errors &&
+                 !out.responses.empty() && table_->NumPending() == 0;
+    if (store) {
+      for (const auto& f : frames) {
+        if (!f.has_cache_ext || f.cache_bits.empty() ||
+            !f.requests.empty() ||
+            f.cache_bits != frames[0].cache_bits) {
+          store = false;
+          break;
+        }
+      }
+    }
+    if (mutated) cache_sets_broadcast_.clear();
+    if (store) cache_sets_broadcast_.insert(frames[0].cache_bits);
+    if (mutated || store) {
+      out.has_cache_ext = true;
+      out.cache_epoch = cache_->epoch();
+      if (cache_flush) out.cache_flags |= kCacheFlush;
+      if (store) out.cache_flags |= kCacheStoreSet;
+      out.cache_assignments = std::move(assignments);
+      out.cache_evictions = std::move(evictions);
+    }
+  }
+
   SerializeResponseList(out, response_list_blob);
+  if (!out.responses.empty()) {
+    // Same clock span as the cached=1 observation (gather-done ->
+    // response-blob-ready), so the two histograms compare exactly the
+    // work caching skips.
+    Metrics::Get().Observe(
+        "control.tick_seconds#cached=0",
+        std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                      last_gather_done_)
+            .count());
+  }
+  if (!BroadcastResponse(response_list_blob)) return true;
+  if (CacheEnabled()) {
+    // The coordinator applies its own broadcast like any client (slot
+    // adoption + set storage for its local replay path).
+    ApplyResponseFrame(out, response_list_blob);
+  }
+  return true;
+}
+
+bool ControlPlane::BroadcastResponse(std::string* response_list_blob) {
+  static std::atomic<long long>* neg_bytes =
+      Metrics::Get().Counter("control.negotiation_bytes");
   ScopedTimer bcast_timer("control.bcast_seconds");
   for (int i = 1; i < process_count_; ++i) {
     if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) {
@@ -617,8 +1025,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       for (int j = 1; j < process_count_; ++j) {
         if (j != i) SendFrame(worker_fds_[size_t(j)], *response_list_blob);
       }
-      return true;
+      return false;
     }
+    neg_bytes->fetch_add((long long)response_list_blob->size(),
+                         std::memory_order_relaxed);
   }
   return true;
 }

@@ -24,10 +24,12 @@
 #define HTPU_CONTROL_H_
 
 #include <atomic>
+#include <map>
 #include <memory>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
 #include "htpu/message_table.h"
@@ -144,6 +146,26 @@ class ControlPlane {
   bool RingXfer(int send_fd, const char* send_buf, size_t send_len,
                 int recv_fd, char* recv_buf, size_t recv_len);
 
+  // ---- response cache (negotiation bitvector ticks) ----
+  // Client half, run by EVERY process on its own outbound frame (the
+  // coordinator included, on its local blob, so the fast-path check sees
+  // P uniform frames): names whose serialized request group is
+  // byte-identical to the group a slot was assigned from compress to one
+  // bit in the trailing extension; everything else rides as full requests.
+  bool CacheEnabled() const { return cache_capacity_ > 0; }
+  void CompressRequestFrame(const std::string& in, std::string* out);
+  // Apply the response extension to this client: adopt assignments and
+  // evictions, flush on demand, store full response sets, and substitute
+  // the locally stored set when the coordinator served from cache.  False
+  // on a protocol error (served flag with no stored set to replay).
+  bool ApplyResponseFrame(const ResponseList& parsed, std::string* blob);
+  // Abort/restart: drop all cache state on both halves.
+  void CacheFlushAll();
+  // Broadcast *response_list_blob to every worker; on a dead worker,
+  // latch + broadcast the abort instead (blob becomes the abort frame)
+  // and return false.
+  bool BroadcastResponse(std::string* response_list_blob);
+
   int process_index_ = 0;
   int process_count_ = 0;
   int first_rank_ = 0;
@@ -192,6 +214,30 @@ class ControlPlane {
   std::unique_ptr<MessageTable> table_;   // coordinator only
   std::atomic<Timeline*> timeline_{nullptr};  // coordinator only; not owned
   std::unordered_set<std::string> negotiating_;   // timeline span state
+
+  // Response cache (HOROVOD_TPU_CACHE_CAPACITY; 0 disables and keeps the
+  // wire byte-identical to the pre-cache format).  All state below is
+  // touched only from the tick thread.
+  int64_t cache_capacity_ = 0;
+  // Client half (every process).  slot -> (name, serialized request group
+  // the slot was assigned from — bit-for-bit hit test, no hashing).
+  int32_t cache_client_epoch_ = 0;
+  std::map<int32_t, std::pair<std::string, std::string>> cache_client_slots_;
+  std::unordered_map<std::string, int32_t> cache_client_index_;
+  // name -> serialized group of the in-flight full send; consumed when the
+  // coordinator assigns the name a slot, dropped when its response lands.
+  std::unordered_map<std::string, std::string> cache_last_sent_;
+  // bits -> full response blob stored on a kCacheStoreSet broadcast and
+  // replayed on kCacheServed mini-frames.  Bounded; cleared on any slot
+  // mutation (the bit-key meaning changed).
+  std::unordered_map<std::string, std::string> cache_set_;
+  std::string cache_bits_in_flight_;
+  std::vector<Request> cache_compressed_in_flight_;
+  std::vector<Request> cache_resend_;   // re-send as full after a flush
+  // Server half (coordinator): slot table + the set keys whose full
+  // response has been broadcast with kCacheStoreSet (fast-path gate).
+  std::unique_ptr<ResponseCache> cache_;
+  std::unordered_set<std::string> cache_sets_broadcast_;
 };
 
 }  // namespace htpu

@@ -176,6 +176,9 @@ Response MessageTable::ConstructResponse(const std::string& name) {
 
   std::vector<int32_t> devices(requests.size(), 0);
   for (const auto& r : requests) devices[size_t(r.request_rank)] = r.device;
+  // `requests` aliases the table entry — copy out everything still needed
+  // before the erase invalidates it.
+  std::string wire_dtype = requests[0].wire_dtype;
 
   // Negotiation latency: first request seen -> response constructed.
   Metrics::Get().Observe(
@@ -188,7 +191,7 @@ Response MessageTable::ConstructResponse(const std::string& name) {
 
   resp.tensor_names = {name};
   resp.devices = std::move(devices);
-  resp.wire_dtype = requests[0].wire_dtype;
+  resp.wire_dtype = std::move(wire_dtype);
   if (!error.empty()) {
     resp.response_type = ResponseType::ERROR;
     resp.error_message = std::move(error);
@@ -223,6 +226,120 @@ std::vector<StallInfo> MessageTable::Stalled(double age_s) const {
   Metrics::Get().SetGauge("control.stalled_tensors",
                           static_cast<double>(out.size()));
   return out;
+}
+
+// ----------------------------------------------------------- response cache
+
+namespace {
+
+inline bool BitIsSet(const std::string& bits, int32_t slot) {
+  size_t byte = size_t(slot) / 8;
+  return byte < bits.size() &&
+         ((uint8_t(bits[byte]) >> (slot % 8)) & 1) != 0;
+}
+
+}  // namespace
+
+int32_t ResponseCache::SlotOf(const std::string& name) const {
+  auto it = index_.find(name);
+  return it == index_.end() ? -1 : it->second;
+}
+
+bool ResponseCache::Validate(const std::string& bits) const {
+  for (size_t byte = 0; byte < bits.size(); ++byte) {
+    uint8_t b = uint8_t(bits[byte]);
+    for (int bit = 0; b; ++bit, b >>= 1) {
+      if ((b & 1) &&
+          slots_.find(int32_t(byte * 8 + size_t(bit))) == slots_.end()) {
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+bool ResponseCache::Expand(const std::string& bits, int process,
+                           std::vector<Request>* out, uint64_t tick) {
+  if (!Validate(bits)) return false;
+  for (auto& kv : slots_) {
+    if (!BitIsSet(bits, kv.first)) continue;
+    kv.second.last_used = tick;
+    if (process >= 0 && size_t(process) < kv.second.per_process.size()) {
+      for (const Request& r : kv.second.per_process[size_t(process)])
+        out->push_back(r);
+    }
+  }
+  return true;
+}
+
+void ResponseCache::Touch(const std::string& bits, uint64_t tick) {
+  for (auto& kv : slots_)
+    if (BitIsSet(bits, kv.first)) kv.second.last_used = tick;
+}
+
+size_t ResponseCache::PopCount(const std::string& bits) {
+  size_t n = 0;
+  for (char c : bits)
+    for (uint8_t b = uint8_t(c); b; b >>= 1) n += b & 1;
+  return n;
+}
+
+int32_t ResponseCache::Assign(const std::string& name,
+                              std::vector<std::vector<Request>> per_process,
+                              uint64_t tick, std::vector<int32_t>* evicted) {
+  if (!enabled()) return -1;
+  while (int64_t(slots_.size()) >= capacity_) {
+    int32_t victim = -1;
+    uint64_t oldest = ~uint64_t(0);
+    for (const auto& kv : slots_) {
+      if (kv.second.last_used < oldest) {
+        oldest = kv.second.last_used;
+        victim = kv.first;
+      }
+    }
+    index_.erase(slots_[victim].name);
+    slots_.erase(victim);
+    free_slots_.insert(victim);
+    evicted->push_back(victim);
+  }
+  int32_t id;
+  if (!free_slots_.empty()) {
+    id = *free_slots_.begin();
+    free_slots_.erase(free_slots_.begin());
+  } else {
+    id = next_slot_++;
+  }
+  Slot s;
+  s.name = name;
+  s.per_process = std::move(per_process);
+  s.last_used = tick;
+  slots_.emplace(id, std::move(s));
+  index_[name] = id;
+  ++epoch_;
+  return id;
+}
+
+bool ResponseCache::Evict(const std::string& name,
+                          std::vector<int32_t>* evicted) {
+  auto it = index_.find(name);
+  if (it == index_.end()) return false;
+  int32_t id = it->second;
+  index_.erase(it);
+  slots_.erase(id);
+  free_slots_.insert(id);
+  evicted->push_back(id);
+  ++epoch_;
+  return true;
+}
+
+size_t ResponseCache::Flush() {
+  size_t dropped = slots_.size();
+  slots_.clear();
+  index_.clear();
+  free_slots_.clear();
+  next_slot_ = 0;
+  ++epoch_;
+  return dropped;
 }
 
 }  // namespace htpu

@@ -8,6 +8,9 @@
 #define HTPU_MESSAGE_TABLE_H_
 
 #include <chrono>
+#include <cstdint>
+#include <map>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -50,6 +53,72 @@ class MessageTable {
   };
   int size_;
   std::unordered_map<std::string, Entry> table_;
+};
+
+// Coordinator half of the negotiation response cache (the tentpole of the
+// bitvector-tick optimization): after a tensor's first full negotiation with
+// every process contributing in the same tick, it gets a stable slot id;
+// later ticks name it by one bit instead of a serialized Request group.
+// Slots store the per-process request vectors verbatim, so expanding a bit
+// re-feeds the MessageTable with exactly the bytes the client would have
+// sent (the client only sets the bit when its serialized group is
+// byte-identical to what the slot was assigned from).  Capacity-bounded
+// with LRU eviction; every mutation (assign / evict / flush) bumps the
+// epoch that versions the bitvectors on the wire.
+class ResponseCache {
+ public:
+  ResponseCache(int64_t capacity, int process_count)
+      : capacity_(capacity), process_count_(process_count) {}
+
+  bool enabled() const { return capacity_ > 0; }
+  int32_t epoch() const { return epoch_; }
+  size_t size() const { return slots_.size(); }
+
+  // Slot id for `name`, or -1.
+  int32_t SlotOf(const std::string& name) const;
+
+  // True iff every set bit names a live slot (LSB of byte 0 = slot 0).
+  bool Validate(const std::string& bits) const;
+
+  // Append process `process`'s stored requests for every set bit to *out,
+  // in ascending slot order, refreshing each touched slot's LRU stamp.
+  // False if a set bit names an unknown slot.
+  bool Expand(const std::string& bits, int process,
+              std::vector<Request>* out, uint64_t tick);
+
+  // Refresh the LRU stamp of every set bit's slot (fast-path ticks, which
+  // replay without expanding).
+  void Touch(const std::string& bits, uint64_t tick);
+
+  static size_t PopCount(const std::string& bits);
+
+  // Assign a (reused-lowest-free, so bitvectors stay O(capacity/8)) slot to
+  // `name`, evicting LRU slots into *evicted while at capacity.  Returns
+  // the new slot id, or -1 when disabled.
+  int32_t Assign(const std::string& name,
+                 std::vector<std::vector<Request>> per_process,
+                 uint64_t tick, std::vector<int32_t>* evicted);
+
+  // Drop `name`'s slot (shape/dtype/wire-dtype divergence: some process
+  // sent a full request for a slotted name).  True if it was present.
+  bool Evict(const std::string& name, std::vector<int32_t>* evicted);
+
+  // Drop everything (abort / epoch mismatch); returns slots dropped.
+  size_t Flush();
+
+ private:
+  struct Slot {
+    std::string name;
+    std::vector<std::vector<Request>> per_process;
+    uint64_t last_used = 0;
+  };
+  int64_t capacity_ = 0;
+  int process_count_ = 0;
+  int32_t epoch_ = 0;
+  int32_t next_slot_ = 0;
+  std::map<int32_t, Slot> slots_;   // ordered: deterministic expansion order
+  std::set<int32_t> free_slots_;    // evicted ids, reused smallest-first
+  std::unordered_map<std::string, int32_t> index_;
 };
 
 }  // namespace htpu

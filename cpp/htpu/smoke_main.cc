@@ -134,6 +134,58 @@ int RunProcess(int pidx, int port) {
     if (v <= 0) return Fail(pidx, "int8 byte counter is zero");
   }
 
+  // Cached negotiation: the same single-tensor request set submitted
+  // tick after tick must ramp onto the bitvector fast path (miss →
+  // slot assignment → bits-only frames → served-from-cache replays),
+  // with every frame transition exercised under the sanitizers.  The
+  // response must stay correct on every repetition.
+  {
+    htpu::Request r;
+    r.request_rank = pidx;
+    r.request_type = htpu::RequestType::ALLREDUCE;
+    r.tensor_name = "smoke.cache";
+    r.tensor_type = "float32";
+    r.device = pidx;
+    r.tensor_shape = {16};
+    htpu::RequestList rl;
+    rl.requests.push_back(r);
+    std::string req_blob;
+    htpu::SerializeRequestList(rl, &req_blob);
+    for (int i = 0; i < 12; ++i) {
+      if (!cp->Tick(req_blob, 0, &resp)) return Fail(pidx, "cached tick");
+      htpu::ResponseList out;
+      if (!htpu::ParseResponseList(
+              reinterpret_cast<const uint8_t*>(resp.data()), resp.size(),
+              &out)) {
+        return Fail(pidx, "cached tick response parse");
+      }
+      // The negotiation window is one synchronous tick here, so every
+      // tick answers the submitted tensor exactly once.
+      if (out.responses.size() != 1 ||
+          out.responses[0].tensor_names != std::vector<std::string>{
+              "smoke.cache"}) {
+        return Fail(pidx, "cached tick response content");
+      }
+      if (out.responses[0].response_type != htpu::ResponseType::ALLREDUCE) {
+        return Fail(pidx, "cached tick response type");
+      }
+    }
+    // Client-side hit counter: after the ramp (assign on tick 1, store
+    // on tick 2) the remaining ticks were byte-exact hits.
+    void* buf = nullptr;
+    int len = htpu_metrics_snapshot(&buf);
+    if (len <= 0 || !buf) return Fail(pidx, "cache metrics snapshot");
+    std::string js(static_cast<const char*>(buf), size_t(len));
+    htpu_free(buf);
+    const std::string key = "\"control.cache_hits\":";
+    size_t at = js.find(key);
+    if (at == std::string::npos) {
+      return Fail(pidx, "metrics snapshot missing cache_hits");
+    }
+    long long hits = atoll(js.c_str() + at + key.size());
+    if (hits <= 0) return Fail(pidx, "cache_hits is zero after ramp");
+  }
+
   // Abort path: process 1 dies without shutdown; survivors keep ticking
   // until the coordinator's gather hits EOF and the abort propagates.
   if (pidx == 1) {

@@ -148,6 +148,13 @@ void Timeline::ActivityEnd(const std::string& tensor_name) {
   NegotiateEnd(tensor_name);
 }
 
+void Timeline::CacheHitTick(int64_t dur_us) {
+  std::ostringstream os;
+  os << "{\"ph\": \"X\", \"pid\": 0, \"ts\": " << TsUs() - dur_us
+     << ", \"dur\": " << dur_us << ", \"name\": \"CACHED_TICK\"}";
+  Emit(os.str());
+}
+
 void Timeline::Counter(const std::string& name, int64_t value) {
   std::ostringstream os;
   os << "{\"ph\": \"C\", \"pid\": 0, \"ts\": " << TsUs() << ", \"name\": \""

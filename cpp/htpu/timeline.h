@@ -37,6 +37,10 @@ class Timeline {
   // Chrome-trace counter track ("ph": "C") — plotted by Perfetto as a
   // rate graph alongside the spans (queue depth, bytes in flight).
   void Counter(const std::string& name, int64_t value);
+  // Complete-event span ("ph": "X") on the control track marking a
+  // negotiation tick served entirely from the response cache: visually
+  // distinct from NEGOTIATE_* spans, dur = full Tick latency.
+  void CacheHitTick(int64_t dur_us);
   void Flush();
   void Close();
 

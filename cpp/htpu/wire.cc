@@ -131,51 +131,100 @@ bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
 void SerializeRequestList(const RequestList& l, std::string* out) {
   // A list is always a whole frame: replace, never append, so callers can
   // reuse one buffer across ticks (the inner Serialize{Request,Response}
-  // helpers stay append-style).
+  // helpers stay append-style).  Without the cache extension the frame is
+  // byte-identical to the legacy format (flags byte == shutdown bool).
   out->clear();
-  PutI8(out, l.shutdown ? 1 : 0);
+  uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
+                | (l.has_cache_ext ? kFlagCacheExt : 0);
+  PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.requests.size()));
   for (const auto& r : l.requests) SerializeRequest(r, out);
+  if (l.has_cache_ext) {
+    PutI32(out, l.cache_epoch);
+    PutStr(out, l.cache_bits);
+  }
 }
 
 bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   size_t pos = 0;
-  uint8_t shutdown;
+  uint8_t flags;
   int32_t n;
-  if (!GetI8(data, len, &pos, &shutdown)) return false;
-  out->shutdown = shutdown != 0;
+  if (!GetI8(data, len, &pos, &flags)) return false;
+  if (flags & ~kKnownFlags) return false;  // newer wire version
+  out->shutdown = (flags & kFlagShutdown) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->requests.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
     if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)])) return false;
+  out->has_cache_ext = (flags & kFlagCacheExt) != 0;
+  out->cache_epoch = 0;
+  out->cache_bits.clear();
+  if (out->has_cache_ext) {
+    if (!GetI32(data, len, &pos, &out->cache_epoch)) return false;
+    if (!GetStr(data, len, &pos, &out->cache_bits)) return false;
+  }
   return pos == len;
 }
 
 void SerializeResponseList(const ResponseList& l, std::string* out) {
   out->clear();  // whole frame — see SerializeRequestList
-  PutI8(out, l.shutdown ? 1 : 0);
+  uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
+                | (l.has_cache_ext ? kFlagCacheExt : 0);
+  PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.responses.size()));
   for (const auto& r : l.responses) SerializeResponse(r, out);
+  if (l.has_cache_ext) {
+    PutI32(out, l.cache_epoch);
+    PutI8(out, l.cache_flags);
+    PutI32(out, int32_t(l.cache_assignments.size()));
+    for (const auto& a : l.cache_assignments) {
+      PutI32(out, a.first);
+      PutStr(out, a.second);
+    }
+    PutI32(out, int32_t(l.cache_evictions.size()));
+    for (int32_t s : l.cache_evictions) PutI32(out, s);
+  }
 }
 
 bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   size_t pos = 0;
-  uint8_t shutdown;
+  uint8_t flags;
   int32_t n;
-  if (!GetI8(data, len, &pos, &shutdown)) return false;
-  out->shutdown = shutdown != 0;
+  if (!GetI8(data, len, &pos, &flags)) return false;
+  if (flags & ~kKnownFlags) return false;  // newer wire version
+  out->shutdown = (flags & kFlagShutdown) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->responses.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
     if (!ParseResponse(data, len, &pos, &out->responses[size_t(i)])) return false;
+  out->has_cache_ext = (flags & kFlagCacheExt) != 0;
+  out->cache_epoch = 0;
+  out->cache_flags = 0;
+  out->cache_assignments.clear();
+  out->cache_evictions.clear();
+  if (out->has_cache_ext) {
+    if (!GetI32(data, len, &pos, &out->cache_epoch)) return false;
+    if (!GetI8(data, len, &pos, &out->cache_flags)) return false;
+    if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+    out->cache_assignments.resize(size_t(n));
+    for (int32_t i = 0; i < n; ++i) {
+      auto& a = out->cache_assignments[size_t(i)];
+      if (!GetI32(data, len, &pos, &a.first)) return false;
+      if (!GetStr(data, len, &pos, &a.second)) return false;
+    }
+    if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+    out->cache_evictions.resize(size_t(n));
+    for (int32_t i = 0; i < n; ++i)
+      if (!GetI32(data, len, &pos, &out->cache_evictions[size_t(i)])) return false;
+  }
   return pos == len;
 }
 

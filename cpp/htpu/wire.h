@@ -14,10 +14,21 @@
 //             shape:vec<i64> wire_dtype:str
 // Response := type:i32 names:vec<str> error:str devices:vec<i32>
 //             sizes:vec<i64> wire_dtype:str
-// RequestList  := shutdown:i8 abort_rank:i32 abort_reason:str
-//                 requests:vec<Request>
-// ResponseList := shutdown:i8 abort_rank:i32 abort_reason:str
+// RequestList  := flags:i8 abort_rank:i32 abort_reason:str
+//                 requests:vec<Request> [cache_epoch:i32 bits:str]
+// ResponseList := flags:i8 abort_rank:i32 abort_reason:str
 //                 responses:vec<Response>
+//                 [cache_epoch:i32 cflags:i8
+//                  assignments:vec<slot:i32 name:str> evictions:vec<i32>]
+//
+// flags was historically the shutdown bool, so legacy frames (including
+// abort frames) decode unchanged: bit 0 = shutdown, bit 1 = the trailing
+// response-cache extension is present.  Unknown flag bits reject the frame
+// (a newer wire version) instead of misreading it.  The RequestList
+// extension carries the hit-slot bitvector (LSB of byte 0 = slot 0,
+// trailing zero bytes trimmed); the ResponseList extension carries the
+// coordinator's cache-coherence traffic — slot assignments, LRU evictions,
+// and the served-from-cache / flush / store-set control bits.
 //
 // abort_rank = -1 means "no abort".  A worker sets it in its RequestList to
 // report a local transport/executor failure; the coordinator sets it in the
@@ -29,9 +40,18 @@
 
 #include <cstdint>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace htpu {
+
+// List-frame flags byte + response-cache extension control bits.
+constexpr uint8_t kFlagShutdown = 0x01;
+constexpr uint8_t kFlagCacheExt = 0x02;
+constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt;
+constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
+constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
+constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits
 
 enum class RequestType : int { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2 };
 enum class ResponseType : int {
@@ -74,6 +94,11 @@ struct RequestList {
   int32_t abort_rank = -1;
   std::string abort_reason;
   std::vector<Request> requests;
+  // Response-cache extension (serialized only when has_cache_ext):
+  // cache-generation number + hit-slot bitvector.
+  bool has_cache_ext = false;
+  int32_t cache_epoch = 0;
+  std::string cache_bits;
 };
 
 struct ResponseList {
@@ -83,6 +108,13 @@ struct ResponseList {
   int32_t abort_rank = -1;
   std::string abort_reason;
   std::vector<Response> responses;
+  // Response-cache extension (serialized only when has_cache_ext):
+  // generation + control bits (kCache*) + slot assignments / evictions.
+  bool has_cache_ext = false;
+  int32_t cache_epoch = 0;
+  uint8_t cache_flags = 0;
+  std::vector<std::pair<int32_t, std::string>> cache_assignments;
+  std::vector<int32_t> cache_evictions;
 };
 
 // Serialization. Append to / read from a byte buffer.

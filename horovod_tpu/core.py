@@ -631,6 +631,125 @@ class TensorTableEntry:
     wire_dtype: str = ""
 
 
+def cache_capacity_from_env() -> int:
+    """HOROVOD_TPU_CACHE_CAPACITY: response-cache slots (default 1024;
+    0 disables the cache entirely).  Malformed values fall back to the
+    default — same leniency as the native parser in control.cc."""
+    raw = os.environ.get("HOROVOD_TPU_CACHE_CAPACITY", "")
+    try:
+        v = int(raw)
+        return v if v >= 0 else 1024
+    except ValueError:
+        return 1024
+
+
+class _LocalResponseCache:
+    """Single-process half of the negotiation response cache.
+
+    The multi-process cache lives inside the native control plane
+    (cpp/htpu: bitvector ticks on the wire); this class gives the local
+    loop the same skip: a tick whose pending request batch serializes
+    byte-identically to an earlier fully-successful tick replays that
+    tick's fused responses without touching the MessageTable or the
+    fusion planner.  Replay is bit-identical by construction — the stored
+    responses ARE the ones the uncached path built, handed out as fresh
+    copies.  Shape / dtype / wire-dtype changes alter the serialized
+    batch, so they miss naturally and the stale entry ages out by LRU.
+    """
+
+    # Full response sets kept per distinct batch shape; small — steady
+    # training loops replay one or two shapes (matches the native client's
+    # cache_set_ bound).
+    MAX_SETS = 16
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # name -> serialized request group (byte-exact per-name hit test,
+        # LRU-bounded by `capacity` for knob parity with the native cache).
+        self._names: "collections.OrderedDict[str, bytes]" = \
+            collections.OrderedDict()
+        # batch key -> fused response list of the tick that negotiated it.
+        self._sets: "collections.OrderedDict[bytes, List[Response]]" = \
+            collections.OrderedDict()
+
+    @staticmethod
+    def _batch_key(pending: List[Request]) -> bytes:
+        from horovod_tpu import wire
+        return b"".join(wire.serialize_request(r) for r in pending)
+
+    def _account(self, pending: List[Request]) -> None:
+        """Per-name hit/miss/eviction metrics, mirroring the native
+        counters (control.cache_hits / _misses / _evictions)."""
+        from horovod_tpu import wire
+        groups: "collections.OrderedDict[str, bytes]" = \
+            collections.OrderedDict()
+        for r in pending:
+            groups[r.tensor_name] = \
+                groups.get(r.tensor_name, b"") + wire.serialize_request(r)
+        hits = misses = 0
+        for name, sig in groups.items():
+            if self._names.get(name) == sig:
+                hits += 1
+                self._names.move_to_end(name)
+            else:
+                misses += 1
+                self._names[name] = sig
+                self._names.move_to_end(name)
+        evicted = 0
+        while len(self._names) > self.capacity:
+            self._names.popitem(last=False)
+            evicted += 1
+        _metrics.registry.inc("control.cache_hits", hits)
+        _metrics.registry.inc("control.cache_misses", misses)
+        if evicted:
+            _metrics.registry.inc("control.cache_evictions", evicted)
+
+    def lookup(self, pending: List[Request],
+               table_empty: bool) -> Optional[List[Response]]:
+        """Fused responses to replay for this batch, or None to negotiate
+        in full.  Replay requires an empty message table: a stored set
+        only equals the uncached result when no straggler from an earlier
+        tick could have contributed to it."""
+        if self.capacity <= 0 or not pending:
+            return None
+        self._account(pending)
+        if not table_empty:
+            return None
+        stored = self._sets.get(self._batch_key(pending))
+        if stored is None:
+            return None
+        self._sets.move_to_end(self._batch_key(pending))
+        return [dataclasses.replace(
+                    r, tensor_names=list(r.tensor_names),
+                    devices=list(r.devices),
+                    tensor_sizes=list(r.tensor_sizes))
+                for r in stored]
+
+    def store(self, pending: List[Request], fused: List[Response]) -> None:
+        """Record a fully-successful tick (every pending name constructed,
+        no ERROR responses, table drained) for later replay."""
+        if self.capacity <= 0:
+            return
+        key = self._batch_key(pending)
+        self._sets[key] = [dataclasses.replace(
+                               r, tensor_names=list(r.tensor_names),
+                               devices=list(r.devices),
+                               tensor_sizes=list(r.tensor_sizes))
+                           for r in fused]
+        self._sets.move_to_end(key)
+        while len(self._sets) > self.MAX_SETS:
+            self._sets.popitem(last=False)
+
+    def flush(self) -> None:
+        """Abort/restart: drop everything (counted as evictions, like the
+        native cache's flush)."""
+        if self._names:
+            _metrics.registry.inc("control.cache_evictions",
+                                  len(self._names))
+        self._names.clear()
+        self._sets.clear()
+
+
 class Controller:
     """Per-process background controller.
 
@@ -798,6 +917,15 @@ class Controller:
         else:
             self._message_table = MessageTable(self.size, self.timeline)
             self._plan_fusion = plan_fusion
+        # Response cache for the single-process negotiation loop.  The
+        # multi-process equivalent lives inside the native control plane's
+        # Tick (bitvector wire ticks), so the Python cache stays off there
+        # — the two never double-count metrics.
+        self._local_cache = None
+        if self._control is None and not self.jit_only:
+            capacity = cache_capacity_from_env()
+            if capacity > 0:
+                self._local_cache = _LocalResponseCache(capacity)
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: collections.deque = collections.deque()
         self._lock = threading.Lock()
@@ -1103,30 +1231,63 @@ class Controller:
             pending = list(self._message_queue)
             self._message_queue.clear()
 
-        # Negotiation.  Single-process: this process speaks for every rank, so
-        # readiness resolves locally.  Multi-process: local requests are
-        # forwarded to the rank-0 coordinator over the control plane (C++
-        # core), which gathers/validates and broadcasts responses.
-        responses: List[Response] = []
-        for req in pending:
-            if self._message_table.increment(req):
-                responses.append(
-                    self._message_table.construct_response(req.tensor_name))
+        # Response cache: a batch byte-identical to an earlier
+        # fully-successful tick replays that tick's fused responses,
+        # skipping the table and the fusion planner.  Only sound when the
+        # table is empty on both sides of the original tick — a straggler
+        # could otherwise have contributed to the stored responses.
+        cache = self._local_cache
+        t0 = time.monotonic()
+        table_was_empty = bool(cache is not None and pending
+                               and len(self._message_table) == 0)
+        fused = None
+        if cache is not None and pending:
+            fused = cache.lookup(pending, table_empty=table_was_empty)
+        cached_tick = fused is not None
 
-        if not responses:
-            self._maybe_check_stalls()
-            self._tick_telemetry()
-            return
+        if not cached_tick:
+            # Negotiation.  Single-process: this process speaks for every
+            # rank, so readiness resolves locally.  Multi-process: local
+            # requests are forwarded to the rank-0 coordinator over the
+            # control plane (C++ core), which gathers/validates and
+            # broadcasts responses.
+            responses: List[Response] = []
+            for req in pending:
+                if self._message_table.increment(req):
+                    responses.append(
+                        self._message_table.construct_response(
+                            req.tensor_name))
 
-        def entry_bytes(name: str) -> int:
-            e = self._tensor_table[name]
-            return int(np.prod(e.per_rank[0].shape)) * np.dtype(e.dtype).itemsize
+            if not responses:
+                self._maybe_check_stalls()
+                self._tick_telemetry()
+                return
 
-        def entry_dtype(name: str) -> str:
-            return self._tensor_table[name].dtype
+            def entry_bytes(name: str) -> int:
+                e = self._tensor_table[name]
+                return (int(np.prod(e.per_rank[0].shape))
+                        * np.dtype(e.dtype).itemsize)
 
-        fused = self._plan_fusion(responses, entry_bytes, entry_dtype,
-                                  self.fusion_threshold)
+            def entry_dtype(name: str) -> str:
+                return self._tensor_table[name].dtype
+
+            fused = self._plan_fusion(responses, entry_bytes, entry_dtype,
+                                      self.fusion_threshold)
+            if (cache is not None and table_was_empty
+                    and len(self._message_table) == 0
+                    and all(r.response_type != ResponseType.ERROR
+                            for r in fused)
+                    and {n for r in fused for n in r.tensor_names}
+                        == {req.tensor_name for req in pending}):
+                cache.store(pending, fused)
+            _metrics.registry.observe("control.tick_seconds#cached=0",
+                                      time.monotonic() - t0)
+        else:
+            dur = time.monotonic() - t0
+            _metrics.registry.observe("control.tick_seconds#cached=1", dur)
+            tl = self.timeline
+            if tl is not None and hasattr(tl, "cache_hit_tick"):
+                tl.cache_hit_tick(int(dur * 1e6))
 
         ready = []
         for resp in fused:
@@ -1195,6 +1356,11 @@ class Controller:
             # Stale negotiation state would poison later reuse of the same
             # tensor names (the readiness count could overshoot `size`).
             self._message_table.clear()
+        # Cached response sets are dead with the job — a restarted loop
+        # must renegotiate from scratch (the native control plane flushes
+        # its own cache in LatchAbort).
+        if self._local_cache is not None:
+            self._local_cache.flush()
         for e in entries:
             e.callback(status, None)
         # Keep the trace on disk usable while the job is failing: this

@@ -72,6 +72,9 @@ def _configure(lib) -> None:
     lib.htpu_timeline_counter.restype = None
     lib.htpu_timeline_counter.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.htpu_timeline_cache_hit_tick.restype = None
+    lib.htpu_timeline_cache_hit_tick.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong]
     lib.htpu_timeline_flush.restype = None
     lib.htpu_timeline_flush.argtypes = [ctypes.c_void_p]
     lib.htpu_timeline_close.argtypes = [ctypes.c_void_p]
@@ -540,6 +543,13 @@ class CppTimeline:
             return
         self._lib.htpu_timeline_counter(
             self._ptr, name.encode("utf-8"), int(value))
+
+    def cache_hit_tick(self, dur_us: int) -> None:
+        """CACHED_TICK complete-event span — a negotiation tick served
+        entirely from the response cache."""
+        if not self._ptr:
+            return
+        self._lib.htpu_timeline_cache_hit_tick(self._ptr, int(dur_us))
 
     def flush(self) -> None:
         if self._ptr:

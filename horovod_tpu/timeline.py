@@ -118,6 +118,13 @@ class Timeline:
             self._emit({"ph": "E", "pid": self._pid(e.name),
                         "ts": self._ts_us()})
 
+    def cache_hit_tick(self, dur_us: int) -> None:
+        """Complete-event span (``"ph": "X"``) marking a negotiation tick
+        served entirely from the response cache — visually distinct from
+        NEGOTIATE_* spans; ``dur`` is the full tick latency."""
+        self._emit({"ph": "X", "pid": 0, "ts": self._ts_us() - int(dur_us),
+                    "dur": int(dur_us), "name": "CACHED_TICK"})
+
     # ------------------------------------------------------------- counters
 
     def counter(self, name: str, value: int) -> None:

@@ -11,6 +11,7 @@ control plane.
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 from typing import List, Optional, Tuple
 
@@ -20,6 +21,44 @@ from horovod_tpu.core import Request, RequestType, Response, ResponseType
 # None.  A worker reports a local failure via its RequestList; the
 # coordinator broadcasts the job-wide ABORT via the ResponseList.
 Abort = Optional[Tuple[int, str]]
+
+# List-frame flags byte.  Historically this byte was the shutdown bool
+# (0/1), so legacy frames — including PR 2 abort frames — decode unchanged.
+# Bit 1 announces a trailing response-cache extension; any other bit is an
+# unknown future version and the frame is rejected rather than misread.
+FLAG_SHUTDOWN = 0x01
+FLAG_CACHE_EXT = 0x02
+_KNOWN_FLAGS = FLAG_SHUTDOWN | FLAG_CACHE_EXT
+
+# Response-cache extension cflags (ResponseList direction only).
+CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
+CACHE_FLUSH = 0x02    # drop all client cache state; resend compressed names
+CACHE_STORE_SET = 0x04  # store this full frame as the set for the sent bits
+
+
+@dataclasses.dataclass
+class RequestCacheExt:
+    """Trailing RequestList extension: ``cache_epoch:i32 bits:str``.
+
+    ``bits`` is the hit-slot bitvector (LSB of byte 0 = slot 0), trailing
+    zero bytes trimmed — steady-state ticks send O(slots/8) bytes instead
+    of serialized request lists."""
+    epoch: int = 0
+    bits: bytes = b""
+
+
+@dataclasses.dataclass
+class ResponseCacheExt:
+    """Trailing ResponseList extension:
+    ``cache_epoch:i32 cflags:i8 assignments:vec<slot:i32 name:str>
+    evictions:vec<i32>``."""
+    epoch: int = 0
+    served_from_cache: bool = False
+    flush: bool = False
+    store_set: bool = False
+    assignments: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+    evictions: List[int] = dataclasses.field(default_factory=list)
 
 
 def _put_str(out: bytearray, s: str) -> None:
@@ -114,59 +153,133 @@ def parse_response(rd: _Reader) -> Response:
                     wire_dtype=wire_dtype)
 
 
+def _check_flags(flags: int, what: str) -> None:
+    if flags & ~_KNOWN_FLAGS:
+        raise ValueError(
+            f"unknown flag bits 0x{flags & ~_KNOWN_FLAGS:02x} in {what} "
+            "(frame from a newer wire version)")
+
+
 def serialize_request_list(requests: List[Request],
                            shutdown: bool = False,
                            abort_rank: int = -1,
-                           abort_reason: str = "") -> bytes:
+                           abort_reason: str = "",
+                           cache_ext: Optional[RequestCacheExt] = None,
+                           ) -> bytes:
+    # Without a cache extension the output is byte-identical to the legacy
+    # (pre-cache) format, so HOROVOD_TPU_CACHE_CAPACITY=0 stays on the old
+    # wire exactly.
+    flags = (FLAG_SHUTDOWN if shutdown else 0)
+    if cache_ext is not None:
+        flags |= FLAG_CACHE_EXT
     out = bytearray()
-    out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(requests))
     for r in requests:
         out += serialize_request(r)
+    if cache_ext is not None:
+        out += struct.pack("<i", cache_ext.epoch)
+        out += struct.pack("<i", len(cache_ext.bits))
+        out += cache_ext.bits
     return bytes(out)
 
 
-def parse_request_list(data: bytes) -> Tuple[List[Request], bool, Abort]:
+def parse_request_list_ex(data: bytes) -> Tuple[
+        List[Request], bool, Abort, Optional[RequestCacheExt]]:
     rd = _Reader(data)
-    shutdown = rd.i8() != 0
+    flags = rd.i8()
+    _check_flags(flags, "request list")
+    shutdown = bool(flags & FLAG_SHUTDOWN)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
     reqs = [parse_request(rd) for _ in range(rd.i32())]
+    ext = None
+    if flags & FLAG_CACHE_EXT:
+        epoch = rd.i32()
+        nbits = rd.i32()
+        bits = bytes(rd.data[rd.pos:rd.pos + nbits])
+        rd.pos += nbits
+        ext = RequestCacheExt(epoch=epoch, bits=bits)
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in request list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
     abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return reqs, shutdown, abort, ext
+
+
+def parse_request_list(data: bytes) -> Tuple[List[Request], bool, Abort]:
+    """Cache-agnostic view: tolerates (and discards) the v2 extension."""
+    reqs, shutdown, abort, _ = parse_request_list_ex(data)
     return reqs, shutdown, abort
 
 
 def serialize_response_list(responses: List[Response],
                             shutdown: bool = False,
                             abort_rank: int = -1,
-                            abort_reason: str = "") -> bytes:
+                            abort_reason: str = "",
+                            cache_ext: Optional[ResponseCacheExt] = None,
+                            ) -> bytes:
+    flags = (FLAG_SHUTDOWN if shutdown else 0)
+    if cache_ext is not None:
+        flags |= FLAG_CACHE_EXT
     out = bytearray()
-    out += struct.pack("<B", 1 if shutdown else 0)
+    out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(responses))
     for r in responses:
         out += serialize_response(r)
+    if cache_ext is not None:
+        out += struct.pack("<i", cache_ext.epoch)
+        cflags = ((CACHE_SERVED if cache_ext.served_from_cache else 0)
+                  | (CACHE_FLUSH if cache_ext.flush else 0)
+                  | (CACHE_STORE_SET if cache_ext.store_set else 0))
+        out += struct.pack("<B", cflags)
+        out += struct.pack("<i", len(cache_ext.assignments))
+        for slot, name in cache_ext.assignments:
+            out += struct.pack("<i", slot)
+            _put_str(out, name)
+        out += struct.pack("<i", len(cache_ext.evictions))
+        for slot in cache_ext.evictions:
+            out += struct.pack("<i", slot)
     return bytes(out)
 
 
-def parse_response_list(data: bytes) -> Tuple[List[Response], bool, Abort]:
+def parse_response_list_ex(data: bytes) -> Tuple[
+        List[Response], bool, Abort, Optional[ResponseCacheExt]]:
     rd = _Reader(data)
-    shutdown = rd.i8() != 0
+    flags = rd.i8()
+    _check_flags(flags, "response list")
+    shutdown = bool(flags & FLAG_SHUTDOWN)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
     resps = [parse_response(rd) for _ in range(rd.i32())]
+    ext = None
+    if flags & FLAG_CACHE_EXT:
+        epoch = rd.i32()
+        cflags = rd.i8()
+        assignments = [(rd.i32(), rd.str_()) for _ in range(rd.i32())]
+        evictions = [rd.i32() for _ in range(rd.i32())]
+        ext = ResponseCacheExt(
+            epoch=epoch,
+            served_from_cache=bool(cflags & CACHE_SERVED),
+            flush=bool(cflags & CACHE_FLUSH),
+            store_set=bool(cflags & CACHE_STORE_SET),
+            assignments=assignments, evictions=evictions)
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in response list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
     abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return resps, shutdown, abort, ext
+
+
+def parse_response_list(data: bytes) -> Tuple[List[Response], bool, Abort]:
+    """Cache-agnostic view: tolerates (and discards) the v2 extension."""
+    resps, shutdown, abort, _ = parse_response_list_ex(data)
     return resps, shutdown, abort
 
 

@@ -372,7 +372,8 @@ class TestNativeBuild:
         lib = cpp_core.load()
         assert lib is not None
         for sym in ("htpu_control_allreduce_wire", "htpu_wire_roundtrip",
-                    "htpu_control_last_error"):
+                    "htpu_control_last_error",
+                    "htpu_timeline_cache_hit_tick"):
             assert hasattr(lib, sym), f"rebuilt library missing {sym}"
 
 
@@ -391,6 +392,7 @@ class TestCppTimeline:
         tl.activity_start_all([E()], "XLA_ALLREDUCE")
         tl.activity_end_all([E()])
         tl.end("grad/w")
+        tl.cache_hit_tick(2500)
         tl.close()
         with open(path) as f:
             events = json.load(f)
@@ -399,6 +401,9 @@ class TestCppTimeline:
         assert "NEGOTIATE_ALLREDUCE" in names
         assert "ALLREDUCE" in names
         assert "XLA_ALLREDUCE" in names
+        cached = [e for e in events if e and e.get("name") == "CACHED_TICK"]
+        assert len(cached) == 1
+        assert cached[0]["ph"] == "X" and cached[0]["dur"] == 2500
         b = sum(1 for e in events if e.get("ph") == "B")
         e_ = sum(1 for e in events if e.get("ph") == "E")
         assert b == e_ == 3

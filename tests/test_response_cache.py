@@ -1,0 +1,242 @@
+"""Response-cached negotiation: wire v2 cache extension + the local
+(single-process) response cache.
+
+The multi-process cache lives inside the native control plane and is
+covered by test_cpp_core.py (wire parity) and test_multiprocess.py
+(coherence under real processes); this file unit-tests the shared wire
+extension encoding and the Python controller's `_LocalResponseCache`.
+"""
+
+import dataclasses
+
+import pytest
+
+from horovod_tpu import metrics as _metrics
+from horovod_tpu import wire
+from horovod_tpu.core import (Request, RequestType, Response, ResponseType,
+                              _LocalResponseCache, cache_capacity_from_env)
+
+
+def req(rank=0, rtype=RequestType.ALLREDUCE, name="t", dtype="float32",
+        shape=(4, 2), root=-1, wire_dtype=""):
+    return Request(request_rank=rank, request_type=rtype, tensor_name=name,
+                   tensor_type=dtype, tensor_shape=tuple(shape),
+                   root_rank=root, device=rank, wire_dtype=wire_dtype)
+
+
+# ------------------------------------------------------------------- wire
+
+
+class TestWireCacheExt:
+    def test_request_list_ext_roundtrip(self):
+        ext = wire.RequestCacheExt(epoch=7, bits=b"\x05\x80")
+        blob = wire.serialize_request_list([req(0), req(1)], cache_ext=ext)
+        parsed, shutdown, abort, got = wire.parse_request_list_ex(blob)
+        assert not shutdown and abort is None
+        assert got is not None
+        assert got.epoch == 7 and got.bits == b"\x05\x80"
+        assert [p.tensor_name for p in parsed] == ["t", "t"]
+
+    def test_request_list_bits_only_frame(self):
+        # Steady-state frame: no requests at all, just the bitvector.
+        ext = wire.RequestCacheExt(epoch=3, bits=b"\xff")
+        blob = wire.serialize_request_list([], cache_ext=ext)
+        parsed, shutdown, abort, got = wire.parse_request_list_ex(blob)
+        assert parsed == [] and not shutdown and abort is None
+        assert got.bits == b"\xff"
+
+    def test_response_list_ext_roundtrip(self):
+        ext = wire.ResponseCacheExt(
+            epoch=12, served_from_cache=False, flush=True, store_set=True,
+            assignments=[(0, "grad/a"), (3, "grad/β")], evictions=[1, 2])
+        blob = wire.serialize_response_list([], cache_ext=ext)
+        parsed, shutdown, abort, got = wire.parse_response_list_ex(blob)
+        assert parsed == [] and not shutdown and abort is None
+        assert got.epoch == 12
+        assert not got.served_from_cache and got.flush and got.store_set
+        assert got.assignments == [(0, "grad/a"), (3, "grad/β")]
+        assert got.evictions == [1, 2]
+
+    def test_served_mini_frame(self):
+        ext = wire.ResponseCacheExt(epoch=5, served_from_cache=True)
+        blob = wire.serialize_response_list([], cache_ext=ext)
+        _, _, _, got = wire.parse_response_list_ex(blob)
+        assert got.served_from_cache
+        assert got.assignments == [] and got.evictions == []
+
+    def test_abort_and_cache_ext_coexist(self):
+        # PR 2's abort fields and the cache extension ride the same frame:
+        # abort must stay decodable even from a frame carrying bits.
+        blob = wire.serialize_request_list(
+            [req(0)], abort_rank=2, abort_reason="boom at 2",
+            cache_ext=wire.RequestCacheExt(epoch=1, bits=b"\x01"))
+        parsed, _, abort, got = wire.parse_request_list_ex(blob)
+        assert abort == (2, "boom at 2")
+        assert got.bits == b"\x01"
+        blob = wire.serialize_response_list(
+            [], abort_rank=0, abort_reason="rank 0 died",
+            cache_ext=wire.ResponseCacheExt(epoch=1, flush=True))
+        _, _, abort, got = wire.parse_response_list_ex(blob)
+        assert abort == (0, "rank 0 died")
+        assert got.flush
+
+    def test_no_ext_stays_legacy_byte_identical(self):
+        # Cache off → frames are byte-identical to the pre-cache format,
+        # so a v1 peer (or HOROVOD_TPU_CACHE_CAPACITY=0) interops.
+        rs = [req(0), req(1)]
+        blob = wire.serialize_request_list(rs)
+        assert blob[0] in (0, 1)           # plain shutdown byte, no flag bit
+        parsed, shutdown, abort, got = wire.parse_request_list_ex(blob)
+        assert got is None
+        blob = wire.serialize_response_list([], shutdown=True)
+        assert blob[0] == wire.FLAG_SHUTDOWN
+        _, shutdown, _, got = wire.parse_response_list_ex(blob)
+        assert shutdown and got is None
+
+    def test_unknown_flag_bits_rejected(self):
+        blob = bytearray(wire.serialize_request_list([req(0)]))
+        blob[0] |= 0x40
+        with pytest.raises(ValueError, match="unknown flag bits"):
+            wire.parse_request_list_ex(bytes(blob))
+        blob = bytearray(wire.serialize_response_list([]))
+        blob[0] |= 0x80
+        with pytest.raises(ValueError, match="unknown flag bits"):
+            wire.parse_response_list_ex(bytes(blob))
+
+
+# ------------------------------------------------------- local cache unit
+
+
+def counters():
+    return _metrics.registry.snapshot()["counters"]
+
+
+def deltas(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in ("control.cache_hits", "control.cache_misses",
+                      "control.cache_evictions")}
+
+
+class TestLocalResponseCache:
+    def _fused(self, names):
+        return [Response(ResponseType.ALLREDUCE, list(names),
+                         devices=[0], tensor_sizes=[8] * len(names))]
+
+    def test_miss_then_hit_replays_stored_set(self):
+        cache = _LocalResponseCache(capacity=8)
+        pending = [req(name="a"), req(name="b")]
+        before = counters()
+        assert cache.lookup(pending, table_empty=True) is None
+        d = deltas(before, counters())
+        assert d["control.cache_misses"] == 2
+        assert d["control.cache_hits"] == 0
+
+        fused = self._fused(["a", "b"])
+        cache.store(pending, fused)
+        before = counters()
+        out = cache.lookup(pending, table_empty=True)
+        d = deltas(before, counters())
+        assert d["control.cache_hits"] == 2
+        assert d["control.cache_misses"] == 0
+        assert out is not None
+        assert [r.tensor_names for r in out] == [["a", "b"]]
+        # Replay hands out copies: mutating one must not poison the cache.
+        out[0].tensor_names.append("junk")
+        again = cache.lookup(pending, table_empty=True)
+        assert again[0].tensor_names == ["a", "b"]
+
+    def test_shape_change_invalidates(self):
+        cache = _LocalResponseCache(capacity=8)
+        pending = [req(name="a", shape=(4, 2))]
+        cache.lookup(pending, table_empty=True)
+        cache.store(pending, self._fused(["a"]))
+        changed = [req(name="a", shape=(4, 3))]
+        before = counters()
+        assert cache.lookup(changed, table_empty=True) is None
+        d = deltas(before, counters())
+        assert d["control.cache_misses"] == 1
+        # dtype and wire-dtype changes miss the same way
+        for variant in (req(name="a", dtype="int32"),
+                        req(name="a", wire_dtype="bf16")):
+            assert cache.lookup([variant], table_empty=True) is None
+
+    def test_straggler_tick_never_replays(self):
+        # A non-empty message table means an earlier tick's requests could
+        # contribute to this tick's responses; replay must be refused.
+        cache = _LocalResponseCache(capacity=8)
+        pending = [req(name="a")]
+        cache.lookup(pending, table_empty=True)
+        cache.store(pending, self._fused(["a"]))
+        assert cache.lookup(pending, table_empty=False) is None
+
+    def test_capacity_lru_eviction(self):
+        cache = _LocalResponseCache(capacity=2)
+        before = counters()
+        cache.lookup([req(name="a"), req(name="b")], table_empty=True)
+        cache.lookup([req(name="c")], table_empty=True)   # evicts "a"
+        d = deltas(before, counters())
+        assert d["control.cache_evictions"] == 1
+        # "a" was evicted → re-offering it is a miss, "b" was touched later
+        # and survives as a hit.
+        before = counters()
+        cache.lookup([req(name="b"), req(name="a")], table_empty=True)
+        d = deltas(before, counters())
+        assert d["control.cache_hits"] == 1
+        assert d["control.cache_misses"] == 1
+
+    def test_flush_drops_everything_and_counts(self):
+        cache = _LocalResponseCache(capacity=8)
+        pending = [req(name="a"), req(name="b")]
+        cache.lookup(pending, table_empty=True)
+        cache.store(pending, self._fused(["a", "b"]))
+        before = counters()
+        cache.flush()
+        d = deltas(before, counters())
+        assert d["control.cache_evictions"] == 2
+        assert cache.lookup(pending, table_empty=True) is None
+
+    def test_capacity_zero_disables(self):
+        cache = _LocalResponseCache(capacity=0)
+        pending = [req(name="a")]
+        before = counters()
+        assert cache.lookup(pending, table_empty=True) is None
+        cache.store(pending, self._fused(["a"]))
+        assert cache.lookup(pending, table_empty=True) is None
+        d = deltas(before, counters())
+        assert all(v == 0 for v in d.values())
+
+    def test_set_bound(self):
+        cache = _LocalResponseCache(capacity=1024)
+        for i in range(_LocalResponseCache.MAX_SETS + 4):
+            pending = [req(name=f"s{i}")]
+            cache.lookup(pending, table_empty=True)
+            cache.store(pending, self._fused([f"s{i}"]))
+        assert len(cache._sets) == _LocalResponseCache.MAX_SETS
+
+
+class TestCapacityKnob:
+    def test_default_and_parsing(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_CACHE_CAPACITY", raising=False)
+        assert cache_capacity_from_env() == 1024
+        monkeypatch.setenv("HOROVOD_TPU_CACHE_CAPACITY", "0")
+        assert cache_capacity_from_env() == 0
+        monkeypatch.setenv("HOROVOD_TPU_CACHE_CAPACITY", "32")
+        assert cache_capacity_from_env() == 32
+        monkeypatch.setenv("HOROVOD_TPU_CACHE_CAPACITY", "-5")
+        assert cache_capacity_from_env() == 1024
+        monkeypatch.setenv("HOROVOD_TPU_CACHE_CAPACITY", "banana")
+        assert cache_capacity_from_env() == 1024
+
+
+class TestCachedTickTimelineSpan:
+    def test_python_timeline_emits_cached_tick(self, tmp_path):
+        import json
+        from horovod_tpu.timeline import Timeline
+        path = str(tmp_path / "tl.json")
+        tl = Timeline(path)
+        tl.cache_hit_tick(1500)
+        tl.close()
+        events = [e for e in json.load(open(path)) if e]
+        spans = [e for e in events if e.get("name") == "CACHED_TICK"]
+        assert len(spans) == 1
+        assert spans[0]["ph"] == "X" and spans[0]["dur"] == 1500

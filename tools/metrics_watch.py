@@ -56,6 +56,15 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
             rate = (counters[name] - prev_counters[name]) / dt
         lines.append(f"  {name:<52} {fmt_value(name, counters[name], rate)}")
 
+    # Derived: response-cache hit rate (docs/observability.md) — the
+    # registry stores raw hit/miss counters, the ratio reads better live.
+    hits = counters.get("control.cache_hits", 0)
+    misses = counters.get("control.cache_misses", 0)
+    if (hits or misses) and (not name_filter
+                             or name_filter in "control.cache_hit_rate"):
+        rate = hits / (hits + misses)
+        lines.append(f"  {'control.cache_hit_rate':<52} {rate:.1%}")
+
     for name in sorted(snap.get("gauges", {})):
         if name_filter and name_filter not in name:
             continue
