@@ -552,6 +552,12 @@ def tcp_worker():
     import horovod_tpu as hvd
     import horovod_tpu.jax as hvd_jax
 
+    # Pin the headline phases to the flat ring so their numbers keep the
+    # same meaning across runs regardless of the auto-selection default
+    # (small payloads would otherwise route to the latency path).  The
+    # algo sweep below flips this deliberately, one phase at a time.
+    os.environ["HOROVOD_TPU_ALLREDUCE_ALGO"] = "ring"
+
     hvd.init()
     n = hvd.process_count()
     batch, iters, params, tx, grads_fn, apply_fn = _conv_leg_setup(
@@ -641,6 +647,65 @@ def tcp_worker():
                                        compression=wire))
         wire_stats[wire]["allreduce_max_err_vs_fp32"] = float(
             f"{np.max(np.abs(out - ref)) / scale:.3e}")
+
+    # Algorithm sweep: per-size p50 allreduce latency for each data-plane
+    # algorithm.  The algorithm preference is read from the environment
+    # per enqueue and rides the negotiated request, so flipping the env at
+    # the same phase point on every process keeps the preference uniform.
+    # On this 2-process single-host leg "hier" degenerates to the
+    # intra-host fan-in/fan-out legs (one leader, no inter-host ring) —
+    # still a distinct data path from the flat ring.  The reported
+    # crossover is the largest payload where the latency path still beats
+    # the ring; compare it against the configured
+    # HOROVOD_TPU_ALLREDUCE_CROSSOVER (docs/benchmarks.md).
+    def _algo_probe(reps=7):
+        from horovod_tpu.core import algo_crossover_bytes
+        sizes = [256, 1024, 4096, 16384, 65536, 262144, 1048576]  # elems
+        sweep = {"sizes_bytes": [s * 4 for s in sizes], "algos": {}}
+        def _plane_bytes():
+            c = hvd_metrics.snapshot().get("counters", {})
+            return (sum(v for k, v in c.items()
+                        if k.startswith("ring.allreduce.bytes_sent#wire=")),
+                    c.get("ring.hier_local.bytes_sent", 0))
+
+        for algo in ("ring", "small", "hier"):
+            os.environ["HOROVOD_TPU_ALLREDUCE_ALGO"] = algo
+            w0, l0 = _plane_bytes()
+            medians = []
+            for n_el in sizes:
+                payload = np.ones(n_el, np.float32)
+                # warm: first hier/small call bootstraps the host-group
+                # sockets; a reused name lets later reps ride the
+                # response cache so negotiation noise stays off the
+                # data-plane timing.
+                hvd.allreduce(payload, average=False,
+                              name=f"algoprobe.{algo}.{n_el}")
+                ts = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    hvd.allreduce(payload, average=False,
+                                  name=f"algoprobe.{algo}.{n_el}")
+                    ts.append(time.perf_counter() - t0)
+                medians.append(round(sorted(ts)[len(ts) // 2] * 1e6, 1))
+            w1, l1 = _plane_bytes()
+            # Ring-wire vs intra-host bytes during this algo's phase:
+            # hier routes member traffic off the (inter-host) ring wire
+            # onto the raw local legs — by ~local_size on a real pod.
+            sweep["algos"][algo] = {"p50_us": medians,
+                                    "ring_wire_bytes": w1 - w0,
+                                    "hier_local_bytes": l1 - l0}
+        os.environ["HOROVOD_TPU_ALLREDUCE_ALGO"] = "ring"
+        crossover = 0
+        for sz, s_us, r_us in zip(sweep["sizes_bytes"],
+                                  sweep["algos"]["small"]["p50_us"],
+                                  sweep["algos"]["ring"]["p50_us"]):
+            if s_us <= r_us:
+                crossover = sz
+        sweep["measured_crossover_bytes"] = crossover
+        sweep["configured_crossover_bytes"] = algo_crossover_bytes()
+        return sweep
+
+    algo_sweep = _algo_probe()
 
     # Response-cache probe: repeated negotiation of a fixed set of small
     # named tensors.  The first burst pays full negotiation (every name
@@ -752,6 +817,9 @@ def tcp_worker():
             "ring_transport": transport,
             "pinned": pinned,
             "wire_compression": wire_stats,
+            # Per-size p50 latency for ring/small/hier plus the measured
+            # small↔ring crossover (docs/benchmarks.md).
+            "algo_sweep": algo_sweep,
             # Cached-vs-uncached negotiation: per-burst wire bytes and the
             # labeled tick-latency histograms of the response cache.
             "response_cache": cache_stats,

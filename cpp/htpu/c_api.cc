@@ -19,6 +19,7 @@
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
+#include "htpu/reduce.h"
 #include "htpu/timeline.h"
 #include "htpu/wire.h"
 
@@ -85,8 +86,10 @@ HTPU_API void htpu_table_destroy(void* t) {
 HTPU_API int htpu_table_increment(void* t, const void* req_bytes, int len) {
   htpu::Request req;
   size_t pos = 0;
+  // Single-message boundary frames always carry the algo field (both
+  // serializer and parser agree out of band — no flag byte here).
   if (!htpu::ParseRequest(static_cast<const uint8_t*>(req_bytes), size_t(len),
-                          &pos, &req) ||
+                          &pos, &req, /*with_algo=*/true) ||
       pos != size_t(len)) {
     return -1;
   }
@@ -102,8 +105,16 @@ HTPU_API int htpu_table_construct_response(void* t, const char* name, void** out
   htpu::Response resp =
       static_cast<htpu::MessageTable*>(t)->ConstructResponse(name);
   std::string buf;
-  htpu::SerializeResponse(resp, &buf);
+  htpu::SerializeResponse(resp, &buf, /*with_algo=*/true);
   return CopyOut(buf, out);
+}
+
+// Topology + crossover inputs for the table's allreduce algorithm
+// resolution ("auto" → ring / hier / small per payload size).
+HTPU_API void htpu_table_configure_algo(void* t, int num_hosts, int num_procs,
+                                        long long crossover_bytes) {
+  static_cast<htpu::MessageTable*>(t)->ConfigureAlgoSelection(
+      num_hosts, num_procs, crossover_bytes);
 }
 
 HTPU_API int htpu_table_num_pending(void* t) {
@@ -257,17 +268,19 @@ HTPU_API int htpu_control_tick(void* cp, const void* req_blob, int len,
 // and the ring reduces in place (the payload path measured copy-bound at
 // multi-MB gradients — docs/benchmarks.md, round-5 eager plane study).
 // `wire_dtype` ("", "bf16", "fp16", "int8") selects the compressed wire
-// format for fp32 payloads (quantize.h).
-HTPU_API int htpu_control_allreduce_wire(void* cp, const char* dtype,
-                                const char* wire_dtype, const void* in,
-                                long long len, void** out) try {
+// format for fp32 payloads (quantize.h); `algo` ("", "hier", "small") the
+// coordinator-resolved collective algorithm (control.h).
+HTPU_API int htpu_control_allreduce_algo(void* cp, const char* dtype,
+                                const char* wire_dtype, const char* algo,
+                                const void* in, long long len,
+                                void** out) try {
   char* buf = static_cast<char*>(malloc(len > 0 ? size_t(len) : 1));
   if (!buf) return -1;
   std::memcpy(buf, in, size_t(len));
   bool ok = false;
   try {
     ok = static_cast<htpu::ControlPlane*>(cp)->AllreduceBuf(
-        dtype, buf, len, wire_dtype ? wire_dtype : "");
+        dtype, buf, len, wire_dtype ? wire_dtype : "", algo ? algo : "");
   } catch (...) {
     ok = false;   // e.g. bad_alloc sizing the ring's chunk buffers
   }
@@ -279,6 +292,12 @@ HTPU_API int htpu_control_allreduce_wire(void* cp, const char* dtype,
   return int(len);
 } catch (...) {
   return -1;
+}
+
+HTPU_API int htpu_control_allreduce_wire(void* cp, const char* dtype,
+                                const char* wire_dtype, const void* in,
+                                long long len, void** out) {
+  return htpu_control_allreduce_algo(cp, dtype, wire_dtype, "", in, len, out);
 }
 
 HTPU_API int htpu_control_allreduce(void* cp, const char* dtype, const void* in,
@@ -340,6 +359,15 @@ HTPU_API long long htpu_wire_roundtrip(const char* wire_dtype, const void* in,
   return total;
 } catch (...) {
   return -1;
+}
+
+// Direct SumInto hook (reduce.h): acc += in elementwise over nbytes of
+// `dtype`.  Exists so tests can pin the parallel reduction's bit-exactness
+// against the serial path (small slices stay serial; large calls engage
+// the worker pool) for every dtype, including bfloat16 which numpy lacks.
+HTPU_API int htpu_sum_into(const char* dtype, void* acc, const void* in,
+                           long long nbytes) {
+  return htpu::SumInto(dtype ? dtype : "", acc, in, nbytes) ? 0 : -1;
 }
 
 // Cumulative eager-data-plane payload traffic of this process.

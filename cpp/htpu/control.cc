@@ -30,6 +30,12 @@ namespace {
 // topology.host_fingerprint (boot id, else hostname): unique per booted
 // host and shared by every container on it.
 std::string HostFingerprint() {
+  // Test seam: lets a single machine fake a multi-host layout (the 3/4-
+  // process hierarchical-allreduce tests run two "hosts" on localhost).
+  // Mirrored in topology.host_fingerprint.
+  if (const char* e = getenv("HOROVOD_TPU_HOST_FINGERPRINT")) {
+    if (*e) return e;
+  }
   std::string fp;
   FILE* f = fopen("/proc/sys/kernel/random/boot_id", "r");
   if (f) {
@@ -138,6 +144,25 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
     }
   }
   if (process_count > 1 && !cp->SetupRing(coord_host)) return nullptr;
+  if (cp->table_) {
+    // Algo-selection inputs for resolving "auto": distinct hosts from the
+    // ring-setup fingerprint book, plus the size crossover below which the
+    // latency-optimal small path wins (measure per deployment with the
+    // bench sweep; see docs/benchmarks.md).
+    int num_hosts = 1;
+    if (!cp->host_fps_.empty()) {
+      std::unordered_set<std::string> uniq(cp->host_fps_.begin(),
+                                           cp->host_fps_.end());
+      num_hosts = int(uniq.size());
+    }
+    int64_t crossover = kDefaultAlgoCrossoverBytes;
+    if (const char* e = getenv("HOROVOD_TPU_ALLREDUCE_CROSSOVER")) {
+      char* end = nullptr;
+      long long v = strtoll(e, &end, 10);
+      if (end && *end == '\0' && v >= 0) crossover = v;
+    }
+    cp->table_->ConfigureAlgoSelection(num_hosts, process_count, crossover);
+  }
   return cp;
 }
 
@@ -245,12 +270,18 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     return false;
   }
 
+  // Persist the topology book for hierarchical leader election
+  // (EnsureHierarchy groups processes by fingerprint lazily).
+  host_fps_ = fps;
+  my_fp_ = HostFingerprint();
+  adv_host_ = host;
+
   // 5. Dial ring-next — UDS when the peer is on this host and advertises
   // a path (falling back to TCP if the path does not resolve, e.g.
   // containers sharing a boot id but not /tmp) — then accept ring-prev on
   // whichever listener it picked.
   int next = (process_index_ + 1) % process_count_;
-  std::string my_fp = HostFingerprint();
+  const std::string& my_fp = my_fp_;
   if (uds_enabled && !uds_paths[size_t(next)].empty() &&
       !my_fp.empty() && fps[size_t(next)] == my_fp) {
     ring_next_fd_ =
@@ -310,6 +341,10 @@ ControlPlane::~ControlPlane() {
   CloseFd(listen_fd_);
   CloseFd(ring_next_fd_);
   CloseFd(ring_prev_fd_);
+  CloseFd(leader_fd_);
+  for (int fd : member_fds_) CloseFd(fd);
+  CloseFd(leader_next_fd_);
+  CloseFd(leader_prev_fd_);
 }
 
 // --------------------------------------------------------------- abort/fault
@@ -417,26 +452,21 @@ bool ControlPlane::AbortedFailFast() {
   return true;
 }
 
-bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
-                            size_t send_len, int recv_fd, char* recv_buf,
-                            size_t recv_len) {
+bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
+                        int recv_fd, char* recv_buf, size_t recv_len,
+                        int send_peer, int recv_peer) {
   int failed = -1;
   if (DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
                      recv_len, timeout_ms_, &failed)) {
     return true;
   }
-  // Attribute to the ring neighbour whose fd died; a plain timeout most
+  // Attribute to the peer process whose fd died; a plain timeout most
   // often means upstream stopped feeding us, so default to the recv side.
-  int peer = failed >= 0 ? failed : (recv_fd >= 0 ? recv_fd : send_fd);
-  int next = (process_index_ + 1) % process_count_;
-  int prev = (process_index_ - 1 + process_count_) % process_count_;
-  int32_t rank = -1;
-  if (peer == ring_next_fd_ && size_t(next) < all_first_ranks_.size()) {
-    rank = all_first_ranks_[size_t(next)];
-  } else if (peer == ring_prev_fd_ &&
-             size_t(prev) < all_first_ranks_.size()) {
-    rank = all_first_ranks_[size_t(prev)];
-  }
+  int peer = failed >= 0 ? (failed == send_fd ? send_peer : recv_peer)
+                         : (recv_fd >= 0 ? recv_peer : send_peer);
+  int32_t rank = (peer >= 0 && size_t(peer) < all_first_ranks_.size())
+                     ? all_first_ranks_[size_t(peer)]
+                     : -1;
   last_error_rank_ = rank >= 0 ? rank : first_rank_;
   last_error_ =
       (failed >= 0
@@ -445,6 +475,14 @@ bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
       std::to_string(last_error_rank_) +
       (failed >= 0 ? " closed the connection or errored" : "");
   return false;
+}
+
+bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
+                            size_t send_len, int recv_fd, char* recv_buf,
+                            size_t recv_len) {
+  return Xfer(send_fd, send_buf, send_len, recv_fd, recv_buf, recv_len,
+              (process_index_ + 1) % process_count_,
+              (process_index_ - 1 + process_count_) % process_count_);
 }
 
 // ----------------------------------------------------- response cache client
@@ -487,7 +525,9 @@ void ControlPlane::CompressRequestFrame(const std::string& in,
   for (const Request& r : list.requests) {
     auto ins = sigs.emplace(r.tensor_name, std::string());
     if (ins.second) order.push_back(r.tensor_name);
-    SerializeRequest(r, &ins.first->second);
+    // with_algo: an algorithm-preference change must miss (and later
+    // evict) the slot just like a shape or wire-dtype change.
+    SerializeRequest(r, &ins.first->second, /*with_algo=*/true);
   }
   std::unordered_set<std::string> hit_names;
   int32_t max_slot = -1;
@@ -1053,13 +1093,16 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
          AllreduceBuf(dtype, &(*out)[0], int64_t(out->size()));
 }
 
-// In-place chunked ring allreduce on a raw buffer: reduce-scatter then
-// allgather, P-1 steps each.  Every step sends one segment downstream
-// while receiving another from upstream (full duplex), so per-process
-// traffic is 2*(P-1)/P * payload — the reference got the same property
-// from MPI's ring algorithms for free.  Operating in place on the
-// caller's buffer keeps the copy count at one for the whole C API round
-// trip (the payload path was measured copy-bound, docs/benchmarks.md).
+// In-place allreduce on a raw buffer, dispatched by the coordinator's
+// resolved algorithm: flat chunked ring (default), two-level hierarchical
+// (HierarchicalAllreduce), or the latency-optimal small-tensor path
+// (SmallAllreduce).  The ring: reduce-scatter then allgather, P-1 steps
+// each.  Every step sends one segment downstream while receiving another
+// from upstream (full duplex), so per-process traffic is
+// 2*(P-1)/P * payload — the reference got the same property from MPI's
+// ring algorithms for free.  Operating in place on the caller's buffer
+// keeps the copy count at one for the whole C API round trip (the
+// payload path was measured copy-bound, docs/benchmarks.md).
 //
 // Two round-6 additions (quantize.h):
 //  * wire_dtype narrows fp32 payloads on the socket — bf16/fp16
@@ -1075,7 +1118,8 @@ bool ControlPlane::RingAllreduce(const std::string& dtype,
 //    whole segment transferred, then reduced serially).
 bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
                                 int64_t nbytes,
-                                const std::string& wire_dtype) {
+                                const std::string& wire_dtype,
+                                const std::string& algo) {
   if (process_count_ == 1) return true;
   if (AbortedFailFast()) return false;
   const int wire = WireDtypeId(wire_dtype);
@@ -1083,8 +1127,50 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   // Compressed wire formats are defined over fp32 payloads only (the
   // Python surface enforces the same rule before submitting).
   if (wire != kWireRaw && dtype != "float32") return false;
-  const int P = process_count_;
-  const int r = process_index_;
+  // `algo` arrives resolved from the coordinator ("auto" never reaches
+  // the data plane); an unknown name is a protocol error.
+  if (!algo.empty() && algo != "hier" && algo != "small") return false;
+  {
+    const int elem = DtypeSize(dtype);
+    if (elem <= 0 || nbytes % elem != 0) return false;
+  }
+  if (nbytes == 0) return true;
+
+  // Per-algo op counter + latency histogram: the bench sweep and
+  // tools/metrics_watch.py read these to locate the small/ring crossover.
+  const std::string algo_label = algo.empty() ? "ring" : algo;
+  Metrics::Get().Counter("ring.allreduce.algo#algo=" + algo_label)
+      ->fetch_add(1, std::memory_order_relaxed);
+  const auto t0 = std::chrono::steady_clock::now();
+  bool ok;
+  if (algo == "hier") {
+    ok = HierarchicalAllreduce(dtype, data, nbytes, wire);
+  } else if (algo == "small") {
+    ok = SmallAllreduce(dtype, data, nbytes, wire);
+  } else {
+    ok = RingReduceCore(
+        dtype, data, nbytes, wire, process_count_, process_index_,
+        ring_next_fd_, ring_prev_fd_,
+        (process_index_ + 1) % process_count_,
+        (process_index_ - 1 + process_count_) % process_count_);
+  }
+  Metrics::Get().Observe(
+      "ring.allreduce.seconds#algo=" + algo_label,
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count());
+  return ok;
+}
+
+// The chunked ring core, parameterized over an arbitrary cycle: the flat
+// ring runs it over all P processes; the hierarchical path runs it over
+// the per-host leaders only (so the compressed inter-host leg moves
+// ~1/local_size of the flat ring's cross-host bytes).
+bool ControlPlane::RingReduceCore(const std::string& dtype, char* data,
+                                  int64_t nbytes, int wire, int np, int rp,
+                                  int next_fd, int prev_fd, int next_peer,
+                                  int prev_peer) {
+  const int P = np;
+  const int r = rp;
   const int elem = DtypeSize(dtype);
   if (elem <= 0 || nbytes % elem != 0) return false;
   const int64_t n_elems = nbytes / elem;
@@ -1096,7 +1182,10 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   // the fp32-equivalent payload, so compression ratio falls out as
   // raw_bytes / bytes.
   const std::string wire_label =
-      wire_dtype.empty() ? std::string("fp32") : wire_dtype;
+      wire == kWireRaw ? std::string("fp32")
+      : wire == kWireBf16 ? std::string("bf16")
+      : wire == kWireFp16 ? std::string("fp16")
+                          : std::string("int8");
   Metrics& mx = Metrics::Get();
   std::atomic<long long>* c_sent =
       mx.Counter("ring.allreduce.bytes_sent#wire=" + wire_label);
@@ -1131,11 +1220,16 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   // Receive-side double buffer + one in-flight decode per slot: the
   // reduce of sub-chunk k runs on a helper thread while sub-chunk k+1 is
   // on the wire.  Raw wires size the slots by the payload element width.
+  // The slots live in the per-plane scratch pool (grown, never shrunk),
+  // so steady-state collectives allocate nothing.
+  auto ensure = [](std::vector<char>& v, size_t n) {
+    if (v.size() < n) v.resize(n);
+  };
   const int64_t chunk_wire_cap =
       wire == kWireRaw ? CH * elem : WireChunkBytes(wire, CH);
-  std::vector<char> rbuf[2];
-  rbuf[0].resize(size_t(chunk_wire_cap));
-  rbuf[1].resize(size_t(chunk_wire_cap));
+  std::vector<char>* rbuf = rbuf_;
+  ensure(rbuf[0], size_t(chunk_wire_cap));
+  ensure(rbuf[1], size_t(chunk_wire_cap));
   std::future<bool> pending[2];
   auto drain = [&pending]() {
     bool ok = true;
@@ -1144,8 +1238,8 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
     return ok;
   };
 
-  std::vector<char> sbuf;   // encode staging (compressed wires only)
-  if (wire != kWireRaw) sbuf.resize(size_t(chunk_wire_cap));
+  std::vector<char>& sbuf = sbuf_;   // encode staging (compressed wires)
+  if (wire != kWireRaw) ensure(sbuf, size_t(chunk_wire_cap));
 
   auto wire_bytes_of = [&](int64_t n) {
     return wire == kWireRaw ? n * elem : WireChunkBytes(wire, n);
@@ -1187,8 +1281,8 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
         drain();
         return false;
       }
-      if (!RingXfer(ring_next_fd_, sptr, size_t(swire),
-                    ring_prev_fd_, rptr, size_t(rwire))) {
+      if (!Xfer(next_fd, sptr, size_t(swire), prev_fd, rptr, size_t(rwire),
+                next_peer, prev_peer)) {
         drain();
         return false;
       }
@@ -1238,8 +1332,9 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
       int recv_seg = (r - s + P) % P;
       int64_t sbytes = seg_elems(send_seg) * elem;
       int64_t rbytes = seg_elems(recv_seg) * elem;
-      if (!RingXfer(ring_next_fd_, seg_base(send_seg), size_t(sbytes),
-                    ring_prev_fd_, seg_base(recv_seg), size_t(rbytes))) {
+      if (!Xfer(next_fd, seg_base(send_seg), size_t(sbytes),
+                prev_fd, seg_base(recv_seg), size_t(rbytes),
+                next_peer, prev_peer)) {
         return false;
       }
       data_bytes_sent_ += sbytes;
@@ -1261,9 +1356,9 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   // own (exact fp32) segment.
   int64_t max_seg = 0;
   for (int i = 0; i < P; ++i) max_seg = std::max(max_seg, seg_elems(i));
-  std::vector<char> wseg[2];
-  wseg[0].resize(size_t(WireSegmentBytes(wire, max_seg)));
-  wseg[1].resize(size_t(WireSegmentBytes(wire, max_seg)));
+  std::vector<char>* wseg = wseg_;
+  ensure(wseg[0], size_t(WireSegmentBytes(wire, max_seg)));
+  ensure(wseg[1], size_t(WireSegmentBytes(wire, max_seg)));
   int cur = 0;
   {
     // Encode our own reduced segment — the one sent at step 0.
@@ -1301,8 +1396,9 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
         drain();
         return false;
       }
-      if (!RingXfer(ring_next_fd_, sw + s_off, size_t(swire),
-                    ring_prev_fd_, rw + r_off, size_t(rwire))) {
+      if (!Xfer(next_fd, sw + s_off, size_t(swire),
+                prev_fd, rw + r_off, size_t(rwire),
+                next_peer, prev_peer)) {
         drain();
         return false;
       }
@@ -1331,6 +1427,449 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
     }
     if (!(drain() && ok)) return false;
     cur ^= 1;   // the image just received is next step's forward
+  }
+  return true;
+}
+
+// Lazy bootstrap of the two-level topology.  Leader election is pure
+// bookkeeping over the ring-setup fingerprint book (lowest process index
+// per host wins); the fan-in connections are established with a
+// deadlock-free ordering: every leader opens its listeners BEFORE the
+// record allgather (which doubles as the barrier — a record in the book
+// implies its listeners exist), then everyone dials, then leaders accept
+// and classify inbound connections by the 8-byte pidx handshake.
+bool ControlPlane::EnsureHierarchy() {
+  if (hier_state_ == 1) return true;
+  if (hier_state_ == -1) return false;
+  hier_state_ = -1;   // sticky: flipped to ready only on full success
+
+  if (int(host_fps_.size()) != process_count_) return false;
+  std::unordered_map<std::string, std::vector<int>> groups;
+  for (int p = 0; p < process_count_; ++p)
+    groups[host_fps_[size_t(p)]].push_back(p);
+  group_ = groups[my_fp_];
+  if (group_.empty()) return false;
+  const int my_leader = group_.front();
+  is_leader_ = (my_leader == process_index_);
+  leaders_.clear();
+  for (int p = 0; p < process_count_; ++p) {
+    if (groups[host_fps_[size_t(p)]].front() == p) leaders_.push_back(p);
+  }
+  my_leader_pos_ = -1;
+  for (size_t i = 0; i < leaders_.size(); ++i)
+    if (leaders_[i] == my_leader) my_leader_pos_ = int(i);
+  if (my_leader_pos_ < 0) return false;
+  const int L = int(leaders_.size());
+
+  // Leaders: listeners first (TCP for remote members/leaders, UDS for the
+  // co-located fan-in — the same on-host fast path the flat ring uses).
+  const char* uds_env = getenv("HOROVOD_TPU_UDS");
+  const bool uds_enabled = !(uds_env && std::string(uds_env) == "0");
+  int lport = 0, tcp_listen = -1, uds_listen = -1;
+  std::string uds_path;
+  auto cleanup = [&]() {
+    CloseFd(tcp_listen);
+    CloseFd(uds_listen);
+    if (!uds_path.empty()) unlink(uds_path.c_str());
+  };
+  if (is_leader_) {
+    tcp_listen = Listen(0, &lport);
+    if (tcp_listen < 0) return false;
+    if (uds_enabled) {
+      uds_path = "/tmp/htpu_hier_" + std::to_string(getpid()) + "_" +
+                 std::to_string(lport) + ".sock";
+      uds_listen = ListenUnix(uds_path);
+      if (uds_listen < 0) uds_path.clear();
+    }
+  }
+
+  // Record exchange over the existing ring (newline-terminated records —
+  // RingAllgather concatenates contributions without separators).
+  std::string rec = std::to_string(process_index_) + "\t" + adv_host_ +
+                    "\t" + std::to_string(lport) + "\t" + uds_path + "\n";
+  std::string book;
+  if (!RingAllgather(rec, &book)) {
+    cleanup();
+    return false;
+  }
+  std::vector<std::string> hosts(static_cast<size_t>(process_count_));
+  std::vector<int> ports(static_cast<size_t>(process_count_), 0);
+  std::vector<std::string> uds_paths(static_cast<size_t>(process_count_));
+  size_t pos = 0;
+  int parsed = 0;
+  while (pos < book.size()) {
+    size_t nl = book.find('\n', pos);
+    if (nl == std::string::npos) break;
+    std::string line = book.substr(pos, nl - pos);
+    pos = nl + 1;
+    std::vector<std::string> fields;
+    size_t fpos = 0;
+    while (fpos <= line.size()) {
+      size_t tab = line.find('\t', fpos);
+      fields.push_back(line.substr(
+          fpos, tab == std::string::npos ? tab : tab - fpos));
+      if (tab == std::string::npos) break;
+      fpos = tab + 1;
+    }
+    if (fields.size() < 4) {
+      cleanup();
+      return false;
+    }
+    int pidx = std::stoi(fields[0]);
+    if (pidx < 0 || pidx >= process_count_) {
+      cleanup();
+      return false;
+    }
+    hosts[size_t(pidx)] = fields[1];
+    ports[size_t(pidx)] = std::stoi(fields[2]);
+    uds_paths[size_t(pidx)] = fields[3];
+    ++parsed;
+  }
+  if (parsed != process_count_) {
+    cleanup();
+    return false;
+  }
+
+  // Dials (listeners all exist now; connect() completes via the kernel
+  // backlog even before the leader reaches accept, so dial-before-accept
+  // cannot deadlock).
+  if (!is_leader_) {
+    if (uds_enabled && !uds_paths[size_t(my_leader)].empty()) {
+      leader_fd_ = DialUnixRetry(uds_paths[size_t(my_leader)],
+                                 timeout_ms_ < 5000 ? timeout_ms_ : 5000);
+    }
+    if (leader_fd_ < 0) {
+      leader_fd_ = DialRetry(hosts[size_t(my_leader)],
+                             ports[size_t(my_leader)], timeout_ms_);
+    }
+    if (leader_fd_ < 0 ||
+        !SendFrame(leader_fd_, HandshakeBlob(process_index_, first_rank_))) {
+      cleanup();
+      return false;
+    }
+    cleanup();
+    hier_state_ = 1;
+    return true;
+  }
+
+  if (L > 1) {
+    // Leader ring: dial the next leader (always TCP — distinct
+    // fingerprints mean distinct hosts, or a faked test layout where
+    // loopback TCP still routes).
+    const int nxt = leaders_[size_t((my_leader_pos_ + 1) % L)];
+    leader_next_fd_ = DialRetry(hosts[size_t(nxt)], ports[size_t(nxt)],
+                                timeout_ms_);
+    if (leader_next_fd_ < 0 ||
+        !SendFrame(leader_next_fd_,
+                   HandshakeBlob(process_index_, first_rank_))) {
+      cleanup();
+      return false;
+    }
+  }
+
+  // Accept members (group_size - 1) plus, when L > 1, the previous
+  // leader; classify by the handshake's process index.
+  std::unordered_map<int, int> member_by_pidx;
+  const int expect = int(group_.size()) - 1 + (L > 1 ? 1 : 0);
+  for (int a = 0; a < expect; ++a) {
+    int fd = AcceptEither(tcp_listen, uds_listen, timeout_ms_);
+    std::string hs;
+    int pidx = -1, frank = -1;
+    if (fd < 0 || !RecvFrame(fd, &hs, timeout_ms_) ||
+        !ParseHandshake(hs, &pidx, &frank) || pidx < 0 ||
+        pidx >= process_count_) {
+      CloseFd(fd);
+      cleanup();
+      return false;
+    }
+    if (host_fps_[size_t(pidx)] == my_fp_) {
+      member_by_pidx[pidx] = fd;
+    } else if (leader_prev_fd_ < 0) {
+      leader_prev_fd_ = fd;
+    } else {
+      CloseFd(fd);
+      cleanup();
+      return false;
+    }
+  }
+  member_fds_.clear();
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    auto it = member_by_pidx.find(group_[gi]);
+    if (it == member_by_pidx.end()) {
+      cleanup();
+      return false;
+    }
+    member_fds_.push_back(it->second);
+  }
+  if (L > 1 && leader_prev_fd_ < 0) {
+    cleanup();
+    return false;
+  }
+  cleanup();
+  hier_state_ = 1;
+  return true;
+}
+
+// Two-level allreduce: raw intra-host fan-in to the leader (UDS —
+// re-encoding on-host links buys nothing and would compound quantization
+// error), the compressed ring core among leaders only, raw fan-out back.
+// Inter-host bytes drop by ~local_size vs the flat ring because only one
+// process per host participates in the cross-host cycle.
+bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
+                                         char* data, int64_t nbytes,
+                                         int wire) {
+  if (!EnsureHierarchy()) {
+    last_error_rank_ = first_rank_;
+    last_error_ = "hierarchical allreduce: host-group topology setup failed";
+    return false;
+  }
+  Metrics& mx = Metrics::Get();
+  std::atomic<long long>* l_sent = mx.Counter("ring.hier_local.bytes_sent");
+  std::atomic<long long>* l_recv = mx.Counter("ring.hier_local.bytes_recv");
+  const int my_leader = group_.front();
+
+  if (!is_leader_) {
+    if (!Xfer(leader_fd_, data, size_t(nbytes), -1, nullptr, 0,
+              my_leader, my_leader)) {
+      return false;
+    }
+    data_bytes_sent_ += nbytes;
+    l_sent->fetch_add(nbytes, std::memory_order_relaxed);
+    if (!Xfer(-1, nullptr, 0, leader_fd_, data, size_t(nbytes),
+              my_leader, my_leader)) {
+      return false;
+    }
+    data_bytes_recv_ += nbytes;
+    l_recv->fetch_add(nbytes, std::memory_order_relaxed);
+    return true;
+  }
+
+  // Leader: deterministic fan-in order (ascending member process index)
+  // so every host computes the same partial-sum association.
+  if (hier_buf_.size() < size_t(nbytes)) hier_buf_.resize(size_t(nbytes));
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    const int m = group_[gi];
+    if (!Xfer(-1, nullptr, 0, member_fds_[gi - 1], hier_buf_.data(),
+              size_t(nbytes), m, m)) {
+      return false;
+    }
+    data_bytes_recv_ += nbytes;
+    l_recv->fetch_add(nbytes, std::memory_order_relaxed);
+    if (!SumInto(dtype, data, hier_buf_.data(), nbytes)) return false;
+  }
+
+  const int L = int(leaders_.size());
+  if (L > 1) {
+    if (!RingReduceCore(dtype, data, nbytes, wire, L, my_leader_pos_,
+                        leader_next_fd_, leader_prev_fd_,
+                        leaders_[size_t((my_leader_pos_ + 1) % L)],
+                        leaders_[size_t((my_leader_pos_ - 1 + L) % L)])) {
+      return false;
+    }
+  }
+
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    if (!Xfer(member_fds_[gi - 1], data, size_t(nbytes), -1, nullptr, 0,
+              group_[gi], group_[gi])) {
+      return false;
+    }
+    data_bytes_sent_ += nbytes;
+    l_sent->fetch_add(nbytes, std::memory_order_relaxed);
+  }
+  return true;
+}
+
+// Latency-optimal small-tensor path: whole-payload frames instead of the
+// ring's 2*(P-1) segment hops — gather-to-leader, a reduce chain up the
+// leader list, the total flowing back down the same duplex sockets, and
+// a leader fan-out.  Every cross-process frame honours the negotiated
+// wire compression and bumps the standard per-wire counters (the
+// reconcile test covers this path for sub-crossover payloads).
+bool ControlPlane::SmallAllreduce(const std::string& dtype, char* data,
+                                  int64_t nbytes, int wire) {
+  if (!EnsureHierarchy()) {
+    last_error_rank_ = first_rank_;
+    last_error_ = "small allreduce: host-group topology setup failed";
+    return false;
+  }
+  const int elem = DtypeSize(dtype);
+  const int64_t n_elems = nbytes / elem;
+  const int64_t CH = kSubChunkElems;
+  const int64_t wbytes =
+      wire == kWireRaw ? nbytes : WireSegmentBytes(wire, n_elems);
+
+  const std::string wire_label =
+      wire == kWireRaw ? std::string("fp32")
+      : wire == kWireBf16 ? std::string("bf16")
+      : wire == kWireFp16 ? std::string("fp16")
+                          : std::string("int8");
+  Metrics& mx = Metrics::Get();
+  std::atomic<long long>* c_sent =
+      mx.Counter("ring.allreduce.bytes_sent#wire=" + wire_label);
+  std::atomic<long long>* c_recv =
+      mx.Counter("ring.allreduce.bytes_recv#wire=" + wire_label);
+  std::atomic<long long>* c_raw_sent =
+      mx.Counter("ring.allreduce.raw_bytes_sent#wire=" + wire_label);
+  std::atomic<long long>* c_raw_recv =
+      mx.Counter("ring.allreduce.raw_bytes_recv#wire=" + wire_label);
+  std::atomic<long long>* c_chunks =
+      mx.Counter("ring.allreduce.chunks_sent#wire=" + wire_label);
+  auto count_sent = [&]() {
+    data_bytes_sent_ += wbytes;
+    c_sent->fetch_add(wbytes, std::memory_order_relaxed);
+    c_raw_sent->fetch_add(nbytes, std::memory_order_relaxed);
+    c_chunks->fetch_add(1, std::memory_order_relaxed);
+  };
+  auto count_recv = [&]() {
+    data_bytes_recv_ += wbytes;
+    c_recv->fetch_add(wbytes, std::memory_order_relaxed);
+    c_raw_recv->fetch_add(nbytes, std::memory_order_relaxed);
+  };
+
+  // Whole-payload codec helpers (sub-chunk framing, same wire images the
+  // ring core produces).
+  auto encode_all = [&](const char* src, char* out) {
+    const float* f = reinterpret_cast<const float*>(src);
+    char* o = out;
+    for (int64_t lo = 0; lo < n_elems; lo += CH) {
+      const int64_t len = std::min(CH, n_elems - lo);
+      EncodeWireChunk(wire, f + lo, len, o);
+      o += WireChunkBytes(wire, len);
+    }
+  };
+  auto decode_all = [&](const char* in, char* dst) {
+    float* f = reinterpret_cast<float*>(dst);
+    const char* i = in;
+    for (int64_t lo = 0; lo < n_elems; lo += CH) {
+      const int64_t len = std::min(CH, n_elems - lo);
+      DecodeWireChunk(wire, i, len, f + lo);
+      i += WireChunkBytes(wire, len);
+    }
+  };
+  auto decode_add_all = [&](const char* in, char* dst) {
+    float* f = reinterpret_cast<float*>(dst);
+    const char* i = in;
+    for (int64_t lo = 0; lo < n_elems; lo += CH) {
+      const int64_t len = std::min(CH, n_elems - lo);
+      DecodeWireChunkAdd(wire, i, len, f + lo);
+      i += WireChunkBytes(wire, len);
+    }
+  };
+
+  if (sbuf_.size() < size_t(wbytes)) sbuf_.resize(size_t(wbytes));
+  if (rbuf_[0].size() < size_t(wbytes)) rbuf_[0].resize(size_t(wbytes));
+  const int my_leader = group_.front();
+
+  if (!is_leader_) {
+    const char* sptr = data;
+    if (wire != kWireRaw) {
+      encode_all(data, sbuf_.data());
+      sptr = sbuf_.data();
+    }
+    if (!Xfer(leader_fd_, sptr, size_t(wbytes), -1, nullptr, 0,
+              my_leader, my_leader)) {
+      return false;
+    }
+    count_sent();
+    char* rptr = wire == kWireRaw ? data : rbuf_[0].data();
+    if (!Xfer(-1, nullptr, 0, leader_fd_, rptr, size_t(wbytes),
+              my_leader, my_leader)) {
+      return false;
+    }
+    count_recv();
+    if (wire != kWireRaw) decode_all(rbuf_[0].data(), data);
+    return true;
+  }
+
+  // Leader: gather + reduce members (ascending process index).
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    const int m = group_[gi];
+    if (!Xfer(-1, nullptr, 0, member_fds_[gi - 1], rbuf_[0].data(),
+              size_t(wbytes), m, m)) {
+      return false;
+    }
+    count_recv();
+    if (wire == kWireRaw) {
+      if (!SumInto(dtype, data, rbuf_[0].data(), nbytes)) return false;
+    } else {
+      decode_add_all(rbuf_[0].data(), data);
+    }
+  }
+
+  // Leader chain: partials flow up positions 0..L-1, the total flows back
+  // down the same duplex sockets.  total_img is what the fan-out ships.
+  const int L = int(leaders_.size());
+  const int p = my_leader_pos_;
+  const char* total_img = data;
+  if (L > 1) {
+    if (p > 0) {
+      if (!Xfer(-1, nullptr, 0, leader_prev_fd_, rbuf_[0].data(),
+                size_t(wbytes), leaders_[size_t(p - 1)],
+                leaders_[size_t(p - 1)])) {
+        return false;
+      }
+      count_recv();
+      if (wire == kWireRaw) {
+        if (!SumInto(dtype, data, rbuf_[0].data(), nbytes)) return false;
+      } else {
+        decode_add_all(rbuf_[0].data(), data);
+      }
+    }
+    if (p < L - 1) {
+      const char* sptr = data;
+      if (wire != kWireRaw) {
+        encode_all(data, sbuf_.data());
+        sptr = sbuf_.data();
+      }
+      if (!Xfer(leader_next_fd_, sptr, size_t(wbytes), -1, nullptr, 0,
+                leaders_[size_t(p + 1)], leaders_[size_t(p + 1)])) {
+        return false;
+      }
+      count_sent();
+      char* rptr = wire == kWireRaw ? data : rbuf_[0].data();
+      if (!Xfer(-1, nullptr, 0, leader_next_fd_, rptr, size_t(wbytes),
+                leaders_[size_t(p + 1)], leaders_[size_t(p + 1)])) {
+        return false;
+      }
+      count_recv();
+      if (p > 0) {
+        // Forward the total image down before decoding (latency: the
+        // downstream leader starts its fan-out sooner).
+        if (!Xfer(leader_prev_fd_, rptr, size_t(wbytes), -1, nullptr, 0,
+                  leaders_[size_t(p - 1)], leaders_[size_t(p - 1)])) {
+          return false;
+        }
+        count_sent();
+      }
+      if (wire != kWireRaw) {
+        decode_all(rbuf_[0].data(), data);
+        total_img = rbuf_[0].data();
+      }
+    } else {
+      // Top of the chain: this leader holds the exact total; encode once
+      // and send it down.
+      if (wire != kWireRaw) {
+        encode_all(data, sbuf_.data());
+        total_img = sbuf_.data();
+      }
+      if (!Xfer(leader_prev_fd_, total_img, size_t(wbytes), -1, nullptr, 0,
+                leaders_[size_t(p - 1)], leaders_[size_t(p - 1)])) {
+        return false;
+      }
+      count_sent();
+    }
+  } else if (wire != kWireRaw && group_.size() > 1) {
+    encode_all(data, sbuf_.data());
+    total_img = sbuf_.data();
+  }
+
+  // Fan-out the total image to the members.
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    if (!Xfer(member_fds_[gi - 1], total_img, size_t(wbytes), -1, nullptr,
+              0, group_[gi], group_[gi])) {
+      return false;
+    }
+    count_sent();
   }
   return true;
 }

@@ -69,8 +69,14 @@ class ControlPlane {
   // accumulator on receive, and every segment moves in ~256 KiB
   // sub-chunks double-buffered so the dequantize/SumInto of chunk k
   // overlaps the duplex transfer of chunk k+1.
+  // `algo` is the coordinator's resolved collective algorithm for this
+  // payload: "" = flat ring, "hier" = two-level hierarchical (intra-host
+  // fan-in to a per-host leader, compressed ring among leaders only,
+  // intra-host fan-out), "small" = latency-optimal single-frame
+  // gather-to-leader + broadcast for sub-crossover payloads.
   bool AllreduceBuf(const std::string& dtype, char* data, int64_t nbytes,
-                    const std::string& wire_dtype = std::string());
+                    const std::string& wire_dtype = std::string(),
+                    const std::string& algo = std::string());
   bool Allgather(const std::string& in, std::string* out);
   bool Broadcast(int root_process, const std::string& in, std::string* out);
 
@@ -141,10 +147,32 @@ class ControlPlane {
   // True (and records the abort as last_error) when the plane is aborted —
   // the data-plane entry points fail fast instead of touching dead sockets.
   bool AbortedFailFast();
-  // DuplexTransfer wrapper that attributes a failure to the ring
-  // neighbour whose fd died (recorded in last_error_*).
+  // DuplexTransfer wrapper that attributes a failure to the peer PROCESS
+  // whose fd died (recorded in last_error_*).  send_peer / recv_peer are
+  // process indices; RingXfer delegates with the ring neighbours.
+  bool Xfer(int send_fd, const char* send_buf, size_t send_len,
+            int recv_fd, char* recv_buf, size_t recv_len,
+            int send_peer, int recv_peer);
   bool RingXfer(int send_fd, const char* send_buf, size_t send_len,
                 int recv_fd, char* recv_buf, size_t recv_len);
+
+  // Chunked ring reduce-scatter + allgather over an arbitrary cycle of
+  // `np` fds (the flat ring and the hierarchical inter-host leader ring
+  // both ride this core).  `rp` is this process's position in the cycle;
+  // next_peer / prev_peer are the neighbours' process indices for failure
+  // attribution.  Bumps the standard per-wire ring.allreduce.* counters.
+  bool RingReduceCore(const std::string& dtype, char* data, int64_t nbytes,
+                      int wire, int np, int rp, int next_fd, int prev_fd,
+                      int next_peer, int prev_peer);
+
+  // Lazy bootstrap of the two-level topology (leader election from the
+  // ring-setup host fingerprints + leader fan-in connections).  Sticky:
+  // a setup failure fails every later hier/small collective.
+  bool EnsureHierarchy();
+  bool HierarchicalAllreduce(const std::string& dtype, char* data,
+                             int64_t nbytes, int wire);
+  bool SmallAllreduce(const std::string& dtype, char* data, int64_t nbytes,
+                      int wire);
 
   // ---- response cache (negotiation bitvector ticks) ----
   // Client half, run by EVERY process on its own outbound frame (the
@@ -210,6 +238,31 @@ class ControlPlane {
   std::vector<int> all_first_ranks_;  // first global rank per process index
   long long data_bytes_sent_ = 0;
   long long data_bytes_recv_ = 0;
+
+  // Host topology persisted from the ring-setup address book (leader
+  // election inputs for the hierarchical paths).
+  std::vector<std::string> host_fps_;   // fingerprint per process index
+  std::string my_fp_;
+  std::string adv_host_;                // address advertised in the book
+
+  // Two-level hierarchy (EnsureHierarchy): per-host groups keyed by
+  // fingerprint, leader = lowest process index per group.
+  int hier_state_ = 0;                  // 0 unset / 1 ready / -1 failed
+  bool is_leader_ = false;
+  std::vector<int> group_;              // process indices on my host, asc
+  std::vector<int> leaders_;            // leader process index per host, asc
+  int my_leader_pos_ = -1;              // my (group's) position in leaders_
+  int leader_fd_ = -1;                  // member -> its leader (UDS or TCP)
+  std::vector<int> member_fds_;         // leader -> members (group_[1..])
+  int leader_next_fd_ = -1;             // leader -> next leader (dialed)
+  int leader_prev_fd_ = -1;             // leader <- prev leader (accepted)
+
+  // Data-plane scratch pool: buffers are reused (never shrunk) across
+  // collectives so steady-state allreduces allocate nothing.
+  std::vector<char> rbuf_[2];           // double-buffered receive slots
+  std::vector<char> sbuf_;              // wire-encode staging
+  std::vector<char> wseg_[2];           // compressed allgather images
+  std::vector<char> hier_buf_;          // raw intra-host fan-in staging
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
   std::atomic<Timeline*> timeline_{nullptr};  // coordinator only; not owned

@@ -22,6 +22,7 @@ std::vector<Response> PlanFusion(
     merged.tensor_names = r.tensor_names;
     merged.devices = r.devices;
     merged.wire_dtype = r.wire_dtype;
+    merged.algo = r.algo;
     int64_t total = 0;
     for (const auto& n : merged.tensor_names) total += entry_bytes(n);
     std::string dtype = entry_dtype(merged.tensor_names[0]);
@@ -34,6 +35,9 @@ std::vector<Response> PlanFusion(
       // A fused buffer rides the ring as one payload with one wire
       // format — only merge entries that negotiated the same one.
       if (nxt.wire_dtype != merged.wire_dtype) break;
+      // Likewise one collective algorithm per fused payload: the data
+      // plane walks a single hop schedule for the whole buffer.
+      if (nxt.algo != merged.algo) break;
       int64_t nbytes = 0;
       for (const auto& n : nxt.tensor_names) nbytes += entry_bytes(n);
       if (total + nbytes > threshold) break;

@@ -4,6 +4,7 @@
 #include <stdexcept>
 
 #include "htpu/metrics.h"
+#include "htpu/reduce.h"
 
 namespace htpu {
 
@@ -79,6 +80,25 @@ Response MessageTable::ConstructResponse(const std::string& name) {
                 "dtype " + wire_name(wire0) +
                 ", but another rank requested wire dtype " +
                 wire_name(requests[i].wire_dtype) + ".";
+      }
+    }
+  }
+
+  // The collective algorithm must be uniform too: every process walks the
+  // same hierarchy (leader fan-in vs flat ring) step for step, so
+  // disagreeing ranks would deadlock the data plane.  Same coordinated-
+  // error style as the wire-compression check.
+  if (error.empty()) {
+    auto algo_name = [](const std::string& a) {
+      return a.empty() ? std::string("ring") : a;
+    };
+    const std::string& algo0 = requests[0].algo;
+    for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+      if (requests[i].algo != algo0) {
+        error = "Mismatched allreduce algorithm: One rank requested "
+                "algorithm " + algo_name(algo0) +
+                ", but another rank requested algorithm " +
+                algo_name(requests[i].algo) + ".";
       }
     }
   }
@@ -179,6 +199,12 @@ Response MessageTable::ConstructResponse(const std::string& name) {
   // `requests` aliases the table entry — copy out everything still needed
   // before the erase invalidates it.
   std::string wire_dtype = requests[0].wire_dtype;
+  std::string algo;
+  if (message_type == RequestType::ALLREDUCE) {
+    int64_t nbytes = int64_t(DtypeSize(requests[0].tensor_type));
+    for (int64_t d : requests[0].tensor_shape) nbytes *= d;
+    algo = ResolveAlgo(requests[0].algo, nbytes);
+  }
 
   // Negotiation latency: first request seen -> response constructed.
   Metrics::Get().Observe(
@@ -200,10 +226,23 @@ Response MessageTable::ConstructResponse(const std::string& name) {
     resp.tensor_sizes = std::move(tensor_sizes);
   } else if (message_type == RequestType::ALLREDUCE) {
     resp.response_type = ResponseType::ALLREDUCE;
+    resp.algo = std::move(algo);
   } else {
     resp.response_type = ResponseType::BROADCAST;
   }
   return resp;
+}
+
+std::string MessageTable::ResolveAlgo(const std::string& pref,
+                                      int64_t nbytes) const {
+  if (pref.empty() || pref == "ring") return "";
+  if (pref != "auto") return pref;  // explicit "hier" / "small"
+  // auto: latency-optimal gather/broadcast chain under the crossover,
+  // hierarchical when there are multiple hosts with co-located processes
+  // to exploit, flat ring otherwise.
+  if (nbytes <= algo_crossover_bytes_) return "small";
+  if (algo_num_hosts_ > 1 && algo_num_hosts_ < algo_num_procs_) return "hier";
+  return "";
 }
 
 std::vector<StallInfo> MessageTable::Stalled(double age_s) const {

@@ -27,9 +27,27 @@ struct StallInfo {
   std::vector<int> missing_ranks;
 };
 
+// Default payload-size crossover (bytes) below which "auto" algorithm
+// selection picks the latency-optimal small-tensor path over the
+// bandwidth-optimal ring.  Measurable per deployment via the bench sweep
+// (docs/benchmarks.md) and overridable with HOROVOD_TPU_ALLREDUCE_CROSSOVER.
+constexpr int64_t kDefaultAlgoCrossoverBytes = 64 * 1024;
+
 class MessageTable {
  public:
   explicit MessageTable(int size) : size_(size) {}
+
+  // Topology + crossover inputs for resolving algo="auto" on allreduce
+  // responses: number of distinct hosts, number of processes, and the
+  // payload-size crossover below which the small-tensor path wins.
+  // Defaults (1 host, 1 process) resolve every auto to ring/small by size
+  // alone — the single-process controller's behavior.
+  void ConfigureAlgoSelection(int num_hosts, int num_procs,
+                              int64_t crossover_bytes) {
+    algo_num_hosts_ = num_hosts;
+    algo_num_procs_ = num_procs;
+    algo_crossover_bytes_ = crossover_bytes;
+  }
 
   // Record one rank's request; returns true when all ranks have reported
   // for this tensor name.
@@ -51,7 +69,14 @@ class MessageTable {
     std::vector<Request> requests;
     std::chrono::steady_clock::time_point first_seen;
   };
+  // Resolve a validated algo preference into the concrete algorithm for a
+  // payload of `nbytes` ("" = ring, "hier", "small").
+  std::string ResolveAlgo(const std::string& pref, int64_t nbytes) const;
+
   int size_;
+  int algo_num_hosts_ = 1;
+  int algo_num_procs_ = 1;
+  int64_t algo_crossover_bytes_ = kDefaultAlgoCrossoverBytes;
   std::unordered_map<std::string, Entry> table_;
 };
 

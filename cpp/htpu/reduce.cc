@@ -1,15 +1,28 @@
 #include "htpu/reduce.h"
 
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
 #include "htpu/half.h"
 
 namespace htpu {
 
 namespace {
 
+// Below this element count the fork/join handshake costs more than the
+// memory-bound sum saves; measured crossover sits well under 256K on
+// current hosts, so the threshold is conservative.
+constexpr int64_t kParallelSumMinElems = 256 * 1024;
+
 template <typename T>
 void TypedSum(void* acc, const void* in, int64_t n) {
   T* a = static_cast<T*>(acc);
   const T* b = static_cast<const T*>(in);
+#pragma omp simd
   for (int64_t i = 0; i < n; ++i) a[i] += b[i];
 }
 
@@ -18,25 +31,11 @@ void BoolOr(void* acc, const void* in, int64_t n) {
   // bool add semantics.
   uint8_t* a = static_cast<uint8_t*>(acc);
   const uint8_t* b = static_cast<const uint8_t*>(in);
+#pragma omp simd
   for (int64_t i = 0; i < n; ++i) a[i] = (a[i] | b[i]) ? 1 : 0;
 }
 
-}  // namespace
-
-int DtypeSize(const std::string& d) {
-  if (d == "float32" || d == "int32" || d == "uint32") return 4;
-  if (d == "float64" || d == "int64" || d == "uint64") return 8;
-  if (d == "float16" || d == "bfloat16" || d == "int16" || d == "uint16")
-    return 2;
-  if (d == "int8" || d == "uint8" || d == "bool") return 1;
-  return 0;
-}
-
-bool SumInto(const std::string& d, void* acc, const void* in,
-             int64_t nbytes) {
-  int esize = DtypeSize(d);
-  if (esize == 0 || nbytes % esize != 0) return false;
-  int64_t n = nbytes / esize;
+bool SumSerial(const std::string& d, void* acc, const void* in, int64_t n) {
   if (d == "float32") TypedSum<float>(acc, in, n);
   else if (d == "float64") TypedSum<double>(acc, in, n);
   else if (d == "int32") TypedSum<int32_t>(acc, in, n);
@@ -56,6 +55,114 @@ bool SumInto(const std::string& d, void* acc, const void* in,
   else if (d == "bool") BoolOr(acc, in, n);
   else return false;
   return true;
+}
+
+// Small persistent worker pool for large reductions.  Threads are created
+// once on first large SumInto and parked on a condition variable between
+// calls, so steady-state collectives pay only the wake/notify handshake —
+// no thread creation, no allocation.  The singleton is intentionally never
+// destroyed (workers would otherwise race static teardown at exit; the
+// object stays reachable, so leak checkers are quiet).
+class SumPool {
+ public:
+  static SumPool& Get() {
+    static SumPool* pool = new SumPool();
+    return *pool;
+  }
+
+  // Parts the pool splits work into: pool threads + the calling thread.
+  int width() const { return int(threads_.size()) + 1; }
+
+  // Invoke fn(part) for every part in [0, width()): part 0 on the caller,
+  // the rest on pool threads.  Returns once all parts have finished.
+  // Callers must not issue overlapping Run()s (collectives are serial per
+  // process, which already guarantees this).
+  void Run(const std::function<void(int)>& fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      fn_ = &fn;
+      pending_ = int(threads_.size());
+      ++generation_;
+    }
+    cv_.notify_all();
+    fn(0);
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return pending_ == 0; });
+    fn_ = nullptr;
+  }
+
+ private:
+  SumPool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    int extra = hw > 1 ? int(hw) - 1 : 0;
+    if (extra > 3) extra = 3;  // memory-bound: more buys nothing
+    for (int i = 0; i < extra; ++i) {
+      threads_.emplace_back([this, i] { Worker(i + 1); });
+      threads_.back().detach();
+    }
+  }
+
+  void Worker(int part) {
+    uint64_t seen = 0;
+    for (;;) {
+      const std::function<void(int)>* fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return generation_ != seen; });
+        seen = generation_;
+        fn = fn_;
+      }
+      (*fn)(part);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int)>* fn_ = nullptr;
+  int pending_ = 0;
+  uint64_t generation_ = 0;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace
+
+int DtypeSize(const std::string& d) {
+  if (d == "float32" || d == "int32" || d == "uint32") return 4;
+  if (d == "float64" || d == "int64" || d == "uint64") return 8;
+  if (d == "float16" || d == "bfloat16" || d == "int16" || d == "uint16")
+    return 2;
+  if (d == "int8" || d == "uint8" || d == "bool") return 1;
+  return 0;
+}
+
+bool SumInto(const std::string& d, void* acc, const void* in,
+             int64_t nbytes) {
+  int esize = DtypeSize(d);
+  if (esize == 0 || nbytes % esize != 0) return false;
+  int64_t n = nbytes / esize;
+  if (n < kParallelSumMinElems) return SumSerial(d, acc, in, n);
+  SumPool& pool = SumPool::Get();
+  const int width = pool.width();
+  if (width < 2) return SumSerial(d, acc, in, n);
+  // Contiguous disjoint element ranges, one per part.  Each element is
+  // still reduced by exactly the same a[i] += b[i] the serial path runs,
+  // so the result is bit-exact vs serial for every dtype (pinned by
+  // tests/test_reduce_parallel.py).
+  const int64_t base = n / width, rem = n % width;
+  std::atomic<bool> ok{true};
+  pool.Run([&](int part) {
+    const int64_t lo = int64_t(part) * base + (part < rem ? part : rem);
+    const int64_t len = base + (part < rem ? 1 : 0);
+    if (len == 0) return;
+    char* a = static_cast<char*>(acc) + lo * esize;
+    const char* b = static_cast<const char*>(in) + lo * esize;
+    if (!SumSerial(d, a, b, len)) ok.store(false, std::memory_order_relaxed);
+  });
+  return ok.load(std::memory_order_relaxed);
 }
 
 }  // namespace htpu

@@ -58,6 +58,11 @@ int Fail(int pidx, const char* what) {
 }
 
 int RunProcess(int pidx, int port) {
+  // Fake a two-host layout (procs 0+1 on one host, proc 2 alone) through
+  // the fingerprint override, so the hierarchical and small-tensor paths
+  // below have real host groups to work with.  Must be set before Create:
+  // the fingerprint rides the ring-bootstrap record exchange.
+  setenv("HOROVOD_TPU_HOST_FINGERPRINT", pidx < 2 ? "smokeA" : "smokeB", 1);
   auto cp = htpu::ControlPlane::Create(pidx, kProcs, "127.0.0.1", port,
                                        /*first_rank=*/pidx,
                                        /*nranks_total=*/kProcs,
@@ -184,6 +189,38 @@ int RunProcess(int pidx, int port) {
     }
     long long hits = atoll(js.c_str() + at + key.size());
     if (hits <= 0) return Fail(pidx, "cache_hits is zero after ramp");
+  }
+
+  // Hierarchical and small-tensor allreduce across the faked 2-host
+  // layout: UDS/TCP member bootstrap, raw intra-host fan-in/fan-out, the
+  // (optionally compressed) inter-host leader leg, and the latency
+  // path's whole-payload frames — all under the sanitizers.  Constant
+  // buffers keep int8's range-scaled quantization exact.
+  for (const char* algo : {"hier", "small"}) {
+    for (const char* wd : {"", "int8"}) {
+      std::vector<float> buf(2048, float(pidx + 1));
+      if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                            int64_t(buf.size() * sizeof(float)), wd, algo)) {
+        return Fail(pidx, "AllreduceBuf hier/small");
+      }
+      for (float v : buf) {
+        if (std::fabs(v - 6.0f) > 0.1f) return Fail(pidx, "hier/small value");
+      }
+    }
+  }
+  {
+    void* buf = nullptr;
+    int len = htpu_metrics_snapshot(&buf);
+    if (len <= 0 || !buf) return Fail(pidx, "algo metrics snapshot");
+    std::string js(static_cast<const char*>(buf), size_t(len));
+    htpu_free(buf);
+    for (const char* key : {"\"ring.allreduce.algo#algo=hier\":",
+                            "\"ring.allreduce.algo#algo=small\":"}) {
+      size_t at = js.find(key);
+      if (at == std::string::npos || atoll(js.c_str() + at + strlen(key)) < 2) {
+        return Fail(pidx, "per-algo op counter missing or low");
+      }
+    }
   }
 
   // Abort path: process 1 dies without shutdown; survivors keep ticking

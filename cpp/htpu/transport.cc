@@ -6,6 +6,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -27,20 +28,6 @@ bool WaitReadable(int fd, int timeout_ms) {
   p.events = POLLIN;
   int rc = poll(&p, 1, timeout_ms);
   return rc > 0 && (p.revents & POLLIN);
-}
-
-bool SendAll(int fd, const void* data, size_t len) {
-  const char* p = static_cast<const char*>(data);
-  while (len > 0) {
-    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
-    if (n <= 0) {
-      if (n < 0 && (errno == EINTR)) continue;
-      return false;
-    }
-    p += n;
-    len -= size_t(n);
-  }
-  return true;
 }
 
 // Robustness options applied to every connected control/ring socket:
@@ -211,9 +198,36 @@ bool SendFrame(int fd, const std::string& payload) {
   uint32_t len = uint32_t(payload.size());
   char hdr[4];
   for (int i = 0; i < 4; ++i) hdr[i] = char((len >> (8 * i)) & 0xff);
-  if (!(SendAll(fd, hdr, 4) &&
-        SendAll(fd, payload.data(), payload.size()))) {
-    return false;
+  // Header + payload leave in one gathered sendmsg: a control frame costs
+  // a single syscall (and, under TCP_NODELAY, a single segment) instead of
+  // the old header-then-payload pair.  Partial writes resume from `done`
+  // across both iovecs.
+  const size_t total = 4 + payload.size();
+  size_t done = 0;
+  while (done < total) {
+    struct iovec iov[2];
+    int niov = 0;
+    if (done < 4) {
+      iov[niov].iov_base = hdr + done;
+      iov[niov].iov_len = 4 - done;
+      ++niov;
+    }
+    const size_t poff = done < 4 ? 0 : done - 4;
+    if (poff < payload.size()) {
+      iov[niov].iov_base = const_cast<char*>(payload.data()) + poff;
+      iov[niov].iov_len = payload.size() - poff;
+      ++niov;
+    }
+    struct msghdr msg;
+    std::memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = iov;
+    msg.msg_iovlen = size_t(niov);
+    ssize_t w = sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    done += size_t(w);
   }
   static std::atomic<long long>* frames =
       Metrics::Get().Counter("transport.frames_sent");

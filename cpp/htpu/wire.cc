@@ -63,9 +63,19 @@ bool GetStr(const uint8_t* d, size_t len, size_t* pos, std::string* v) {
   return true;
 }
 
+// True when some message in the list carries an algorithm — only then is
+// the kFlagAlgoExt bit set, so ring-only ("") traffic stays byte-identical
+// to the pre-algo wire format.
+template <typename Vec>
+bool AnyAlgo(const Vec& msgs) {
+  for (const auto& m : msgs)
+    if (!m.algo.empty()) return true;
+  return false;
+}
+
 }  // namespace
 
-void SerializeRequest(const Request& r, std::string* out) {
+void SerializeRequest(const Request& r, std::string* out, bool with_algo) {
   PutI32(out, r.request_rank);
   PutI32(out, int32_t(r.request_type));
   PutStr(out, r.tensor_name);
@@ -75,9 +85,11 @@ void SerializeRequest(const Request& r, std::string* out) {
   PutI32(out, int32_t(r.tensor_shape.size()));
   for (int64_t d : r.tensor_shape) PutI64(out, d);
   PutStr(out, r.wire_dtype);
+  if (with_algo) PutStr(out, r.algo);
 }
 
-bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out) {
+bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out,
+                  bool with_algo) {
   int32_t type, ndims;
   if (!GetI32(data, len, pos, &out->request_rank)) return false;
   if (!GetI32(data, len, pos, &type)) return false;
@@ -91,10 +103,12 @@ bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out) {
   for (int i = 0; i < ndims; ++i)
     if (!GetI64(data, len, pos, &out->tensor_shape[size_t(i)])) return false;
   if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
+  out->algo.clear();
+  if (with_algo && !GetStr(data, len, pos, &out->algo)) return false;
   return true;
 }
 
-void SerializeResponse(const Response& r, std::string* out) {
+void SerializeResponse(const Response& r, std::string* out, bool with_algo) {
   PutI32(out, int32_t(r.response_type));
   PutI32(out, int32_t(r.tensor_names.size()));
   for (const auto& n : r.tensor_names) PutStr(out, n);
@@ -104,10 +118,11 @@ void SerializeResponse(const Response& r, std::string* out) {
   PutI32(out, int32_t(r.tensor_sizes.size()));
   for (int64_t s : r.tensor_sizes) PutI64(out, s);
   PutStr(out, r.wire_dtype);
+  if (with_algo) PutStr(out, r.algo);
 }
 
 bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
-                   Response* out) {
+                   Response* out, bool with_algo) {
   int32_t type, n;
   if (!GetI32(data, len, pos, &type)) return false;
   out->response_type = ResponseType(type);
@@ -125,6 +140,8 @@ bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
   for (int32_t i = 0; i < n; ++i)
     if (!GetI64(data, len, pos, &out->tensor_sizes[size_t(i)])) return false;
   if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
+  out->algo.clear();
+  if (with_algo && !GetStr(data, len, pos, &out->algo)) return false;
   return true;
 }
 
@@ -134,13 +151,15 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
   // helpers stay append-style).  Without the cache extension the frame is
   // byte-identical to the legacy format (flags byte == shutdown bool).
   out->clear();
+  const bool with_algo = AnyAlgo(l.requests);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
-                | (l.has_cache_ext ? kFlagCacheExt : 0);
+                | (l.has_cache_ext ? kFlagCacheExt : 0)
+                | (with_algo ? kFlagAlgoExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.requests.size()));
-  for (const auto& r : l.requests) SerializeRequest(r, out);
+  for (const auto& r : l.requests) SerializeRequest(r, out, with_algo);
   if (l.has_cache_ext) {
     PutI32(out, l.cache_epoch);
     PutStr(out, l.cache_bits);
@@ -154,12 +173,14 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   if (!GetI8(data, len, &pos, &flags)) return false;
   if (flags & ~kKnownFlags) return false;  // newer wire version
   out->shutdown = (flags & kFlagShutdown) != 0;
+  const bool with_algo = (flags & kFlagAlgoExt) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->requests.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
-    if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)])) return false;
+    if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)], with_algo))
+      return false;
   out->has_cache_ext = (flags & kFlagCacheExt) != 0;
   out->cache_epoch = 0;
   out->cache_bits.clear();
@@ -172,13 +193,15 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
 
 void SerializeResponseList(const ResponseList& l, std::string* out) {
   out->clear();  // whole frame — see SerializeRequestList
+  const bool with_algo = AnyAlgo(l.responses);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
-                | (l.has_cache_ext ? kFlagCacheExt : 0);
+                | (l.has_cache_ext ? kFlagCacheExt : 0)
+                | (with_algo ? kFlagAlgoExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.responses.size()));
-  for (const auto& r : l.responses) SerializeResponse(r, out);
+  for (const auto& r : l.responses) SerializeResponse(r, out, with_algo);
   if (l.has_cache_ext) {
     PutI32(out, l.cache_epoch);
     PutI8(out, l.cache_flags);
@@ -199,12 +222,15 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   if (!GetI8(data, len, &pos, &flags)) return false;
   if (flags & ~kKnownFlags) return false;  // newer wire version
   out->shutdown = (flags & kFlagShutdown) != 0;
+  const bool with_algo = (flags & kFlagAlgoExt) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->responses.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
-    if (!ParseResponse(data, len, &pos, &out->responses[size_t(i)])) return false;
+    if (!ParseResponse(data, len, &pos, &out->responses[size_t(i)],
+                       with_algo))
+      return false;
   out->has_cache_ext = (flags & kFlagCacheExt) != 0;
   out->cache_epoch = 0;
   out->cache_flags = 0;

@@ -11,9 +11,9 @@
 // vec<T> = i32 count + elements.
 //
 // Request  := rank:i32 type:i32 name:str dtype:str root:i32 device:i32
-//             shape:vec<i64> wire_dtype:str
+//             shape:vec<i64> wire_dtype:str [algo:str]
 // Response := type:i32 names:vec<str> error:str devices:vec<i32>
-//             sizes:vec<i64> wire_dtype:str
+//             sizes:vec<i64> wire_dtype:str [algo:str]
 // RequestList  := flags:i8 abort_rank:i32 abort_reason:str
 //                 requests:vec<Request> [cache_epoch:i32 bits:str]
 // ResponseList := flags:i8 abort_rank:i32 abort_reason:str
@@ -23,12 +23,15 @@
 //
 // flags was historically the shutdown bool, so legacy frames (including
 // abort frames) decode unchanged: bit 0 = shutdown, bit 1 = the trailing
-// response-cache extension is present.  Unknown flag bits reject the frame
-// (a newer wire version) instead of misreading it.  The RequestList
-// extension carries the hit-slot bitvector (LSB of byte 0 = slot 0,
-// trailing zero bytes trimmed); the ResponseList extension carries the
-// coordinator's cache-coherence traffic — slot assignments, LRU evictions,
-// and the served-from-cache / flush / store-set control bits.
+// response-cache extension is present, bit 2 = every message in the list
+// carries a trailing allreduce-algorithm string (set only when some
+// message's algo is non-empty, so ring-only traffic stays byte-identical
+// to the pre-algo wire).  Unknown flag bits reject the frame (a newer
+// wire version) instead of misreading it.  The RequestList extension
+// carries the hit-slot bitvector (LSB of byte 0 = slot 0, trailing zero
+// bytes trimmed); the ResponseList extension carries the coordinator's
+// cache-coherence traffic — slot assignments, LRU evictions, and the
+// served-from-cache / flush / store-set control bits.
 //
 // abort_rank = -1 means "no abort".  A worker sets it in its RequestList to
 // report a local transport/executor failure; the coordinator sets it in the
@@ -48,7 +51,8 @@ namespace htpu {
 // List-frame flags byte + response-cache extension control bits.
 constexpr uint8_t kFlagShutdown = 0x01;
 constexpr uint8_t kFlagCacheExt = 0x02;
-constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt;
+constexpr uint8_t kFlagAlgoExt = 0x04;
+constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt | kFlagAlgoExt;
 constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
 constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
 constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits
@@ -72,6 +76,11 @@ struct Request {
   // "bf16" / "fp16" / "int8" — quantize.h).  Validated across ranks like
   // tensor_type.
   std::string wire_dtype;
+  // Requested collective algorithm ("" = ring; "hier" / "small" / "auto").
+  // Validated across ranks like wire_dtype; "auto" is resolved by the
+  // coordinator per fused payload.  Serialized only when the enclosing
+  // list sets kFlagAlgoExt.
+  std::string algo;
 };
 
 struct Response {
@@ -84,6 +93,11 @@ struct Response {
   // Negotiated wire compression (uniform across ranks by validation);
   // fusion only merges responses with equal wire dtypes.
   std::string wire_dtype;
+  // Resolved collective algorithm ("" = ring; "hier" / "small") — the
+  // coordinator's concrete pick, never "auto".  Fusion only merges
+  // responses with equal algorithms.  Serialized only when the enclosing
+  // list sets kFlagAlgoExt.
+  std::string algo;
 };
 
 struct RequestList {
@@ -117,12 +131,18 @@ struct ResponseList {
   std::vector<int32_t> cache_evictions;
 };
 
-// Serialization. Append to / read from a byte buffer.
-void SerializeRequest(const Request& r, std::string* out);
-bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out);
-void SerializeResponse(const Response& r, std::string* out);
+// Serialization. Append to / read from a byte buffer.  `with_algo`
+// mirrors the enclosing list's kFlagAlgoExt bit: single-message uses
+// (the C API's table endpoints) always pass true so the algo survives
+// the ctypes boundary.
+void SerializeRequest(const Request& r, std::string* out,
+                      bool with_algo = false);
+bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out,
+                  bool with_algo = false);
+void SerializeResponse(const Response& r, std::string* out,
+                       bool with_algo = false);
 bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
-                   Response* out);
+                   Response* out, bool with_algo = false);
 void SerializeRequestList(const RequestList& l, std::string* out);
 bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out);
 void SerializeResponseList(const ResponseList& l, std::string* out);

@@ -212,6 +212,53 @@ def default_wire_dtype() -> str:
     return normalize_wire_dtype(os.environ.get("HOROVOD_TPU_WIRE_DTYPE", ""))
 
 
+# Canonical allreduce algorithm names.  "" = flat ring (the canonical form
+# of "ring"); "hier" = two-level hierarchical; "small" = latency-optimal
+# small-tensor path; "auto" = coordinator picks per payload (request-side
+# only — responses always carry a resolved concrete algorithm).  Mirrors
+# ResolveAlgo in cpp/htpu/message_table.cc.
+_ALGO_ALIASES = {
+    "": "", "ring": "", "flat": "",
+    "hier": "hier", "hierarchical": "hier",
+    "small": "small", "latency": "small",
+    "auto": "auto",
+}
+
+# Payload size at/below which "auto" picks the small-tensor path
+# (kDefaultAlgoCrossoverBytes, cpp/htpu/message_table.h); override with
+# HOROVOD_TPU_ALLREDUCE_CROSSOVER, measure with `bench.py --tcp-allreduce`.
+DEFAULT_ALGO_CROSSOVER_BYTES = 64 * 1024
+
+
+def normalize_allreduce_algo(algo: str) -> str:
+    """Canonicalize an allreduce algorithm name; raises on unknown names."""
+    key = (algo or "").strip().lower()
+    if key not in _ALGO_ALIASES:
+        raise ValueError(
+            f"Unknown allreduce algorithm {algo!r}: expected one of "
+            "ring, hier, small, auto.")
+    return _ALGO_ALIASES[key]
+
+
+def default_allreduce_algo() -> str:
+    """Process-wide allreduce algorithm preference from
+    HOROVOD_TPU_ALLREDUCE_ALGO ("auto" when unset/empty)."""
+    raw = os.environ.get("HOROVOD_TPU_ALLREDUCE_ALGO", "").strip()
+    return "auto" if not raw else normalize_allreduce_algo(raw)
+
+
+def algo_crossover_bytes() -> int:
+    """Small-path crossover from HOROVOD_TPU_ALLREDUCE_CROSSOVER (bytes);
+    malformed/negative values fall back to the default — same leniency as
+    the native parser in control.cc."""
+    raw = os.environ.get("HOROVOD_TPU_ALLREDUCE_CROSSOVER", "")
+    try:
+        v = int(raw)
+        return v if v >= 0 else DEFAULT_ALGO_CROSSOVER_BYTES
+    except ValueError:
+        return DEFAULT_ALGO_CROSSOVER_BYTES
+
+
 @dataclasses.dataclass
 class Request:
     """One rank's announcement that a named tensor is ready
@@ -226,6 +273,10 @@ class Request:
     # Requested ring wire compression ("" = raw fp32; "bf16"/"fp16"/"int8"
     # — cpp/htpu/quantize.h).  Validated across ranks like tensor_type.
     wire_dtype: str = ""
+    # Requested allreduce algorithm preference ("" = ring, "hier", "small",
+    # or "auto" for coordinator selection).  Validated across ranks like
+    # wire_dtype; resolved to a concrete algorithm in the response.
+    algo: str = ""
 
 
 @dataclasses.dataclass
@@ -242,6 +293,10 @@ class Response:
     # Negotiated wire compression (uniform across ranks by validation);
     # fusion only merges responses with equal wire dtypes.
     wire_dtype: str = ""
+    # Resolved allreduce algorithm ("" = ring, "hier", "small" — never
+    # "auto"); fusion only merges responses with equal algorithms, and the
+    # response cache replays the resolution byte-exactly.
+    algo: str = ""
 
 
 # --------------------------------------------------------------------------
@@ -259,9 +314,38 @@ class MessageTable:
         self._size = size
         self._table: Dict[str, Tuple[List[Request], float]] = {}
         self._timeline = timeline
+        # Allreduce algorithm-selection inputs (configure_algo_selection);
+        # defaults describe a single-host, single-process job, under which
+        # "auto" resolves to small/ring only.
+        self._algo_num_hosts = 1
+        self._algo_num_procs = 1
+        self._algo_crossover = DEFAULT_ALGO_CROSSOVER_BYTES
 
     def __len__(self):
         return len(self._table)
+
+    def configure_algo_selection(self, num_hosts: int, num_procs: int,
+                                 crossover_bytes: int) -> None:
+        """Topology + crossover inputs for allreduce algorithm resolution
+        (mirrors MessageTable::ConfigureAlgoSelection, message_table.cc)."""
+        self._algo_num_hosts = max(1, num_hosts)
+        self._algo_num_procs = max(1, num_procs)
+        self._algo_crossover = max(0, crossover_bytes)
+
+    def _resolve_algo(self, pref: str, nbytes: int) -> str:
+        """Concrete algorithm for one allreduce (ResolveAlgo parity):
+        explicit preferences pass through; "auto" picks the small path at or
+        below the crossover, the hierarchical path when the job spans
+        multiple hosts with co-located processes, else the flat ring."""
+        if pref in ("", "ring"):
+            return ""
+        if pref != "auto":
+            return pref
+        if nbytes <= self._algo_crossover:
+            return "small"
+        if 1 < self._algo_num_hosts < self._algo_num_procs:
+            return "hier"
+        return ""
 
     def clear(self):
         self._table.clear()
@@ -326,6 +410,19 @@ class MessageTable:
                     error = ("Mismatched wire compression: One rank requested "
                              f"wire dtype {wire0 or 'fp32'}, but another rank "
                              f"requested wire dtype {r.wire_dtype or 'fp32'}.")
+                    break
+
+        # The allreduce algorithm must be uniform for the same reason: hop
+        # schedules differ per algorithm, so disagreeing ranks would
+        # deadlock the data plane.  Coordinated error, like wire dtype.
+        if error is None:
+            algo0 = requests[0].algo
+            for r in requests[1:]:
+                if r.algo != algo0:
+                    error = ("Mismatched allreduce algorithm: One rank "
+                             f"requested algorithm {algo0 or 'ring'}, but "
+                             "another rank requested algorithm "
+                             f"{r.algo or 'ring'}.")
                     break
 
         message_type = requests[0].request_type
@@ -420,8 +517,17 @@ class MessageTable:
                             tensor_sizes=tensor_sizes, devices=devices,
                             wire_dtype=wire_dtype)
         if message_type == RequestType.ALLREDUCE:
+            # Resolve the (uniform) preference to a concrete algorithm by
+            # this payload's size — the data plane never sees "auto".
+            try:
+                nbytes = np.dtype(data_type).itemsize
+            except TypeError:
+                nbytes = 0
+            for d in requests[0].tensor_shape:
+                nbytes *= d
             return Response(ResponseType.ALLREDUCE, [name], devices=devices,
-                            wire_dtype=wire_dtype)
+                            wire_dtype=wire_dtype,
+                            algo=self._resolve_algo(requests[0].algo, nbytes))
         return Response(ResponseType.BROADCAST, [name], devices=devices,
                         wire_dtype=wire_dtype)
 
@@ -467,13 +573,18 @@ def plan_fusion(responses: List[Response],
             # format — only merge entries that negotiated the same one.
             if nxt.wire_dtype != r.wire_dtype:
                 break
+            # Likewise one collective algorithm per fused payload: the
+            # data plane walks a single hop schedule for the whole buffer.
+            if nxt.algo != r.algo:
+                break
             if total + nbytes > threshold:
                 break
             names.extend(nxt.tensor_names)
             total += nbytes
             j += 1
         fused.append(Response(ResponseType.ALLREDUCE, names,
-                              devices=r.devices, wire_dtype=r.wire_dtype))
+                              devices=r.devices, wire_dtype=r.wire_dtype,
+                              algo=r.algo))
         i = j
     return fused
 
@@ -675,7 +786,11 @@ class _LocalResponseCache:
     @staticmethod
     def _batch_key(pending: List[Request]) -> bytes:
         from horovod_tpu import wire
-        return b"".join(wire.serialize_request(r) for r in pending)
+        # with_algo so an algorithm-preference change misses (and the
+        # replayed responses keep their resolved algo) — matches the
+        # native cache's signature (control.cc CompressRequestFrame).
+        return b"".join(
+            wire.serialize_request(r, with_algo=True) for r in pending)
 
     def _account(self, pending: List[Request]) -> None:
         """Per-name hit/miss/eviction metrics, mirroring the native
@@ -684,8 +799,9 @@ class _LocalResponseCache:
         groups: "collections.OrderedDict[str, bytes]" = \
             collections.OrderedDict()
         for r in pending:
-            groups[r.tensor_name] = \
-                groups.get(r.tensor_name, b"") + wire.serialize_request(r)
+            groups[r.tensor_name] = (groups.get(r.tensor_name, b"")
+                                     + wire.serialize_request(
+                                         r, with_algo=True))
         hits = misses = 0
         for name, sig in groups.items():
             if self._names.get(name) == sig:
@@ -792,6 +908,9 @@ class Controller:
         # need it — one process per host is the TPU pod norm).
         self.host_local_rank: Optional[int] = None
         self.host_local_size: Optional[int] = None
+        # Distinct host count across the job (refined by the control-plane
+        # layout exchange below); feeds allreduce algorithm selection.
+        self.num_hosts = 1
         coord_addr = os.environ.get("HOROVOD_TPU_COORD_ADDR", "")
         # Multi-controller pod with no control plane configured: jit-only
         # mode.  The SPMD path needs no negotiation (XLA's runtime carries
@@ -825,16 +944,19 @@ class Controller:
                                topology.rank, topology.local_size, my_host)
             blob = self._control.allgather(mine)
             host_procs = []
+            all_hosts = set()
             for off in range(0, len(blob), 76):
                 pidx, frank, lsize, host = struct.unpack_from(
                     "<3i64s", blob, off)
                 for r in range(frank, frank + lsize):
                     self._rank_to_process[r] = pidx
+                all_hosts.add(host.rstrip(b"\0"))
                 if host.rstrip(b"\0") == my_host.rstrip(b"\0"):
                     host_procs.append(pidx)
             host_procs.sort()
             self.host_local_rank = host_procs.index(topology.process_index)
             self.host_local_size = len(host_procs)
+            self.num_hosts = len(all_hosts)
         elif self.jit_only:
             # Host grouping without a control plane: the only cross-process
             # channel in jit-only mode is XLA itself, so allgather each
@@ -917,6 +1039,11 @@ class Controller:
         else:
             self._message_table = MessageTable(self.size, self.timeline)
             self._plan_fusion = plan_fusion
+        # Topology + crossover for "auto" algorithm resolution.  The native
+        # control plane configures its own internal table the same way
+        # (control.cc Create); this covers the local negotiation loop.
+        self._message_table.configure_algo_selection(
+            self.num_hosts, topology.process_count, algo_crossover_bytes())
         # Response cache for the single-process negotiation loop.  The
         # multi-process equivalent lives inside the native control plane's
         # Tick (bitvector wire ticks), so the Python cache stays off there
@@ -1036,6 +1163,11 @@ class Controller:
                 "HOROVOD_TPU_{SIZE,RANK,PROCESS_INDEX,PROCESS_COUNT} on "
                 "every process; see docs/running.md.")
         first_rank = self.topology.rank
+        # Allreduces carry the process-wide algorithm preference (read per
+        # enqueue so HOROVOD_TPU_ALLREDUCE_ALGO changes take effect without
+        # reinit); other collectives have a single data-plane path.
+        algo = (default_allreduce_algo()
+                if entry.request_type == RequestType.ALLREDUCE else "")
         requests = []
         for i, contrib in enumerate(entry.per_rank):
             requests.append(Request(
@@ -1047,6 +1179,7 @@ class Controller:
                 root_rank=entry.root_rank,
                 device=first_rank + i,
                 wire_dtype=entry.wire_dtype,
+                algo=algo,
             ))
         with self._lock:
             # Abort outranks plain shutdown: after a job-wide abort every

@@ -50,6 +50,9 @@ def _configure(lib) -> None:
     lib.htpu_table_stalled.restype = ctypes.c_int
     lib.htpu_table_stalled.argtypes = [
         ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_table_configure_algo.restype = None
+    lib.htpu_table_configure_algo.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
     lib.htpu_plan_fusion.restype = ctypes.c_int
     lib.htpu_plan_fusion.argtypes = [
         ctypes.c_char_p, ctypes.c_int,
@@ -95,10 +98,18 @@ def _configure(lib) -> None:
     lib.htpu_control_allreduce_wire.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
+    lib.htpu_control_allreduce_algo.restype = ctypes.c_int
+    lib.htpu_control_allreduce_algo.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_wire_roundtrip.restype = ctypes.c_longlong
     lib.htpu_wire_roundtrip.argtypes = [
         ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
         ctypes.c_void_p]
+    lib.htpu_sum_into.restype = ctypes.c_int
+    lib.htpu_sum_into.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_longlong]
     lib.htpu_control_allgather.restype = ctypes.c_int
     lib.htpu_control_allgather.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
@@ -214,7 +225,9 @@ class CppMessageTable:
         self._pending_names.clear()
 
     def increment(self, msg: Request) -> bool:
-        data = wire.serialize_request(msg)
+        # Single-message boundary frames always carry the algo field (the
+        # C side parses with with_algo=true — no flag byte on this path).
+        data = wire.serialize_request(msg, with_algo=True)
         rc = self._lib.htpu_table_increment(self._ptr, data, len(data))
         if rc < 0:
             raise RuntimeError("native core failed to parse request")
@@ -242,6 +255,13 @@ class CppMessageTable:
         out = ctypes.c_void_p()
         n = self._lib.htpu_table_stalled(self._ptr, age_s, ctypes.byref(out))
         return _parse_stall_records(_take_buffer(self._lib, out, n))
+
+    def configure_algo_selection(self, num_hosts: int, num_procs: int,
+                                 crossover_bytes: int) -> None:
+        """Topology + crossover inputs for allreduce algorithm resolution
+        ("auto" -> ring / hier / small per payload size)."""
+        self._lib.htpu_table_configure_algo(
+            self._ptr, num_hosts, num_procs, crossover_bytes)
 
 
 def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
@@ -281,6 +301,22 @@ def wire_roundtrip(wire_dtype: str, values):
     if nbytes < 0:
         raise ValueError(f"unknown wire dtype: {wire_dtype!r}")
     return out, int(nbytes)
+
+
+def sum_into(dtype: str, acc, inp) -> None:
+    """Native ``acc += inp`` elementwise (reduce.h SumInto) on two
+    C-contiguous same-size numpy arrays; ``dtype`` is the htpu dtype name
+    (may differ from the arrays' numpy dtype — e.g. "bfloat16" over uint16
+    storage).  Unit-test hook for the parallel reduction path."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core not available")
+    if acc.nbytes != inp.nbytes:
+        raise ValueError("size mismatch")
+    rc = lib.htpu_sum_into(dtype.encode("utf-8"), acc.ctypes.data,
+                           inp.ctypes.data, acc.nbytes)
+    if rc != 0:
+        raise ValueError(f"SumInto failed for dtype {dtype!r}")
 
 
 def _parse_stall_records(data: bytes):
@@ -363,13 +399,17 @@ class CppControlPlane:
             raise ConnectionError("control-plane tick failed")
         return _take_buffer(self._lib, out, n)
 
-    def allreduce(self, dtype: str, data, wire_dtype: str = "") -> bytes:
-        """Ring-allreduce ``data`` (bytes, or a C-contiguous numpy array —
+    def allreduce(self, dtype: str, data, wire_dtype: str = "",
+                  algo: str = "") -> bytes:
+        """Allreduce ``data`` (bytes, or a C-contiguous numpy array —
         arrays are read straight from their buffer, skipping a
         ``tobytes`` copy; the payload path is copy-bound at multi-MB
         gradients).  ``wire_dtype`` selects the ring wire compression
         ("" = raw; "bf16"/"fp16"/"int8", float32 payloads only — see
-        cpp/htpu/quantize.h)."""
+        cpp/htpu/quantize.h).  ``algo`` is the coordinator-resolved
+        collective algorithm ("" = flat ring; "hier" = two-level
+        hierarchical; "small" = latency-optimal small-tensor path —
+        cpp/htpu/control.h)."""
         import numpy as np
         if isinstance(data, np.ndarray):
             if not data.flags["C_CONTIGUOUS"]:
@@ -378,13 +418,14 @@ class CppControlPlane:
         else:
             ptr, length = data, len(data)
         out = ctypes.c_void_p()
-        n = self._lib.htpu_control_allreduce_wire(
+        n = self._lib.htpu_control_allreduce_algo(
             self._ptr, dtype.encode("utf-8"), wire_dtype.encode("utf-8"),
-            ptr, length, ctypes.byref(out))
+            algo.encode("utf-8"), ptr, length, ctypes.byref(out))
         if n < 0:
             raise ConnectionError(
                 "data-plane allreduce failed"
-                + (f" (wire dtype {wire_dtype!r})" if wire_dtype else ""))
+                + (f" (wire dtype {wire_dtype!r})" if wire_dtype else "")
+                + (f" (algo {algo!r})" if algo else ""))
         return _take_buffer(self._lib, out, n)
 
     def allgather(self, data: bytes) -> bytes:

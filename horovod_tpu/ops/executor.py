@@ -472,7 +472,8 @@ class DistributedExecutor(Executor):
             reduced = self._mesh_allreduce(entries, lengths, dtype)
             host_out = False
         else:
-            reduced = self._tcp_allreduce(entries, lengths, dtype)
+            reduced = self._tcp_allreduce(entries, lengths, dtype,
+                                          getattr(response, "algo", ""))
             host_out = True
         if self.timeline:
             self.timeline.activity_start_all(entries,
@@ -541,10 +542,12 @@ class DistributedExecutor(Executor):
         return jax.make_array_from_single_device_arrays(
             shape, NamedSharding(self.mesh, P(RANKS_AXIS)), shards)
 
-    def _tcp_allreduce(self, entries, lengths, dtype):
+    def _tcp_allreduce(self, entries, lengths, dtype, algo=""):
         """Host data plane for disjoint runtimes (or 64-bit dtypes): a
         jitted local pre-reduction (one compiled program — flatten, concat,
-        stack, sum), then the chunked TCP ring."""
+        stack, sum), then the coordinator-selected collective ("" = chunked
+        TCP ring; "hier" = two-level hierarchical; "small" = latency-optimal
+        small-tensor path)."""
         if self.timeline:
             self.timeline.activity_start_all(entries,
                                              "MEMCPY_IN_FUSION_BUFFER")
@@ -565,11 +568,15 @@ class DistributedExecutor(Executor):
         if self.timeline:
             from horovod_tpu.timeline import wire_activity
             self.timeline.activity_end_all(entries)
-            self.timeline.activity_start_all(
-                entries, wire_activity("TCP_ALLREDUCE", wire_dtype))
+            # Span name carries the resolved algorithm so traces show which
+            # data-plane path each fused payload took.
+            activity = wire_activity("TCP_ALLREDUCE", wire_dtype)
+            if algo:
+                activity += f"[{algo}]"
+            self.timeline.activity_start_all(entries, activity)
         reduced = np.frombuffer(
             self._control.allreduce(str(dtype), np.ascontiguousarray(buf),
-                                    wire_dtype),
+                                    wire_dtype, algo),
             dtype=dtype)
         if self.timeline:
             self.timeline.activity_end_all(entries)

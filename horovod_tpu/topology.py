@@ -26,7 +26,8 @@ mirroring Horovod's rank-0 coordinator (``operations.cc:1665-1693``).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -116,9 +117,18 @@ def host_fingerprint(warn_truncation: bool = False) -> str:
     ``warn_truncation``: set by callers that compare only the first 64
     bytes (the control-plane wire field); the hash-based jit-only path
     compares the full string and has no truncation risk.
+
+    ``HOROVOD_TPU_HOST_FINGERPRINT`` (non-empty) overrides everything —
+    the test seam for faking multi-host layouts on one machine (the native
+    control plane honours the same variable, control.cc HostFingerprint);
+    it also serves as an escape hatch where boot-id sharing lies about
+    locality (e.g. VMs cloned from one image without re-seeding).
     """
     import socket
     import warnings
+    forced = os.environ.get("HOROVOD_TPU_HOST_FINGERPRINT", "")
+    if forced:
+        return forced
     try:
         with open("/proc/sys/kernel/random/boot_id") as f:
             boot = f.read().strip()
@@ -134,6 +144,27 @@ def host_fingerprint(warn_truncation: bool = False) -> str:
             "sharing this 64-byte name prefix would be grouped as one host "
             "(wrong local_rank/local_size).", RuntimeWarning, stacklevel=2)
     return name
+
+
+def derive_host_groups(
+        fingerprints: Sequence[str],
+) -> Tuple[Dict[str, List[int]], List[int]]:
+    """Host grouping + leader election from per-process host fingerprints
+    (index = process index).
+
+    Returns ``(groups, leaders)``: ``groups`` maps each fingerprint to the
+    ascending list of process indices on that host; ``leaders`` is the
+    per-host leader — the lowest process index of each host — ordered
+    ascending, which IS the inter-host ring order of the hierarchical
+    allreduce (mirrors ControlPlane::EnsureHierarchy, cpp/htpu/control.cc;
+    both sides must elect identically or the data plane deadlocks).
+    """
+    groups: Dict[str, List[int]] = {}
+    for pidx, fp in enumerate(fingerprints):
+        groups.setdefault(fp, []).append(pidx)
+    leaders = [procs[0] for procs in groups.values()]
+    leaders.sort()
+    return groups, leaders
 
 
 def _device_coords(d) -> Optional[Tuple[int, ...]]:

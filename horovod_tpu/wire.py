@@ -24,11 +24,15 @@ Abort = Optional[Tuple[int, str]]
 
 # List-frame flags byte.  Historically this byte was the shutdown bool
 # (0/1), so legacy frames — including PR 2 abort frames — decode unchanged.
-# Bit 1 announces a trailing response-cache extension; any other bit is an
+# Bit 1 announces a trailing response-cache extension; bit 2 announces
+# that every message in the list carries a trailing allreduce-algorithm
+# string (set only when some message's algo is non-empty, so ring-only
+# traffic stays byte-identical to the pre-algo wire); any other bit is an
 # unknown future version and the frame is rejected rather than misread.
 FLAG_SHUTDOWN = 0x01
 FLAG_CACHE_EXT = 0x02
-_KNOWN_FLAGS = FLAG_SHUTDOWN | FLAG_CACHE_EXT
+FLAG_ALGO_EXT = 0x04
+_KNOWN_FLAGS = FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
 
 # Response-cache extension cflags (ResponseList direction only).
 CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
@@ -94,7 +98,7 @@ class _Reader:
         return v
 
 
-def serialize_request(r: Request) -> bytes:
+def serialize_request(r: Request, with_algo: bool = False) -> bytes:
     out = bytearray()
     out += struct.pack("<i", r.request_rank)
     out += struct.pack("<i", int(r.request_type))
@@ -106,10 +110,12 @@ def serialize_request(r: Request) -> bytes:
     for d in r.tensor_shape:
         out += struct.pack("<q", d)
     _put_str(out, r.wire_dtype)
+    if with_algo:
+        _put_str(out, getattr(r, "algo", ""))
     return bytes(out)
 
 
-def parse_request(rd: _Reader) -> Request:
+def parse_request(rd: _Reader, with_algo: bool = False) -> Request:
     rank = rd.i32()
     rtype = RequestType(rd.i32())
     name = rd.str_()
@@ -119,12 +125,13 @@ def parse_request(rd: _Reader) -> Request:
     ndims = rd.i32()
     shape = tuple(rd.i64() for _ in range(ndims))
     wire_dtype = rd.str_()
+    algo = rd.str_() if with_algo else ""
     return Request(request_rank=rank, request_type=rtype, tensor_name=name,
                    tensor_type=dtype, tensor_shape=shape, root_rank=root,
-                   device=device, wire_dtype=wire_dtype)
+                   device=device, wire_dtype=wire_dtype, algo=algo)
 
 
-def serialize_response(r: Response) -> bytes:
+def serialize_response(r: Response, with_algo: bool = False) -> bytes:
     out = bytearray()
     out += struct.pack("<i", int(r.response_type))
     out += struct.pack("<i", len(r.tensor_names))
@@ -138,19 +145,29 @@ def serialize_response(r: Response) -> bytes:
     for s in r.tensor_sizes:
         out += struct.pack("<q", s)
     _put_str(out, r.wire_dtype)
+    if with_algo:
+        _put_str(out, getattr(r, "algo", ""))
     return bytes(out)
 
 
-def parse_response(rd: _Reader) -> Response:
+def parse_response(rd: _Reader, with_algo: bool = False) -> Response:
     rtype = ResponseType(rd.i32())
     names = [rd.str_() for _ in range(rd.i32())]
     error = rd.str_()
     devices = [rd.i32() for _ in range(rd.i32())]
     sizes = [rd.i64() for _ in range(rd.i32())]
     wire_dtype = rd.str_()
+    algo = rd.str_() if with_algo else ""
     return Response(response_type=rtype, tensor_names=names,
                     error_message=error, devices=devices, tensor_sizes=sizes,
-                    wire_dtype=wire_dtype)
+                    wire_dtype=wire_dtype, algo=algo)
+
+
+def _any_algo(msgs) -> bool:
+    # The algo extension bit is set only when some message carries a
+    # non-empty algo, so ring-only traffic stays byte-identical to the
+    # pre-algo wire format.
+    return any(getattr(m, "algo", "") for m in msgs)
 
 
 def _check_flags(flags: int, what: str) -> None:
@@ -172,13 +189,16 @@ def serialize_request_list(requests: List[Request],
     flags = (FLAG_SHUTDOWN if shutdown else 0)
     if cache_ext is not None:
         flags |= FLAG_CACHE_EXT
+    with_algo = _any_algo(requests)
+    if with_algo:
+        flags |= FLAG_ALGO_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(requests))
     for r in requests:
-        out += serialize_request(r)
+        out += serialize_request(r, with_algo)
     if cache_ext is not None:
         out += struct.pack("<i", cache_ext.epoch)
         out += struct.pack("<i", len(cache_ext.bits))
@@ -192,9 +212,10 @@ def parse_request_list_ex(data: bytes) -> Tuple[
     flags = rd.i8()
     _check_flags(flags, "request list")
     shutdown = bool(flags & FLAG_SHUTDOWN)
+    with_algo = bool(flags & FLAG_ALGO_EXT)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
-    reqs = [parse_request(rd) for _ in range(rd.i32())]
+    reqs = [parse_request(rd, with_algo) for _ in range(rd.i32())]
     ext = None
     if flags & FLAG_CACHE_EXT:
         epoch = rd.i32()
@@ -225,13 +246,16 @@ def serialize_response_list(responses: List[Response],
     flags = (FLAG_SHUTDOWN if shutdown else 0)
     if cache_ext is not None:
         flags |= FLAG_CACHE_EXT
+    with_algo = _any_algo(responses)
+    if with_algo:
+        flags |= FLAG_ALGO_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(responses))
     for r in responses:
-        out += serialize_response(r)
+        out += serialize_response(r, with_algo)
     if cache_ext is not None:
         out += struct.pack("<i", cache_ext.epoch)
         cflags = ((CACHE_SERVED if cache_ext.served_from_cache else 0)
@@ -254,9 +278,10 @@ def parse_response_list_ex(data: bytes) -> Tuple[
     flags = rd.i8()
     _check_flags(flags, "response list")
     shutdown = bool(flags & FLAG_SHUTDOWN)
+    with_algo = bool(flags & FLAG_ALGO_EXT)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
-    resps = [parse_response(rd) for _ in range(rd.i32())]
+    resps = [parse_response(rd, with_algo) for _ in range(rd.i32())]
     ext = None
     if flags & FLAG_CACHE_EXT:
         epoch = rd.i32()
@@ -284,7 +309,10 @@ def parse_response_list(data: bytes) -> Tuple[List[Response], bool, Abort]:
 
 
 def parse_single_response(data: bytes) -> Response:
+    # Single-message frames (the C API's table endpoints) always carry the
+    # trailing algo string — both sides of that ctypes boundary agree, so
+    # no flag byte is needed.
     rd = _Reader(data)
-    resp = parse_response(rd)
+    resp = parse_response(rd, with_algo=True)
     assert rd.pos == len(data), "trailing bytes in response"
     return resp

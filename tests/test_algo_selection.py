@@ -1,0 +1,246 @@
+"""Allreduce algorithm selection: normalization, wire format, negotiation
+validation/resolution, and fusion gating.
+
+The coordinator resolves each allreduce's algorithm ("" = flat ring,
+"hier", "small") from the ranks' uniform preference (usually "auto") and
+the payload size; the decision rides the negotiated response so every
+process walks the same hop schedule.  The wire encoding is an opt-in
+extension flag — ring-only traffic stays byte-identical to the pre-algo
+frame format (pinned by the golden-frame test below).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cpp_core, wire
+from horovod_tpu.core import (
+    DEFAULT_ALGO_CROSSOVER_BYTES, MessageTable, Request, RequestType,
+    Response, ResponseType, algo_crossover_bytes, default_allreduce_algo,
+    normalize_allreduce_algo, plan_fusion,
+)
+from horovod_tpu.topology import derive_host_groups
+
+
+# ------------------------------------------------------------ normalization
+
+def test_normalize_aliases():
+    assert normalize_allreduce_algo("ring") == ""
+    assert normalize_allreduce_algo("RING") == ""
+    assert normalize_allreduce_algo("flat") == ""
+    assert normalize_allreduce_algo("") == ""
+    assert normalize_allreduce_algo("hier") == "hier"
+    assert normalize_allreduce_algo("hierarchical") == "hier"
+    assert normalize_allreduce_algo("small") == "small"
+    assert normalize_allreduce_algo("latency") == "small"
+    assert normalize_allreduce_algo("auto") == "auto"
+
+
+def test_normalize_rejects_unknown():
+    with pytest.raises(ValueError, match="Unknown allreduce algorithm"):
+        normalize_allreduce_algo("tree")
+
+
+def test_env_default(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TPU_ALLREDUCE_ALGO", raising=False)
+    assert default_allreduce_algo() == "auto"
+    monkeypatch.setenv("HOROVOD_TPU_ALLREDUCE_ALGO", "ring")
+    assert default_allreduce_algo() == ""
+    monkeypatch.setenv("HOROVOD_TPU_ALLREDUCE_ALGO", "hier")
+    assert default_allreduce_algo() == "hier"
+
+
+def test_crossover_env(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TPU_ALLREDUCE_CROSSOVER", raising=False)
+    assert algo_crossover_bytes() == DEFAULT_ALGO_CROSSOVER_BYTES
+    monkeypatch.setenv("HOROVOD_TPU_ALLREDUCE_CROSSOVER", "1048576")
+    assert algo_crossover_bytes() == 1048576
+    monkeypatch.setenv("HOROVOD_TPU_ALLREDUCE_CROSSOVER", "junk")
+    assert algo_crossover_bytes() == DEFAULT_ALGO_CROSSOVER_BYTES
+
+
+def test_derive_host_groups():
+    groups, leaders = derive_host_groups(["a", "b", "a", "b", "c"])
+    assert groups == {"a": [0, 2], "b": [1, 3], "c": [4]}
+    assert leaders == [0, 1, 4]
+
+
+# ------------------------------------------------------------------- wire
+
+def _req(name="t", algo="", shape=(4,)):
+    return Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type="float32",
+                   tensor_shape=shape, device=0, algo=algo)
+
+
+def test_request_list_roundtrips_algo():
+    reqs = [_req("a", algo="auto"), _req("b", algo="hier")]
+    blob = wire.serialize_request_list(reqs)
+    assert blob[0] & wire.FLAG_ALGO_EXT
+    back, shutdown, abort = wire.parse_request_list(blob)
+    assert [r.algo for r in back] == ["auto", "hier"]
+    assert not shutdown and abort is None
+
+
+def test_response_list_roundtrips_algo():
+    resps = [Response(ResponseType.ALLREDUCE, ["a"], devices=[0],
+                      algo="small")]
+    blob = wire.serialize_response_list(resps)
+    assert blob[0] & wire.FLAG_ALGO_EXT
+    back, _, _ = wire.parse_response_list(blob)
+    assert back[0].algo == "small"
+
+
+def test_ring_frames_are_byte_identical_to_legacy():
+    """With every request on the ring ("" algo) the extension bit stays
+    clear and the frame matches the pre-algo wire format byte for byte —
+    hand-built here from the legacy layout so a serializer regression
+    cannot hide."""
+    req = _req("grad/w", algo="", shape=(3, 5))
+    blob = wire.serialize_request_list([req])
+
+    def s(txt):
+        b = txt.encode()
+        return struct.pack("<i", len(b)) + b
+
+    legacy = (struct.pack("<B", 0)                     # flags: nothing set
+              + struct.pack("<i", -1) + s("")          # no abort
+              + struct.pack("<i", 1)                   # one request
+              + struct.pack("<i", 0)                   # request_rank
+              + struct.pack("<i", int(RequestType.ALLREDUCE))
+              + s("grad/w") + s("float32")
+              + struct.pack("<i", -1)                  # root_rank
+              + struct.pack("<i", 0)                   # device
+              + struct.pack("<i", 2)                   # ndims
+              + struct.pack("<q", 3) + struct.pack("<q", 5)
+              + s(""))                                 # wire_dtype
+    assert blob == legacy
+
+    resp = Response(ResponseType.ALLREDUCE, ["grad/w"], devices=[0])
+    rblob = wire.serialize_response_list([resp])
+    assert not rblob[0] & wire.FLAG_ALGO_EXT
+
+
+# ------------------------------------------- negotiation: validate + resolve
+
+def _table(num_hosts=1, num_procs=1,
+           crossover=DEFAULT_ALGO_CROSSOVER_BYTES, size=2):
+    t = MessageTable(size)
+    t.configure_algo_selection(num_hosts, num_procs, crossover)
+    return t
+
+
+def _rank_req(rank, algo, shape=(4,)):
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name="t", tensor_type="float32",
+                   tensor_shape=shape, device=rank, algo=algo)
+
+
+def test_mismatched_algo_is_coordinated_error():
+    t = _table()
+    t.increment(_rank_req(0, "auto"))
+    assert t.increment(_rank_req(1, ""))
+    resp = t.construct_response("t")
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched allreduce algorithm" in resp.error_message
+    assert "ring" in resp.error_message and "auto" in resp.error_message
+
+
+@pytest.mark.parametrize("pref,num_hosts,num_procs,shape,want", [
+    ("", 2, 4, (1 << 20,), ""),            # explicit ring passes through
+    ("hier", 1, 2, (4,), "hier"),          # explicit hier passes through
+    ("small", 2, 4, (1 << 20,), "small"),  # explicit small passes through
+    ("auto", 2, 4, (4,), "small"),         # tiny -> small
+    ("auto", 2, 4, (1 << 20,), "hier"),    # big + multi-host -> hier
+    ("auto", 1, 4, (1 << 20,), ""),        # big + one host -> ring
+    ("auto", 4, 4, (1 << 20,), ""),        # one proc per host -> ring
+])
+def test_auto_resolution(pref, num_hosts, num_procs, shape, want):
+    t = _table(num_hosts, num_procs)
+    t.increment(_rank_req(0, pref, shape))
+    t.increment(_rank_req(1, pref, shape))
+    resp = t.construct_response("t")
+    assert resp.response_type == ResponseType.ALLREDUCE
+    assert resp.algo == want
+
+
+def test_crossover_boundary_is_inclusive():
+    t = _table(num_hosts=1, num_procs=2, crossover=64)
+    t.increment(_rank_req(0, "auto", (16,)))     # 64 bytes == crossover
+    t.increment(_rank_req(1, "auto", (16,)))
+    assert t.construct_response("t").algo == "small"
+    t.increment(_rank_req(0, "auto", (17,)))     # 68 bytes > crossover
+    t.increment(_rank_req(1, "auto", (17,)))
+    assert t.construct_response("t").algo == ""
+
+
+# ------------------------------------------------------------------ fusion
+
+def _resp(names, algo, wire_dtype=""):
+    return Response(ResponseType.ALLREDUCE, list(names), devices=[0, 1],
+                    wire_dtype=wire_dtype, algo=algo)
+
+
+def _fusion_maps(nbytes=64):
+    return (lambda n: nbytes), (lambda n: "float32")
+
+
+@pytest.mark.parametrize("planner", ["python", "cpp"])
+def test_fusion_merges_only_equal_algo(planner):
+    if planner == "cpp":
+        if not cpp_core.available():
+            pytest.skip("native core not built")
+        fuse = cpp_core.cpp_plan_fusion
+    else:
+        fuse = plan_fusion
+    eb, ed = _fusion_maps()
+    fused = fuse([_resp(["a"], "small"), _resp(["b"], "small"),
+                  _resp(["c"], "hier"), _resp(["d"], "hier")],
+                 eb, ed, threshold=1 << 20)
+    assert [r.tensor_names for r in fused] == [["a", "b"], ["c", "d"]]
+    assert [r.algo for r in fused] == ["small", "hier"]
+
+
+@pytest.mark.parametrize("planner", ["python", "cpp"])
+def test_fusion_merges_freely_with_uniform_algo(planner):
+    if planner == "cpp":
+        if not cpp_core.available():
+            pytest.skip("native core not built")
+        fuse = cpp_core.cpp_plan_fusion
+    else:
+        fuse = plan_fusion
+    eb, ed = _fusion_maps()
+    fused = fuse([_resp(["a"], ""), _resp(["b"], ""), _resp(["c"], "")],
+                 eb, ed, threshold=1 << 20)
+    assert [r.tensor_names for r in fused] == [["a", "b", "c"]]
+    assert fused[0].algo == ""
+
+
+# ------------------------------------------------------- native table parity
+
+@pytest.mark.skipif(not cpp_core.available(), reason="native core not built")
+def test_native_table_resolution_matches_python():
+    for num_hosts, num_procs, shape, want in [
+            (2, 4, (4,), "small"),
+            (2, 4, (1 << 20,), "hier"),
+            (1, 4, (1 << 20,), ""),
+    ]:
+        ct = cpp_core.CppMessageTable(2)
+        ct.configure_algo_selection(num_hosts, num_procs,
+                                    DEFAULT_ALGO_CROSSOVER_BYTES)
+        ct.increment(_rank_req(0, "auto", shape))
+        assert ct.increment(_rank_req(1, "auto", shape))
+        resp = ct.construct_response("t")
+        assert resp.response_type == ResponseType.ALLREDUCE
+        assert resp.algo == want, (num_hosts, num_procs, shape)
+
+
+@pytest.mark.skipif(not cpp_core.available(), reason="native core not built")
+def test_native_table_mismatch_error_matches_python():
+    ct = cpp_core.CppMessageTable(2)
+    ct.increment(_rank_req(0, "auto"))
+    assert ct.increment(_rank_req(1, ""))
+    resp = ct.construct_response("t")
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched allreduce algorithm" in resp.error_message

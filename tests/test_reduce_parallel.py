@@ -1,0 +1,72 @@
+"""Parallel SumInto (cpp/htpu/reduce.cc) bit-exactness.
+
+Large reductions (>= 256K elements) run split across a persistent worker
+pool; each worker applies the identical elementwise ``a[i] += b[i]`` over a
+disjoint contiguous range, so the result must equal the serial path BIT
+FOR BIT for every dtype.  Pinned here by reducing the same payload twice
+through the native code: once as one large call (parallel path engaged)
+and once as many sub-threshold slices (serial path), then comparing raw
+bytes.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cpp_core
+
+pytestmark = pytest.mark.skipif(
+    not cpp_core.available(), reason="native core not built")
+
+# Comfortably above the kParallelSumMinElems = 256K element threshold.
+N = 600_000
+# Each serial slice stays far below it.
+SLICE = 4096
+
+
+def _materialize(dtype_name, seed):
+    rng = np.random.RandomState(seed)
+    if dtype_name == "bfloat16":
+        # numpy has no bfloat16; drive the native path over uint16 storage
+        # holding real bfloat16 bit patterns (top half of a float32).
+        f = (rng.rand(N).astype(np.float32) * 4 - 2)
+        return (f.view(np.uint32) >> 16).astype(np.uint16)
+    if dtype_name == "bool":
+        return rng.rand(N) < 0.5
+    dt = np.dtype(dtype_name)
+    if np.issubdtype(dt, np.floating):
+        return (rng.rand(N) * 4 - 2).astype(dt)
+    info = np.iinfo(dt)
+    # Stay in half the dtype's range so a[i] += b[i] cannot overflow
+    # (overflow is UB-adjacent noise, not what this test pins).
+    lo, hi = info.min // 2, info.max // 2
+    return rng.randint(lo, hi + 1, size=N).astype(dt)
+
+
+@pytest.mark.parametrize("dtype_name", [
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "uint8", "int16", "uint16",
+    "int32", "uint32", "int64", "uint64",
+    "bool",
+])
+def test_parallel_matches_serial_bit_for_bit(dtype_name):
+    a = _materialize(dtype_name, seed=7)
+    b = _materialize(dtype_name, seed=13)
+
+    parallel = np.ascontiguousarray(a.copy())
+    cpp_core.sum_into(dtype_name, parallel, b)
+
+    serial = np.ascontiguousarray(a.copy())
+    for lo in range(0, N, SLICE):
+        chunk = np.ascontiguousarray(serial[lo:lo + SLICE])
+        cpp_core.sum_into(dtype_name, chunk, np.ascontiguousarray(
+            b[lo:lo + SLICE]))
+        serial[lo:lo + SLICE] = chunk
+
+    assert parallel.tobytes() == serial.tobytes(), (
+        f"{dtype_name}: parallel SumInto diverged from serial")
+
+
+def test_sum_into_rejects_unknown_dtype():
+    a = np.zeros(4, np.float32)
+    with pytest.raises(ValueError):
+        cpp_core.sum_into("complex64", a, a.copy())

@@ -39,6 +39,54 @@ def fmt_value(name: str, value: float, rate=None) -> str:
     return text
 
 
+def hist_median(h: dict) -> float | None:
+    """Estimate the p50 of a registry histogram snapshot (per-bucket
+    counts, one overflow bucket past the last bound) by linear
+    interpolation inside the bucket holding the midpoint sample."""
+    bounds = h.get("bounds") or []
+    counts = h.get("counts") or []
+    total = h.get("count", 0)
+    if not total or len(counts) != len(bounds) + 1:
+        return None
+    target = total / 2.0
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if seen + c >= target and c:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            return lo + (hi - lo) * (target - seen) / c
+        seen += c
+    return bounds[-1]
+
+
+def render_algo_summary(snap: dict, name_filter: str) -> list[str]:
+    """Per-algorithm allreduce digest: op counts from the
+    ``ring.allreduce.algo#algo=`` counters joined with p50 latency from
+    the matching ``ring.allreduce.seconds#algo=`` histograms."""
+    ops_prefix = "ring.allreduce.algo#algo="
+    lat_prefix = "ring.allreduce.seconds#algo="
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    algos = sorted({k[len(ops_prefix):] for k in counters
+                    if k.startswith(ops_prefix)}
+                   | {k[len(lat_prefix):] for k in hists
+                      if k.startswith(lat_prefix)})
+    lines = []
+    for algo in algos:
+        name = f"allreduce[{algo}]"
+        if name_filter and name_filter not in name:
+            continue
+        ops = counters.get(ops_prefix + algo, 0)
+        med = hist_median(hists.get(lat_prefix + algo, {}))
+        text = f"ops={ops:g}"
+        if med is not None:
+            text += f"  p50={med * 1e3:.3g}ms"
+        lines.append(f"  {name:<52} {text}")
+    if lines:
+        lines.insert(0, "  -- allreduce by algorithm --")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -77,7 +125,13 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
         h = snap["histograms"][name]
         count = h.get("count", 0)
         mean = (h.get("sum", 0.0) / count) if count else 0.0
-        lines.append(f"  {name:<52} n={count} mean={mean:.3g}")
+        text = f"n={count} mean={mean:.3g}"
+        med = hist_median(h)
+        if med is not None:
+            text += f" p50={med:.3g}"
+        lines.append(f"  {name:<52} {text}")
+
+    lines.extend(render_algo_summary(snap, name_filter))
     return "\n".join(lines)
 
 
