@@ -440,6 +440,27 @@ def bench_transformer(jax, hvd, mesh, nchips):
             mfu_xla = flops / (dt / timed_batches) / peak
             mfu_xla_note = ("cost model appears to include the scan trip "
                             "count; spc scaling removed")
+    # In-jit wire A/B (fp32 vs bf16 vs int8 gradient wire): identical
+    # program except for the reduce_gradients compression, so step-time
+    # deltas are the wire's own cost/benefit.  The fp32 row reuses the
+    # main leg above (compression=none IS the fp32 wire).
+    wire_ab = None
+    if (os.environ.get("BENCH_TLM_AB", "1") == "1" and nchips > 1):
+        wire_ab = _injit_wire_ab(
+            jax, np, build_step=lambda comp: make_train_step(
+                loss_fn, tx, mesh, sync_aux_state=False,
+                steps_per_call=spc, compression=comp, donate=False),
+            init_state=lambda: (params, {}, tx.init(params)),
+            data=tokens, nchips=nchips,
+            iters=max(2, timed_batches // 2), spc=spc,
+            fp32_sec_per_step=dt / (timed_batches * spc),
+            mfu_of=lambda sec: (round(model_flops / sec / peak, 4)
+                                if peak else None))
+    elif os.environ.get("BENCH_TLM_AB", "1") == "1":
+        wire_ab = {"note": "single chip: every collective is the "
+                           "identity, so the gradient wire never "
+                           "engages — run the multi-chip leg for the "
+                           "fp32/bf16/int8 comparison"}
     return {
         "transformer_lm": {
             "tokens_per_sec_per_chip": round(tok_per_sec / nchips, 1),
@@ -451,8 +472,78 @@ def bench_transformer(jax, hvd, mesh, nchips):
             "achieved_model_tflops_per_chip": round(achieved / 1e12, 2),
             "dim": dim, "depth": depth, "seq_len": seq,
             "batch_per_chip": batch_per_chip, "attn": attn,
+            **({"injit_wire_ab": wire_ab} if wire_ab else {}),
         }
     }
+
+
+def _injit_wire_ab(jax, np, *, build_step, init_state, data, nchips,
+                   iters, spc, fp32_sec_per_step, mfu_of):
+    """Shared fp32/bf16/int8 in-jit wire A/B: per-wire step time, MFU
+    (when the caller can compute one), and the estimated bytes each wire
+    dtype moves per rank per step (the same plan behind the
+    ``injit.bytes#wire_dtype=*`` counters).  On TPU a Mosaic rejection
+    of the Pallas codec falls back to the bit-identical jnp codec
+    (``HOROVOD_TPU_INJIT_PALLAS=0``) and says so."""
+    from horovod_tpu.compression import Compression
+    from horovod_tpu.ops import quantized_collectives as qc
+
+    params = init_state()[0]
+
+    def leg_sec(comp):
+        step = build_step(comp)
+        state = init_state()
+        step, _, _ = aot_compile(step, (*state, data))
+        p, aux, o = state
+        p, aux, o, loss = step(p, aux, o, data)   # warmup binds loss
+        np.asarray(loss)
+
+        def one(st, data):
+            p, aux, o, _ = st
+            return step(p, aux, o, data)
+
+        _, d = _timed(one, (p, aux, o, loss), data, iters, 2, np)
+        return d / (iters * spc)
+
+    out = {}
+    for wire, comp in (("fp32", Compression.none),
+                       ("bf16", Compression.bf16),
+                       ("int8", Compression.int8)):
+        plan = qc.estimate_wire_plan(params, nchips, comp)
+        note = None
+        if wire == "fp32" and fp32_sec_per_step is not None:
+            sec = fp32_sec_per_step
+        else:
+            try:
+                sec = leg_sec(comp)
+            except Exception as exc:   # noqa: BLE001 — per-leg, not fatal
+                if wire != "int8" or os.environ.get(
+                        "HOROVOD_TPU_INJIT_PALLAS") == "0":
+                    out[wire] = {"error": f"{type(exc).__name__}: "
+                                          f"{exc}"[:300]}
+                    continue
+                os.environ["HOROVOD_TPU_INJIT_PALLAS"] = "0"
+                try:
+                    sec = leg_sec(comp)
+                    note = ("Pallas codec rejected by the backend; "
+                            "measured with the bit-identical jnp codec")
+                except Exception as exc2:   # noqa: BLE001
+                    out[wire] = {"error": f"{type(exc2).__name__}: "
+                                          f"{exc2}"[:300]}
+                    continue
+                finally:
+                    os.environ.pop("HOROVOD_TPU_INJIT_PALLAS", None)
+        out[wire] = {
+            "step_time_ms": round(sec * 1e3, 2),
+            "mfu": mfu_of(sec),
+            "est_wire_bytes_per_step_per_rank": plan or None,
+            **({"note": note} if note else {}),
+        }
+    if ("step_time_ms" in out.get("int8", {})
+            and "step_time_ms" in out.get("fp32", {})):
+        out["int8_faster_than_fp32"] = (out["int8"]["step_time_ms"]
+                                        < out["fp32"]["step_time_ms"])
+    return out
 
 
 def _pin_cpu_half(half: int) -> bool:
@@ -619,6 +710,7 @@ def tcp_worker():
             params, opt_state, comp, wire)
         stats = {
             "images_per_sec_per_proc": round(batch * iters / dt, 2),
+            "step_time_ms": round(dt / iters * 1e3, 2),
             "comm_fraction": round(t_comm / dt, 4),
             "bytes_on_wire_sent": sent,
             "bytes_on_wire_recvd": recvd,
@@ -627,6 +719,7 @@ def tcp_worker():
             raw_sent, dt_raw, t_comm_raw = sent, dt, t_comm
         elif raw_sent:
             stats["bytes_ratio_vs_fp32"] = round(sent / raw_sent, 4)
+            stats["faster_than_fp32"] = dt < dt_raw
         wire_stats[wire] = stats
 
     # Accuracy: one fixed per-process payload through each wire vs the
@@ -1130,7 +1223,9 @@ def bench_scaling(n_virtual: int):
     model = ConvNet(num_classes=10)
     tx = optax.sgd(0.01, momentum=0.9)
 
-    def run(devices):
+    from horovod_tpu.compression import Compression
+
+    def run(devices, compression=Compression.none):
         n = len(devices)
         mesh = Mesh(np.asarray(devices), ("ranks",))
         batch = batch_per_chip * n
@@ -1150,7 +1245,7 @@ def bench_scaling(n_virtual: int):
                 logits, lbls).mean(), aux
 
         step = make_train_step(loss_fn, tx, mesh, sync_aux_state=False,
-                               donate=False)
+                               donate=False, compression=compression)
         opt_state = tx.init(params)
         data = (images, labels)
         for _ in range(3):   # warmup/compile
@@ -1168,10 +1263,50 @@ def bench_scaling(n_virtual: int):
         def profile_target():
             np.asarray(one((params, opt_state, loss), data)[-1])
 
-        return batch * iters / dt / n, profile_target
+        return batch * iters / dt / n, profile_target, params
 
-    per_chip_1, _ = run(jax.devices()[:1])
-    per_chip_n, profile_target = run(jax.devices())
+    per_chip_1, _, _ = run(jax.devices()[:1])
+    per_chip_n, profile_target, params = run(jax.devices())
+
+    # In-jit wire A/B at N devices: same ConvNet step, only the gradient
+    # wire changes (the 8 MB dense kernel is int8-eligible under the
+    # default floor).  On a shared-core virtual mesh the psum is a
+    # memcpy while the int8 ring does real codec work, so int8 "losing"
+    # here measures codec compute, not wire savings — the note says so.
+    wire_ab = None
+    if os.environ.get("BENCH_SCALE_AB", "1") == "1":
+        from horovod_tpu.ops import quantized_collectives as qc
+        wire_ab = {}
+        for wire, comp in (("fp32", Compression.none),
+                           ("bf16", Compression.bf16),
+                           ("int8", Compression.int8)):
+            if wire == "fp32":
+                per_chip_c = per_chip_n
+            else:
+                try:
+                    per_chip_c, _, _ = run(jax.devices(), compression=comp)
+                except Exception as exc:   # noqa: BLE001 — per-leg
+                    wire_ab[wire] = {"error": f"{type(exc).__name__}: "
+                                              f"{exc}"[:300]}
+                    continue
+            plan = qc.estimate_wire_plan(params, n_virtual, comp)
+            wire_ab[wire] = {
+                "step_time_ms": round(batch_per_chip / per_chip_c * 1e3,
+                                      2),
+                "images_per_sec_per_chip": round(per_chip_c, 2),
+                "est_wire_bytes_per_step_per_rank": plan or None,
+            }
+        if ("step_time_ms" in wire_ab.get("int8", {})
+                and "step_time_ms" in wire_ab.get("fp32", {})):
+            wire_ab["int8_faster_than_fp32"] = (
+                wire_ab["int8"]["step_time_ms"]
+                < wire_ab["fp32"]["step_time_ms"])
+            wire_ab["note"] = (
+                "virtual CPU mesh: collectives are intra-process "
+                "memcpys, so the int8 leg pays the codec FLOPs without "
+                "any wire to save — see scaling_tcp_2proc."
+                "wire_compression for the cross-process wire where the "
+                "byte savings are real")
 
     # Comm/compute split measured on the ACTUAL benchmark step (not a
     # probe), where the backend exposes device-side spans.
@@ -1182,6 +1317,7 @@ def bench_scaling(n_virtual: int):
         "images_per_sec_per_chip_1": round(per_chip_1, 2),
         "images_per_sec_per_chip_n": round(per_chip_n, 2),
         "scaling_efficiency": round(per_chip_n / per_chip_1, 4),
+        **({"injit_wire_ab": wire_ab} if wire_ab else {}),
         "comm_fraction": comm_frac,
         "note": "virtual CPU mesh: the N-device run shares the same host "
                 "cores as the 1-device run, so efficiency ~1/N is the "

@@ -12,14 +12,24 @@ namespace {
 
 // Per-block absmax scale: maps the block's range onto [-127, 127].  An
 // all-zero (or all-NaN-free zero) block gets scale 1 so dequantization
-// stays exact zeros.
+// stays exact zeros.  The clamp to FLT_MIN keeps 1/scale finite when
+// absmax is subnormal: without it, absmax/127 can underflow to 0 and the
+// block's exact-zero elements encode as 0 * inf = NaN.  The in-jit codec
+// (horovod_tpu/ops/quantized_collectives.py) applies the identical rule
+// so wire images stay bit-exact across planes.
 inline float BlockScale(const float* in, int64_t n) {
   float absmax = 0.0f;
+#pragma omp simd reduction(max : absmax)
   for (int64_t i = 0; i < n; ++i) {
     float a = std::fabs(in[i]);
     if (a > absmax) absmax = a;
   }
-  return absmax > 0.0f ? absmax / 127.0f : 1.0f;
+  constexpr float kMinScale = 1.17549435e-38f;  // FLT_MIN
+  // Multiply by the f32 reciprocal rather than divide: XLA lowers a
+  // divide-by-constant as a reciprocal multiply, so the in-jit codec
+  // can only match this scale bit-for-bit if both sides multiply.
+  constexpr float kInv127 = 1.0f / 127.0f;
+  return absmax > 0.0f ? std::max(absmax * kInv127, kMinScale) : 1.0f;
 }
 
 inline int64_t NumBlocks(int64_t n) {
@@ -83,12 +93,33 @@ void EncodeWireChunk(int wire_id, const float* in, int64_t n, char* out) {
     std::memcpy(out + b * 4, &scale, 4);
     const float inv = 1.0f / scale;
     int8_t* q = reinterpret_cast<int8_t*>(payload + lo);
+    // Round via the 1.5*2^23 bias trick instead of nearbyintf: while
+    // w = v + kRound sits in the [2^23, 2^24) binade its low mantissa
+    // bits ARE round_even(v), so an integer subtract of kRound's bit
+    // pattern recovers the rounded value with no float->int convert.
+    // The float->bits map is monotonic outside that binade, so the
+    // integer clamp reproduces float clamp-then-round for every input
+    // class: ties-to-even for |v| < 127.5, +-inf to +-127, and NaN to
+    // +-127 by its sign bit (propagated input NaNs are sign-positive
+    // -> 127, like the old scalar loop's std::min; only the inf-scale
+    // block's inf*0 indefinite lands on -127, a byte both codecs
+    // already treat as garbage — its fp32 scale header is inf).  A
+    // float clamp here would NOT vectorize under GCC 10 —
+    // std::min/max on floats lower to comiss + branches because their
+    // NaN semantics differ from MINPS — and the scalar nearbyintf call
+    // it replaced was the eager int8 wire's whole deficit vs fp32 on a
+    // fast link.
+    constexpr float kRound = 12582912.0f;       // 1.5 * 2^23
+    constexpr int32_t kRoundBits = 0x4B400000;  // bit pattern of kRound
+#pragma omp simd
     for (int64_t i = 0; i < len; ++i) {
-      float v = in[lo + i] * inv;
-      // round-half-away like rintf would under nearbyint ties-to-even is
-      // fine too; clamp guards absmax elements rounding to 127 exactly.
-      v = std::nearbyintf(v);
-      q[i] = int8_t(std::max(-127.0f, std::min(127.0f, v)));
+      float w = in[lo + i] * inv + kRound;
+      int32_t t;
+      std::memcpy(&t, &w, 4);
+      t -= kRoundBits;
+      t = t < -127 ? -127 : t;
+      t = t > 127 ? 127 : t;
+      q[i] = int8_t(t);
     }
   }
 }
@@ -112,6 +143,7 @@ void DecodeWireChunkAdd(int wire_id, const char* in, int64_t n, float* acc) {
     float scale;
     std::memcpy(&scale, in + b * 4, 4);
     const int8_t* q = reinterpret_cast<const int8_t*>(payload + lo);
+#pragma omp simd
     for (int64_t i = 0; i < len; ++i) acc[lo + i] += float(q[i]) * scale;
   }
 }
@@ -135,6 +167,7 @@ void DecodeWireChunk(int wire_id, const char* in, int64_t n, float* out) {
     float scale;
     std::memcpy(&scale, in + b * 4, 4);
     const int8_t* q = reinterpret_cast<const int8_t*>(payload + lo);
+#pragma omp simd
     for (int64_t i = 0; i < len; ++i) out[lo + i] = float(q[i]) * scale;
   }
 }

@@ -64,14 +64,22 @@ class Int8Compressor(Compressor):
     blocks, fp32 scales — same grid as the host ring's int8 wire,
     ``cpp/htpu/quantize.cc``).
 
-    On the mesh path the quantized values cannot ride a ``psum`` as raw
-    int8 (sums overflow, and per-block scales don't commute with the
-    reduction), so ``compress`` snaps the tensor onto the int8 grid and
-    returns it **dequantized in bfloat16**: a single sum-safe array that
-    still halves the bytes on the wire.  True 4x int8 bytes-on-wire
-    lives on the cross-process host ring — request it with
-    ``allreduce(..., compression=Compression.int8)`` or process-wide via
-    ``HOROVOD_TPU_WIRE_DTYPE=int8``.
+    Where a true int8 wire exists, selecting this compressor engages it:
+    the cross-process host ring (``allreduce(...,
+    compression=Compression.int8)`` / ``HOROVOD_TPU_WIRE_DTYPE=int8``)
+    and, inside ``shard_map`` on a flat mesh, the in-jit quantized ring
+    (:func:`horovod_tpu.ops.quantized_collectives
+    .quantized_ring_allreduce` — routed by ``reduce_gradients`` /
+    ``allreduce_gradients`` per the bucket policy).  Everywhere else —
+    e.g. the hierarchical mesh, whose three-stage collective cannot
+    carry per-block scales — ``compress`` degrades gracefully: it snaps
+    the tensor onto the int8 grid and returns it **dequantized in
+    bfloat16**, a single sum-safe array that still halves the bytes on
+    the wire.
+
+    The block grid and scale rule are shared with both int8 wires
+    (``quantized_collectives.snap_to_grid``), including the FLT_MIN
+    scale clamp that keeps near-zero blocks NaN-free.
     """
 
     block_elems = 1024
@@ -81,15 +89,8 @@ class Int8Compressor(Compressor):
         dtype = jnp.result_type(tensor)
         if not jnp.issubdtype(dtype, jnp.floating):
             return tensor, None
-        n = tensor.size
-        blocks = -(-n // cls.block_elems)
-        flat = jnp.ravel(tensor).astype(jnp.float32)
-        padded = jnp.pad(flat, (0, blocks * cls.block_elems - n))
-        grid = padded.reshape(blocks, cls.block_elems)
-        absmax = jnp.max(jnp.abs(grid), axis=1, keepdims=True)
-        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-        q = jnp.clip(jnp.round(grid / scale), -127, 127)
-        deq = (q * scale).reshape(-1)[:n].reshape(tensor.shape)
+        from horovod_tpu.ops.quantized_collectives import snap_to_grid
+        deq = snap_to_grid(tensor)
         return deq.astype(jnp.bfloat16), dtype
 
     @classmethod

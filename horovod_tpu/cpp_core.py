@@ -106,6 +106,15 @@ def _configure(lib) -> None:
     lib.htpu_wire_roundtrip.argtypes = [
         ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
         ctypes.c_void_p]
+    for fn in ("htpu_wire_encode", "htpu_wire_decode"):
+        f = getattr(lib, fn, None)
+        if f is not None:
+            f.restype = ctypes.c_longlong
+            f.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_longlong, ctypes.c_void_p]
+    if hasattr(lib, "htpu_wire_bytes"):
+        lib.htpu_wire_bytes.restype = ctypes.c_longlong
+        lib.htpu_wire_bytes.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
     lib.htpu_sum_into.restype = ctypes.c_int
     lib.htpu_sum_into.argtypes = [
         ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -301,6 +310,42 @@ def wire_roundtrip(wire_dtype: str, values):
     if nbytes < 0:
         raise ValueError(f"unknown wire dtype: {wire_dtype!r}")
     return out, int(nbytes)
+
+
+def wire_encode(wire_dtype: str, values) -> bytes:
+    """Encode a float32 array into the ring's wire image
+    (``EncodeWireChunk`` framing, per 64K-element sub-chunk).  Unit-test
+    hook for cross-plane codec parity against the in-jit encoder."""
+    import numpy as np
+    lib = load()
+    if lib is None or getattr(lib, "htpu_wire_encode", None) is None:
+        raise RuntimeError("native core wire codec not available")
+    arr = np.ascontiguousarray(values, dtype=np.float32).reshape(-1)
+    total = lib.htpu_wire_bytes(wire_dtype.encode("utf-8"), arr.size)
+    if total < 0:
+        raise ValueError(f"unknown wire dtype: {wire_dtype!r}")
+    out = np.empty(int(total), dtype=np.uint8)
+    rc = lib.htpu_wire_encode(wire_dtype.encode("utf-8"), arr.ctypes.data,
+                              arr.size, out.ctypes.data)
+    if rc < 0:
+        raise ValueError(f"wire encode failed for {wire_dtype!r}")
+    return out.tobytes()
+
+
+def wire_decode(wire_dtype: str, buf: bytes, n_elems: int):
+    """Decode a wire image produced by :func:`wire_encode` (or by the
+    in-jit encoder — that is the point) back to float32."""
+    import numpy as np
+    lib = load()
+    if lib is None or getattr(lib, "htpu_wire_decode", None) is None:
+        raise RuntimeError("native core wire codec not available")
+    inp = np.frombuffer(buf, dtype=np.uint8)
+    out = np.empty(n_elems, dtype=np.float32)
+    rc = lib.htpu_wire_decode(wire_dtype.encode("utf-8"), inp.ctypes.data,
+                              n_elems, out.ctypes.data)
+    if rc < 0:
+        raise ValueError(f"wire decode failed for {wire_dtype!r}")
+    return out
 
 
 def sum_into(dtype: str, acc, inp) -> None:

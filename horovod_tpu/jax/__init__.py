@@ -16,7 +16,7 @@ Also here, mirroring the reference's startup-sync utilities:
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,7 @@ from jax import lax
 from horovod_tpu import basics
 from horovod_tpu.compression import Compression, Compressor, NoneCompressor
 from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops import quantized_collectives as _qc
 from horovod_tpu.parallel.mesh import RANKS_AXIS
 
 
@@ -53,6 +54,14 @@ def _is_sparse(leaf) -> bool:
     return isinstance(leaf, IndexedSlices)
 
 
+class ErrorFeedbackState(NamedTuple):
+    """Optimizer state of a ``DistributedOptimizer(error_feedback=True)``:
+    the wrapped optimizer's state plus one fp32 residual per parameter
+    leaf carrying the quantization error not yet applied."""
+    inner: Any
+    residual: Any
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
@@ -60,13 +69,25 @@ def DistributedOptimizer(
     average: bool = True,
     compression: Compressor = NoneCompressor,
     sparse_as_dense: bool = False,
+    error_feedback: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates consume rank-averaged gradients.
 
     Inside jit/shard_map (``axis_name`` in scope) the average compiles to a
     single XLA AllReduce; outside, gradients take the eager negotiated path.
     ``compression`` casts to a narrow wire dtype around the reduction
-    (reference ``DistributedOptimizer(compression=...)``).
+    (reference ``DistributedOptimizer(compression=...)``).  With
+    ``Compression.int8`` on the SPMD path, eligible bulk leaves ride the
+    in-jit quantized ring (:mod:`horovod_tpu.ops.quantized_collectives`).
+
+    ``error_feedback=True`` carries each leaf's quantization error as
+    extra optimizer state (:class:`ErrorFeedbackState`) and adds it back
+    into the next step's gradient before quantizing again (EQuARX /
+    1-bit-SGD error feedback): components too small for this step's int8
+    grid accumulate in the residual until they cross it, so convergence
+    tracks the uncompressed run instead of flooring at the quantization
+    noise.  Only meaningful with a lossy ``compression``; the residual
+    is per-parameter fp32, so it costs one extra model copy of state.
 
     :class:`horovod_tpu.sparse.IndexedSlices` gradient leaves are routed
     through the sparse **allgather** path automatically (the reference's
@@ -78,17 +99,59 @@ def DistributedOptimizer(
     IndexedSlices apply the way TF optimizers do).
     """
 
+    def _residual_leaf(p):
+        if jnp.issubdtype(jnp.result_type(p), jnp.floating):
+            return jnp.zeros(jnp.shape(p), dtype=jnp.float32)
+        return jnp.zeros((), dtype=jnp.float32)
+
     def init(params):
-        return optimizer.init(params)
+        inner = optimizer.init(params)
+        if not error_feedback:
+            return inner
+        return ErrorFeedbackState(
+            inner=inner,
+            residual=jax.tree.map(_residual_leaf, params))
+
+    def _lossy(comp, g):
+        # Leaves the wire actually quantizes — the only ones whose
+        # residual is non-trivial.  Matches the reduce-path policy.
+        return (not _is_sparse(g) and _qc.is_int8(comp)
+                and _qc.int8_eligible(jnp.shape(g), jnp.result_type(g)))
 
     def update(grads, state, params=None, **kw):
-        grads = allreduce_gradients(grads, axis_name=axis_name,
-                                    average=average, compression=compression,
-                                    sparse_as_dense=sparse_as_dense)
-        grads = jax.tree.map(
-            lambda g: g.to_dense() if _is_sparse(g) else g, grads,
+        inner_state = state.inner if error_feedback else state
+        comp = _qc.resolve_injit_compression(compression)
+        if error_feedback:
+            def carry_in(g, r):
+                if not _lossy(comp, g):
+                    return g
+                return g + r.astype(jnp.result_type(g))
+            grads = jax.tree.map(carry_in, grads, state.residual,
+                                 is_leaf=_is_sparse)
+        red = allreduce_gradients(grads, axis_name=axis_name,
+                                  average=average, compression=compression,
+                                  sparse_as_dense=sparse_as_dense)
+        if error_feedback:
+            # Local-error formulation: what this rank contributed minus
+            # what survived its own quantizer.  Q is deterministic and
+            # shared with the wire (same block grid and scale rule), so
+            # this is exactly the first-hop loss of the ring.
+            def carry_out(g, r):
+                if not _lossy(comp, g):
+                    return r
+                g32 = g.astype(jnp.float32)
+                return g32 - _qc.snap_to_grid(g32)
+            residual = jax.tree.map(carry_out, grads, state.residual,
+                                    is_leaf=_is_sparse)
+        red = jax.tree.map(
+            lambda g: g.to_dense() if _is_sparse(g) else g, red,
             is_leaf=_is_sparse)
-        return optimizer.update(grads, state, params, **kw)
+        updates, inner_state = optimizer.update(red, inner_state, params,
+                                                **kw)
+        if error_feedback:
+            return updates, ErrorFeedbackState(inner=inner_state,
+                                               residual=residual)
+        return updates, inner_state
 
     return optax.GradientTransformation(init, update)
 
@@ -117,14 +180,31 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
         grads = jax.tree.map(
             lambda g: g.to_dense() if _is_sparse(g) else g, grads,
             is_leaf=_is_sparse)
+    # Canonicalize up front (string names -> Compressor, env default):
+    # both the SPMD branch and the eager fallback below need a real
+    # Compressor for the non-fp32 compress/decompress calls.
+    compression = _qc.resolve_injit_compression(compression)
     if _in_spmd_context(axis_name):
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        comp = compression
 
         def one(g):
             if _is_sparse(g):
                 return _sparse.allreduce(g, average=average,
                                          axis_name=axis_name)
-            c, ctx = compression.compress(g)
+            vma_g = getattr(jax.typeof(g), "vma", None)
+            varied = vma_g is None or any(a in vma_g for a in axes)
+            if (varied and isinstance(axis_name, str) and _qc.is_int8(comp)
+                    and _qc.int8_eligible(g.shape, g.dtype)):
+                # Bulk leaf under int8: the in-jit quantized ring — int8
+                # payload + per-block scales on every hop.  Under-floor
+                # leaves fall through to the raw branch below (the
+                # bucket policy; docs/concepts.md).
+                return _qc.quantized_ring_allreduce(g, axis_name,
+                                                    average=average)
+            leaf_comp = (NoneCompressor if _qc.is_int8(comp)
+                         else comp)
+            c, ctx = leaf_comp.compress(g)
             vma = getattr(jax.typeof(c), "vma", None)
             unvaried = vma is not None and not any(a in vma for a in axes)
             if unvaried and grads_hint:
@@ -136,7 +216,7 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
             else:
                 red = (lax.pmean(c, axis_name) if average
                        else lax.psum(c, axis_name))
-            return compression.decompress(red, ctx)
+            return leaf_comp.decompress(red, ctx)
         return jax.tree.map(one, grads, is_leaf=_is_sparse)
     # Eager path: compression is applied per-leaf around the negotiated op.
     leaves, treedef = jax.tree.flatten(grads, is_leaf=_is_sparse)
@@ -244,6 +324,7 @@ def allreduce_(tree, *, average: bool = True, name_prefix: str = "allreduce"):
 
 
 __all__ = [
-    "DistributedOptimizer", "allreduce_gradients", "broadcast_parameters",
-    "broadcast_optimizer_state", "allreduce_", "Compression",
+    "DistributedOptimizer", "ErrorFeedbackState", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state", "allreduce_",
+    "Compression",
 ]

@@ -200,6 +200,78 @@ def test_resnet_remat_is_semantics_preserving(hvd):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_int8_error_feedback_convergence(hvd, monkeypatch):
+    """int8 wire + error feedback converges like fp32; disabling the
+    feedback measurably degrades it.
+
+    The problem is built so quantization actually hurts: a "spike" row
+    whose |.|-penalty gradient (SPIKE/31 per entry) dominates every
+    block absmax, putting the int8 grid step (absmax/127 ≈ 2.4) above
+    the typical MSE gradient (≈ 0.7).  Without feedback the MSE
+    gradients round to zero on most steps; the residual restores them
+    by accumulation.  The reported metric is the MSE term alone — the
+    oscillating spike term would mask the signal.
+    """
+    monkeypatch.setenv("HOROVOD_TPU_INJIT_INT8_FLOOR", "0")
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("ranks",))
+    rng = np.random.RandomState(3)
+    x = rng.randn(256, 32).astype(np.float32)
+    w_true = rng.randn(32, 31).astype(np.float32)
+    y = x @ w_true
+    SPIKE = 300.0
+
+    def spike_loss(params, xs, ys):
+        w = params["w"]                      # (33, 31): row 0 = spike
+        mse = jnp.mean((xs @ w[1:] - ys) ** 2)
+        return mse + SPIKE * jnp.mean(jnp.abs(w[0])), mse
+
+    def run(compression, error_feedback, steps=150):
+        params = {"w": jnp.zeros((33, 31), jnp.float32)}
+        opt = hvd_jax.DistributedOptimizer(
+            optax.sgd(0.05), axis_name="ranks", compression=compression,
+            error_feedback=error_feedback)
+        state = opt.init(params)
+
+        def train_step(params, state, xs, ys):
+            (_, mse), grads = jax.value_and_grad(
+                spike_loss, has_aux=True)(params, xs, ys)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            return params, state, jax.lax.pmean(mse, "ranks")
+
+        f = jax.jit(jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P(), P(), P("ranks"), P("ranks")),
+            out_specs=(P(), P(), P())))
+        for _ in range(steps):
+            params, state, mse = f(params, state, x, y)
+        return float(mse)
+
+    fp32 = run(Compression.none, False)
+    int8_ef = run(Compression.int8, True)
+    int8_raw = run(Compression.int8, False)
+    # Measured: fp32 11.13, int8+EF 11.19 (+0.5%), no-EF 12.45 (+12%).
+    assert int8_ef < fp32 * 1.03
+    assert int8_raw > int8_ef * 1.05
+
+
+def test_error_feedback_state_shape(hvd):
+    """ErrorFeedbackState wraps the inner optimizer state with fp32
+    residuals for float leaves only; feedback off keeps the inner state
+    type unchanged."""
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16),
+              "step": jnp.array(0, jnp.int32)}
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1), axis_name="ranks",
+                                       error_feedback=True)
+    state = opt.init(params)
+    assert isinstance(state, hvd_jax.ErrorFeedbackState)
+    assert state.residual["w"].dtype == jnp.float32
+    assert state.residual["w"].shape == (4, 4)
+    assert state.residual["step"].shape == ()      # int leaf: sentinel
+    plain = hvd_jax.DistributedOptimizer(optax.sgd(0.1), axis_name="ranks")
+    assert not isinstance(plain.init(params), hvd_jax.ErrorFeedbackState)
+
+
 def test_distributed_optimizer_in_plain_jit_raises_clear_error(hvd):
     """Tracing DistributedOptimizer inside a user's own jit (no mesh axis
     in scope) must raise actionable guidance, not a raw

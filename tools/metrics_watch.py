@@ -87,6 +87,34 @@ def render_algo_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def render_injit_summary(snap: dict, name_filter: str) -> list[str]:
+    """In-jit bytes-by-wire-dtype digest: the ``injit.bytes#wire_dtype=``
+    counters (estimated per-rank wire traffic of the compiled train
+    step) with each dtype's share, plus per-step bytes when the
+    ``injit.steps`` counter is present."""
+    prefix = "injit.bytes#wire_dtype="
+    counters = snap.get("counters", {})
+    by_dtype = {k[len(prefix):]: v for k, v in counters.items()
+                if k.startswith(prefix)}
+    if not by_dtype:
+        return []
+    total = sum(by_dtype.values())
+    steps = counters.get("injit.steps", 0)
+    lines = []
+    for dtype in sorted(by_dtype, key=by_dtype.get, reverse=True):
+        name = f"injit[{dtype}]"
+        if name_filter and name_filter not in name:
+            continue
+        nbytes = by_dtype[dtype]
+        text = f"{human_bytes(nbytes)}  ({nbytes / total:.0%})"
+        if steps:
+            text += f"  {human_bytes(nbytes / steps)}/step"
+        lines.append(f"  {name:<52} {text}")
+    if lines:
+        lines.insert(0, "  -- in-jit wire bytes by dtype --")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -132,6 +160,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
         lines.append(f"  {name:<52} {text}")
 
     lines.extend(render_algo_summary(snap, name_filter))
+    lines.extend(render_injit_summary(snap, name_filter))
     return "\n".join(lines)
 
 
