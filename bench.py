@@ -903,6 +903,27 @@ def tcp_worker():
                      if control is not None
                      and hasattr(control, "ring_transport") else "none")
         snap = hvd.metrics()
+
+        def _straggler_skew():
+            # Per-rank gather-arrival skew from the coordinator's
+            # control.gather_skew_seconds#rank= histograms: who arrived
+            # late at the negotiation barrier during this leg, and by how
+            # much on average.  The live counterpart of the post-hoc
+            # tools/trace_merge.py report.
+            prefix = "control.gather_skew_seconds#rank="
+            per_rank = {}
+            for name, h in snap.get("histograms", {}).items():
+                if not name.startswith(prefix) or not h.get("count"):
+                    continue
+                rank = name[len(prefix):]
+                per_rank[rank] = {
+                    "count": h["count"],
+                    "mean_s": round(h["sum"] / h["count"], 9)}
+            if not per_rank:
+                return None
+            slowest = max(per_rank, key=lambda r: per_rank[r]["mean_s"])
+            return {"per_rank": per_rank, "slowest_rank": slowest}
+
         print("TCPLEG " + json.dumps({
             "n_proc": n,
             "images_per_sec_per_proc": round(batch * iters / dt_raw, 2),
@@ -916,6 +937,9 @@ def tcp_worker():
             # Cached-vs-uncached negotiation: per-burst wire bytes and the
             # labeled tick-latency histograms of the response cache.
             "response_cache": cache_stats,
+            # Per-rank negotiation-barrier lateness (None when the
+            # coordinator recorded no skew samples, e.g. 1-proc runs).
+            "straggler_skew": _straggler_skew(),
             # Full counter/gauge state at the end of the run, straight
             # from the unified registry (histograms are left to the
             # JSONL/Prometheus exporters to keep this line readable).

@@ -15,6 +15,7 @@
 #include <unordered_map>
 
 #include "htpu/control.h"
+#include "htpu/flight_recorder.h"
 #include "htpu/fusion.h"
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
@@ -180,6 +181,17 @@ HTPU_API void* htpu_timeline_create(const char* path) {
   return tl;
 }
 
+// Rank-tagged variant: the trace opens with a trace_t0 instant carrying
+// {rank, t0_wall_us} so tools/trace_merge.py can align per-rank files.
+HTPU_API void* htpu_timeline_create_rank(const char* path, int rank) {
+  auto* tl = new htpu::Timeline(path, rank);
+  if (!tl->ok()) {
+    delete tl;
+    return nullptr;
+  }
+  return tl;
+}
+
 HTPU_API void htpu_timeline_destroy(void* tl) {
   delete static_cast<htpu::Timeline*>(tl);
 }
@@ -225,6 +237,21 @@ HTPU_API void htpu_timeline_counter(void* tl, const char* name,
 // response cache (distinct from NEGOTIATE_* spans in the trace viewer).
 HTPU_API void htpu_timeline_cache_hit_tick(void* tl, long long dur_us) {
   static_cast<htpu::Timeline*>(tl)->CacheHitTick(dur_us);
+}
+
+// Global instant on the control track; args_json is a caller-built JSON
+// object (or NULL/empty for {}).
+HTPU_API void htpu_timeline_instant(void* tl, const char* name,
+                                    const char* args_json) {
+  static_cast<htpu::Timeline*>(tl)->Instant(name ? name : "",
+                                            args_json ? args_json : "");
+}
+
+// Complete-event TICK span ending now (dur_us long) tagged with the tick
+// id — the cross-rank alignment anchor for merged traces.
+HTPU_API void htpu_timeline_tick_span(void* tl, unsigned long long tick,
+                                      long long dur_us) {
+  static_cast<htpu::Timeline*>(tl)->TickSpan(tick, dur_us);
 }
 
 HTPU_API void htpu_timeline_flush(void* tl) {
@@ -478,5 +505,38 @@ HTPU_API int htpu_metrics_snapshot(void** out) {
 // Zero every value (tests/bench isolation); registered metrics survive so
 // cached counter pointers inside hot paths stay valid.
 HTPU_API void htpu_metrics_reset() { htpu::Metrics::Get().Reset(); }
+
+// ----------------------------------------------------- flight recorder
+
+// Record one event into the process-wide ring (flight_recorder.h).  Lets
+// the Python run loop leave breadcrumbs — pending tensor names, op
+// timeouts — next to the native control/transport events.
+HTPU_API void htpu_flight_record(const char* kind, const char* detail,
+                                 long long bytes, int a, int b) {
+  htpu::FlightRecorder::Get().Record(kind, detail, bytes, a, b);
+}
+
+// Resize the ring to `events` slots (drops recorded history; tests).
+HTPU_API void htpu_flight_set_capacity(long long events) {
+  htpu::FlightRecorder::Get().SetCapacityEvents(events);
+}
+
+HTPU_API void htpu_flight_set_rank(int rank) {
+  htpu::FlightRecorder::Get().SetRank(rank);
+}
+
+// Dump the ring to the per-rank JSON file; writes the path into *out
+// (htpu_free it) and returns its length, 0 when the write failed.
+HTPU_API int htpu_flight_dump(const char* why, void** out) {
+  return CopyOut(
+      htpu::FlightRecorder::Get().Dump(why ? why : "manual"), out);
+}
+
+// The ring as a JSON object without touching the filesystem (tests).
+HTPU_API int htpu_flight_snapshot(const char* why, void** out) {
+  return CopyOut(
+      htpu::FlightRecorder::Get().SnapshotJson(why ? why : "snapshot"),
+      out);
+}
 
 }  // extern "C"

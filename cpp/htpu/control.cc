@@ -15,6 +15,7 @@
 #include <stdexcept>
 #include <thread>
 
+#include "htpu/flight_recorder.h"
 #include "htpu/fusion.h"
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
@@ -78,6 +79,52 @@ bool ParseHandshake(const std::string& s, int* process_index,
   return true;
 }
 
+// ---- clock trailer (cross-rank timebase) ----
+// Every worker appends 20 bytes to its tick request frame AFTER cache
+// compression: magic + previous-response receive stamp + request send
+// stamp (wall-clock us, little-endian).  Living at the frame layer —
+// not inside the RequestList wire format — keeps serialized request
+// bytes identical to previous rounds (the response cache's byte-exact
+// hit test and the golden-frame tests both depend on that).  The
+// coordinator strips it before parsing.
+constexpr uint32_t kClockTrailerMagic = 0x4854434bu;   // "KCTH" on wire
+constexpr size_t kClockTrailerBytes = 20;
+
+// Re-estimation cadence: commit the best (lowest-RTT) offset sample at
+// least this often so slow clock drift keeps being tracked.
+constexpr uint64_t kClockCommitTicks = 64;
+
+void AppendClockTrailer(int64_t prev_resp_recv_us, std::string* frame) {
+  uint32_t magic = kClockTrailerMagic;
+  for (int i = 0; i < 4; ++i)
+    frame->push_back(char((magic >> (8 * i)) & 0xff));
+  for (int64_t v : {prev_resp_recv_us, WallClockUs()}) {
+    uint64_t u = uint64_t(v);
+    for (int i = 0; i < 8; ++i)
+      frame->push_back(char((u >> (8 * i)) & 0xff));
+  }
+}
+
+bool StripClockTrailer(std::string* blob, int64_t* prev_resp_recv_us,
+                       int64_t* send_us) {
+  if (blob->size() < kClockTrailerBytes) return false;
+  size_t base = blob->size() - kClockTrailerBytes;
+  uint32_t magic = 0;
+  for (int i = 0; i < 4; ++i)
+    magic |= uint32_t(uint8_t((*blob)[base + i])) << (8 * i);
+  if (magic != kClockTrailerMagic) return false;
+  auto rd64 = [&blob](size_t off) {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i)
+      v |= uint64_t(uint8_t((*blob)[off + i])) << (8 * i);
+    return int64_t(v);
+  };
+  *prev_resp_recv_us = rd64(base + 4);
+  *send_us = rd64(base + 12);
+  blob->resize(base);
+  return true;
+}
+
 }  // namespace
 
 std::unique_ptr<ControlPlane> ControlPlane::Create(
@@ -101,6 +148,13 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
   }
   cp->heartbeat_ms_ = int(std::min<long long>(hb_s * 1000LL, timeout_ms));
   cp->ParseFaultEnv();
+  // Flight recorder: rank-tag the process-wide ring and arm the SIGUSR2
+  // dump so a wedged tick thread can still be made to leave forensics
+  // (the launcher pokes hung ranks before escalating to SIGTERM).
+  FlightRecorder::Get().SetRank(first_rank);
+  FlightRecorder::InstallSignalDump();
+  FlightRecorder::Get().Record("plane.create", coord_host.c_str(), 0,
+                               process_index, process_count);
   // Negotiation response cache (0 disables; frames then stay byte-identical
   // to the pre-cache wire format and ticks run the exact legacy path).
   long cache_cap = 1024;
@@ -397,6 +451,8 @@ void ControlPlane::MaybeInjectFault() {
     fprintf(stderr, "htpu fault injection: hanging rank %d at tick %llu\n",
             first_rank_, (unsigned long long)tick_count_);
     fflush(stderr);
+    FlightRecorder::Get().Record("fault.hang", "injected hang", 0,
+                                 first_rank_);
     // Block the tick thread forever with sockets left open: the silent-
     // worker case only the heartbeat deadline can catch.
     for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
@@ -405,6 +461,8 @@ void ControlPlane::MaybeInjectFault() {
           "htpu fault injection: dropping connections of rank %d at tick "
           "%llu\n", first_rank_, (unsigned long long)tick_count_);
   fflush(stderr);
+  FlightRecorder::Get().Record("fault.drop_conn", "injected conn drop", 0,
+                               first_rank_);
   fault_mode_ = 0;  // fires once
   for (int fd : worker_fds_) {
     if (fd >= 0) shutdown(fd, SHUT_RDWR);
@@ -424,6 +482,17 @@ void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
   CacheFlushAll();
   Metrics::Get().Counter("control.aborts")->fetch_add(
       1, std::memory_order_relaxed);
+  // Dump the flight recorder and name the dump in the abort reason so
+  // every HorovodAbortedError points at this rank's forensics.  A worker
+  // latches the coordinator's broadcast reason — which already names the
+  // coordinator's dump — and appends its own local path after it; the
+  // find() guard only prevents appending the SAME path twice (re-latch).
+  FlightRecorder& fr = FlightRecorder::Get();
+  fr.Record("abort", reason.c_str(), 0, rank);
+  std::string dump = fr.Dump("abort");
+  if (!dump.empty() && abort_reason_.find(dump) == std::string::npos) {
+    abort_reason_ += " [flight recorder: " + dump + "]";
+  }
 }
 
 void ControlPlane::CacheFlushAll() {
@@ -474,6 +543,8 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
            : "ring data-plane transfer timed out waiting on rank ") +
       std::to_string(last_error_rank_) +
       (failed >= 0 ? " closed the connection or errored" : "");
+  FlightRecorder::Get().Record("xfer.fail", last_error_.c_str(),
+                               int64_t(send_len + recv_len), peer, errno);
   return false;
 }
 
@@ -655,6 +726,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       Metrics::Get().Counter("control.negotiation_bytes");
   ticks->fetch_add(1, std::memory_order_relaxed);
   ++tick_count_;
+  FlightRecorder::Get().SetTick(tick_count_);
   MaybeInjectFault();
   if (aborted_) {
     // Latched: every subsequent tick completes instantly with the original
@@ -664,21 +736,37 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   }
 
   if (!is_coordinator()) {
-    // Worker: send our (bit-compressed when cached) request list, wait for
-    // the response list.
+    // Worker: send our (bit-compressed when cached) request list with the
+    // clock trailer, wait for the response list.
     std::string frame;
     CompressRequestFrame(request_list_blob, &frame);
+    AppendClockTrailer(last_resp_recv_us_, &frame);
+    auto w0 = std::chrono::steady_clock::now();
+    FlightRecorder::Get().Record("tick.send", "", int64_t(frame.size()),
+                                 0, coord_fd_);
     if (!SendFrame(coord_fd_, frame) ||
         !RecvFrame(coord_fd_, response_list_blob, timeout_ms_)) {
       // Coordinator link gone: synthesize a local abort naming process 0
       // so waiters get an attributed error, not a generic tick failure.
       int32_t coord_rank =
           all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+      FlightRecorder::Get().Record("tick.fail", "coordinator link lost",
+                                   0, coord_fd_, errno);
       LatchAbort(coord_rank,
                  "lost connection to the coordinator (rank " +
                      std::to_string(coord_rank) + ", process 0)");
       SerializeAbort(response_list_blob);
       return true;
+    }
+    last_resp_recv_us_ = WallClockUs();
+    FlightRecorder::Get().Record("tick.recv", "",
+                                 int64_t(response_list_blob->size()), 0,
+                                 coord_fd_);
+    if (Timeline* tl = timeline_.load(std::memory_order_acquire)) {
+      tl->TickSpan(tick_count_,
+                   std::chrono::duration_cast<std::chrono::microseconds>(
+                       std::chrono::steady_clock::now() - w0)
+                       .count());
     }
     neg_bytes->fetch_add(
         (long long)(frame.size() + response_list_blob->size()),
@@ -730,9 +818,22 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     }
   }
   auto gather_t0 = std::chrono::steady_clock::now();
+  // Request-ready stamps for straggler attribution: each worker's
+  // trailer send stamp mapped onto the coordinator clock via its
+  // committed offset, the coordinator's own frame at gather start.
+  std::vector<int64_t> arrival_us(size_t(process_count_), 0);
+  std::vector<bool> have_arrival(size_t(process_count_), false);
+  arrival_us[0] = WallClockUs();
+  have_arrival[0] = true;
+  if (clock_sync_.empty()) clock_sync_.resize(size_t(process_count_));
   for (int i = 1; i < process_count_ && abort_rank < 0; ++i) {
     std::string blob;
-    if (!RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_) ||
+    bool got = RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_);
+    int64_t t2_us = WallClockUs();
+    int64_t t1_us = 0, t4_prev_us = 0;
+    bool have_trailer =
+        got && StripClockTrailer(&blob, &t4_prev_us, &t1_us);
+    if (!got ||
         !ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
                           blob.size(), &frames[size_t(i)])) {
       abort_rank = worker_first_rank_[size_t(i)];
@@ -741,9 +842,22 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
           std::to_string(i) + ") missed the " +
           std::to_string(heartbeat_ms_ / 1000) +
           "s heartbeat deadline (crashed, hung, or sent a corrupt frame)";
+      FlightRecorder::Get().Record("gather.fail", abort_reason.c_str(), 0,
+                                   i, got ? 0 : errno);
     } else {
+      FlightRecorder::Get().Record("gather.recv", "",
+                                   int64_t(blob.size()), i,
+                                   worker_fds_[size_t(i)]);
       neg_bytes->fetch_add((long long)blob.size(),
                            std::memory_order_relaxed);
+      if (have_trailer) {
+        NoteClockSample(i, t1_us, t4_prev_us, t2_us);
+        const ClockEst& est = clock_sync_[size_t(i)].est;
+        if (est.valid) {
+          arrival_us[size_t(i)] = t1_us - int64_t(est.offset_us);
+          have_arrival[size_t(i)] = true;
+        }
+      }
       shutdown = shutdown || frames[size_t(i)].shutdown;
       if (frames[size_t(i)].abort_rank >= 0 && abort_rank < 0) {
         // A worker reported a local transport/executor failure.
@@ -752,6 +866,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       }
     }
   }
+  if (abort_rank < 0) ObserveGatherSkew(arrival_us, have_arrival);
   {
     auto gather_t1 = std::chrono::steady_clock::now();
     Metrics::Get().Observe(
@@ -847,7 +962,16 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
                          last_gather_done_)
                          .count();
         Metrics::Get().Observe("control.tick_seconds#cached=1", dur);
-        if (timeline) timeline->CacheHitTick(int64_t(dur * 1e6));
+        FlightRecorder::Get().Record("tick.cached", "",
+                                     int64_t(response_list_blob->size()));
+        if (timeline) {
+          timeline->CacheHitTick(int64_t(dur * 1e6));
+          timeline->TickSpan(
+              tick_count_,
+              std::chrono::duration_cast<std::chrono::microseconds>(
+                  std::chrono::steady_clock::now() - gather_t0)
+                  .count());
+        }
         if (!BroadcastResponse(response_list_blob)) return true;
         if (!ApplyResponseFrame(mini, response_list_blob)) {
           LatchAbort(first_rank_,
@@ -950,6 +1074,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
         timeline->NegotiateEnd(r.tensor_name);
       }
       Response resp = table_->ConstructResponse(r.tensor_name);
+      FlightRecorder::Get().Record(
+          resp.response_type == ResponseType::ERROR ? "response.error"
+                                                    : "response.ready",
+          r.tensor_name.c_str(), 0, r.request_rank);
       if (track_cache && resp.response_type != ResponseType::ERROR) {
         ready_ok.push_back(r.tensor_name);
       }
@@ -1045,6 +1173,13 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     // adoption + set storage for its local replay path).
     ApplyResponseFrame(out, response_list_blob);
   }
+  if (timeline) {
+    timeline->TickSpan(
+        tick_count_,
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - gather_t0)
+            .count());
+  }
   return true;
 }
 
@@ -1070,7 +1205,104 @@ bool ControlPlane::BroadcastResponse(std::string* response_list_blob) {
     neg_bytes->fetch_add((long long)response_list_blob->size(),
                          std::memory_order_relaxed);
   }
+  // t3' of the next tick's clock samples: workers echo their receive
+  // stamp of THIS broadcast in their next trailer.
+  last_bcast_us_ = WallClockUs();
+  FlightRecorder::Get().Record("bcast.send", "",
+                               int64_t(response_list_blob->size()), 0,
+                               process_count_ - 1);
   return true;
+}
+
+// ------------------------------------------------- clock sync / skew
+
+void ControlPlane::NoteClockSample(int proc, int64_t t1_us,
+                                   int64_t t4_prev_us, int64_t t2_us) {
+  // NTP midpoint over the tick round trip: t3' = our previous response
+  // broadcast, t4' = the worker's receipt of it (echoed in the trailer),
+  // t1 = the worker's request send, t2 = our receipt.  The worker's
+  // processing time between ticks cancels out of the RTT, so delta is
+  // pure network time and the midpoint's worst-case error is delta/2.
+  if (t4_prev_us <= 0 || last_bcast_us_ <= 0) return;   // no previous round
+  double theta =
+      0.5 * (double(t4_prev_us - last_bcast_us_) + double(t1_us - t2_us));
+  double delta =
+      double(t2_us - last_bcast_us_) - double(t1_us - t4_prev_us);
+  if (delta < 0) return;   // a clock stepped mid-interval; discard
+  ClockSync& cs = clock_sync_[size_t(proc)];
+  double unc = 0.5 * delta;
+  if (!cs.best.valid || unc < cs.best.uncertainty_us) {
+    cs.best.offset_us = theta;
+    cs.best.uncertainty_us = unc;
+    cs.best.valid = true;
+  }
+  // Commit the window's lowest-uncertainty sample: immediately on the
+  // first sample ever (short jobs still get offsets), then periodically
+  // so drift keeps being tracked without spamming the trace.
+  bool commit =
+      cs.best.valid &&
+      (!cs.est.valid ||
+       tick_count_ - cs.last_commit_tick >= kClockCommitTicks);
+  if (!commit) return;
+  cs.est = cs.best;
+  cs.best.valid = false;
+  cs.last_commit_tick = tick_count_;
+  if (offset_names_.empty()) {
+    for (int p = 0; p < process_count_; ++p) {
+      int rank = size_t(p) < all_first_ranks_.size()
+                     ? all_first_ranks_[size_t(p)]
+                     : p;
+      offset_names_.push_back("control.clock_offset_us#rank=" +
+                              std::to_string(rank));
+    }
+  }
+  Metrics::Get().SetGauge(offset_names_[size_t(proc)], cs.est.offset_us);
+  if (Timeline* tl = timeline_.load(std::memory_order_acquire)) {
+    int rank = size_t(proc) < all_first_ranks_.size()
+                   ? all_first_ranks_[size_t(proc)]
+                   : proc;
+    tl->ClockOffset(rank, cs.est.offset_us, cs.est.uncertainty_us);
+  }
+}
+
+void ControlPlane::ObserveGatherSkew(
+    const std::vector<int64_t>& arrival_us,
+    const std::vector<bool>& have_arrival) {
+  if (process_count_ < 2) return;
+  std::vector<int64_t> vals;
+  vals.reserve(arrival_us.size());
+  for (size_t p = 0; p < arrival_us.size(); ++p) {
+    if (have_arrival[p]) vals.push_back(arrival_us[p]);
+  }
+  if (vals.size() < 2) return;   // offsets not yet estimated
+  // True median (midpoint of the two middles for even counts), matching
+  // statistics.median in tools/trace_merge.py so the live histograms and
+  // the post-hoc trace report reconcile.  Upper-median alone would zero
+  // the signal entirely at 2 processes: the late rank IS the median.
+  std::nth_element(vals.begin(), vals.begin() + long(vals.size() / 2),
+                   vals.end());
+  double median = double(vals[vals.size() / 2]);
+  if (vals.size() % 2 == 0) {
+    int64_t lower = *std::max_element(vals.begin(),
+                                      vals.begin() + long(vals.size() / 2));
+    median = (median + double(lower)) / 2.0;
+  }
+  if (skew_names_.empty()) {
+    for (int p = 0; p < process_count_; ++p) {
+      int rank = size_t(p) < all_first_ranks_.size()
+                     ? all_first_ranks_[size_t(p)]
+                     : p;
+      skew_names_.push_back("control.gather_skew_seconds#rank=" +
+                            std::to_string(rank));
+    }
+  }
+  for (size_t p = 0; p < arrival_us.size(); ++p) {
+    if (!have_arrival[p]) continue;
+    // Lateness vs the median request-ready time; early ranks clamp to 0
+    // so the histogram reads directly as "imposed wait".
+    double skew_s = (double(arrival_us[p]) - median) / 1e6;
+    Metrics::Get().Observe(skew_names_[p], skew_s < 0 ? 0.0 : skew_s);
+  }
 }
 
 bool ControlPlane::Allreduce(const std::string& dtype, const std::string& in,
@@ -1141,6 +1373,15 @@ bool ControlPlane::AllreduceBuf(const std::string& dtype, char* data,
   const std::string algo_label = algo.empty() ? "ring" : algo;
   Metrics::Get().Counter("ring.allreduce.algo#algo=" + algo_label)
       ->fetch_add(1, std::memory_order_relaxed);
+  {
+    // Resolved algorithm + wire dtype for the flight recorder: the
+    // forensic question after a data-plane stall is "which collective,
+    // which path, how big".
+    std::string d = "algo=" + algo_label + " wire=" +
+                    (wire_dtype.empty() ? "fp32" : wire_dtype) +
+                    " dtype=" + dtype;
+    FlightRecorder::Get().Record("allreduce", d.c_str(), nbytes);
+  }
   const auto t0 = std::chrono::steady_clock::now();
   bool ok;
   if (algo == "hier") {
@@ -1880,6 +2121,7 @@ bool ControlPlane::Allgather(const std::string& in, std::string* out) {
     return true;
   }
   if (AbortedFailFast()) return false;
+  FlightRecorder::Get().Record("allgather", "", int64_t(in.size()));
   return RingAllgather(in, out);
 }
 
@@ -1958,6 +2200,8 @@ bool ControlPlane::Broadcast(int root_process, const std::string& in,
     return true;
   }
   if (AbortedFailFast()) return false;
+  FlightRecorder::Get().Record("broadcast", "", int64_t(in.size()),
+                               root_process);
   return RingBroadcast(root_process, in, out);
 }
 

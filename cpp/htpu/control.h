@@ -103,14 +103,15 @@ class ControlPlane {
   // fast path), "tcp", or "none" (single process).
   const char* ring_transport() const { return ring_transport_; }
 
-  // Coordinator-side negotiation spans (NEGOTIATE_* with per-rank ready
-  // instants) for the multi-process mode: the Python MessageTable hooks
-  // never run there — the table lives in this class — so the timeline
-  // must be driven from the Tick loop.  Not owned; the caller keeps the
+  // Per-rank trace hooks driven from the Tick loop.  On the coordinator:
+  // negotiation spans (NEGOTIATE_* with per-rank ready instants — the
+  // Python MessageTable hooks never run in multi-process mode), TICK
+  // spans, and clock_offset instants.  On workers: TICK spans covering
+  // each request/response round trip.  Not owned; the caller keeps the
   // Timeline alive for the plane's lifetime — or DETACHES (nullptr)
   // before letting it die.  Atomic because the detach may race a Tick
   // in flight on the background thread (interpreter teardown without
-  // shutdown); Tick loads the pointer once per use.  Coordinator only.
+  // shutdown); Tick loads the pointer once per use.
   void set_timeline(Timeline* timeline) {
     timeline_.store(timeline, std::memory_order_release);
   }
@@ -194,6 +195,31 @@ class ControlPlane {
   // and return false.
   bool BroadcastResponse(std::string* response_list_blob);
 
+  // ---- cross-rank clock sync + gather-skew attribution ----
+  // Coordinator-side NTP-style midpoint estimate per worker, fed by the
+  // clock trailer every worker appends to its tick request frame
+  // (previous-response receive stamp + request send stamp, both wall
+  // clock).  With the coordinator's own previous-broadcast and
+  // request-arrival stamps this yields offset = ((t4' - t3') +
+  // (t1 - t2)) / 2 and uncertainty = RTT / 2 — worker processing time
+  // between ticks cancels out of the RTT.
+  struct ClockEst {
+    double offset_us = 0;        // worker clock minus coordinator clock
+    double uncertainty_us = 0;   // half the sampled network round trip
+    bool valid = false;
+  };
+  // Feed one trailer sample for worker process `proc`; commits the best
+  // sample of each re-estimation window to the
+  // control.clock_offset_us#rank= gauge and the trace (clock_offset
+  // instants trace_merge.py aligns per-rank files with).
+  void NoteClockSample(int proc, int64_t t1_us, int64_t t4_prev_us,
+                       int64_t t2_us);
+  // Per-tick request-ready skew: arrival_us[p] is process p's request
+  // send stamp mapped onto the coordinator clock; observes
+  // control.gather_skew_seconds#rank= lateness-vs-median histograms.
+  void ObserveGatherSkew(const std::vector<int64_t>& arrival_us,
+                         const std::vector<bool>& have_arrival);
+
   int process_index_ = 0;
   int process_count_ = 0;
   int first_rank_ = 0;
@@ -264,8 +290,22 @@ class ControlPlane {
   std::vector<char> wseg_[2];           // compressed allgather images
   std::vector<char> hier_buf_;          // raw intra-host fan-in staging
 
+  // Clock-sync state.  Worker: wall stamp of the last response receipt
+  // (t4', echoed in the next trailer).  Coordinator: wall stamp of the
+  // last response broadcast (t3') plus the per-process estimator state.
+  int64_t last_resp_recv_us_ = 0;
+  int64_t last_bcast_us_ = 0;
+  struct ClockSync {
+    ClockEst est;                 // committed (gauge + trace metadata)
+    ClockEst best;                // best sample since the last commit
+    uint64_t last_commit_tick = 0;
+  };
+  std::vector<ClockSync> clock_sync_;        // per process index
+  std::vector<std::string> skew_names_;      // precomputed metric names
+  std::vector<std::string> offset_names_;
+
   std::unique_ptr<MessageTable> table_;   // coordinator only
-  std::atomic<Timeline*> timeline_{nullptr};  // coordinator only; not owned
+  std::atomic<Timeline*> timeline_{nullptr};  // not owned
   std::unordered_set<std::string> negotiating_;   // timeline span state
 
   // Response cache (HOROVOD_TPU_CACHE_CAPACITY; 0 disables and keeps the

@@ -1,0 +1,213 @@
+#include "htpu/flight_recorder.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace htpu {
+
+int64_t WallClockUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return int64_t(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+namespace {
+
+constexpr int64_t kDefaultTicks = 64;
+constexpr int64_t kEventsPerTick = 16;
+constexpr int64_t kMinEvents = 8;
+constexpr int64_t kMaxEvents = 1 << 20;
+
+// Copy src into a fixed char field, replacing anything that could break
+// the dump's JSON (control chars, '"', '\\', non-ASCII) with '.'.  The
+// sanitizing happens at record time so the dump paths — including the
+// lock-free signal path — can quote the bytes verbatim.
+template <size_t N>
+void CopySanitized(char (&dst)[N], const char* src) {
+  size_t i = 0;
+  if (src) {
+    for (; i + 1 < N && src[i]; ++i) {
+      unsigned char c = (unsigned char)src[i];
+      dst[i] = (c < 0x20 || c > 0x7e || c == '"' || c == '\\') ? '.' : (char)c;
+    }
+  }
+  for (; i < N; ++i) dst[i] = '\0';
+}
+
+// One event as a JSON object into buf; returns bytes written (snprintf
+// semantics, always NUL-terminated).  Shared by the locked and the
+// signal dump paths.
+int FormatEvent(char* buf, size_t cap, const FlightEvent& ev) {
+  int n = snprintf(buf, cap,
+                   "{\"ts_us\":%lld,\"tick\":%llu,\"kind\":\"%s\","
+                   "\"detail\":\"%s\",\"bytes\":%lld,\"a\":%d,\"b\":%d}",
+                   (long long)ev.ts_us, (unsigned long long)ev.tick,
+                   ev.kind, ev.detail, (long long)ev.bytes, (int)ev.a,
+                   (int)ev.b);
+  if (n < 0) n = 0;
+  if ((size_t)n >= cap) n = (int)cap - 1;
+  return n;
+}
+
+int64_t EnvCapacityEvents() {
+  const char* s = getenv("HOROVOD_TPU_FLIGHT_RECORDER_TICKS");
+  long long ticks = s && *s ? atoll(s) : kDefaultTicks;
+  if (ticks <= 0) ticks = kDefaultTicks;
+  return ticks * kEventsPerTick;
+}
+
+}  // namespace
+
+FlightRecorder::FlightRecorder() {
+  int64_t cap = EnvCapacityEvents();
+  if (cap < kMinEvents) cap = kMinEvents;
+  if (cap > kMaxEvents) cap = kMaxEvents;
+  ring_.resize(size_t(cap));
+  const char* d = getenv("HOROVOD_TPU_FLIGHT_RECORDER_DIR");
+  if (!d || !*d) d = getenv("TMPDIR");
+  if (!d || !*d) d = "/tmp";
+  dir_ = d;
+}
+
+FlightRecorder& FlightRecorder::Get() {
+  static FlightRecorder* recorder = new FlightRecorder();  // never destroyed
+  return *recorder;
+}
+
+void FlightRecorder::SetCapacityEvents(int64_t events) {
+  if (events < kMinEvents) events = kMinEvents;
+  if (events > kMaxEvents) events = kMaxEvents;
+  std::lock_guard<std::mutex> lock(mu_);
+  ring_.assign(size_t(events), FlightEvent{});
+  seq_ = 0;
+}
+
+int64_t FlightRecorder::capacity() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return int64_t(ring_.size());
+}
+
+void FlightRecorder::SetRank(int rank) {
+  std::lock_guard<std::mutex> lock(mu_);
+  rank_ = rank;
+}
+
+void FlightRecorder::Record(const char* kind, const char* detail,
+                            int64_t bytes, int32_t a, int32_t b) {
+  FlightEvent ev;
+  ev.ts_us = WallClockUs();
+  ev.tick = tick_.load(std::memory_order_relaxed);
+  ev.bytes = bytes;
+  ev.a = a;
+  ev.b = b;
+  CopySanitized(ev.kind, kind);
+  CopySanitized(ev.detail, detail);
+  std::lock_guard<std::mutex> lock(mu_);
+  ring_[size_t(seq_ % ring_.size())] = ev;
+  ++seq_;
+}
+
+std::string FlightRecorder::SnapshotJson(const std::string& why) const {
+  char buf[512];
+  std::string out;
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t cap = ring_.size();
+  uint64_t n = seq_ < cap ? seq_ : cap;
+  uint64_t first = seq_ - n;   // oldest retained event
+  snprintf(buf, sizeof(buf),
+           "{\"rank\":%d,\"why\":\"%s\",\"dumped_at_us\":%lld,"
+           "\"tick\":%llu,\"capacity\":%llu,\"recorded\":%llu,"
+           "\"dropped\":%llu,\"events\":[",
+           rank_, why.c_str(), (long long)WallClockUs(),
+           (unsigned long long)tick_.load(std::memory_order_relaxed),
+           (unsigned long long)cap, (unsigned long long)seq_,
+           (unsigned long long)first);
+  out += buf;
+  for (uint64_t i = 0; i < n; ++i) {
+    if (i) out += ',';
+    FormatEvent(buf, sizeof(buf), ring_[size_t((first + i) % cap)]);
+    out += buf;
+  }
+  out += "]}\n";
+  return out;
+}
+
+std::string FlightRecorder::DumpPath() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return dir_ + "/htpu_flight.rank" + std::to_string(rank_) + ".json";
+}
+
+std::string FlightRecorder::Dump(const std::string& why) {
+  std::string path = DumpPath();
+  std::string body = SnapshotJson(why);
+  FILE* f = fopen(path.c_str(), "w");
+  if (!f) return std::string();
+  size_t wrote = fwrite(body.data(), 1, body.size(), f);
+  int rc = fclose(f);
+  if (wrote != body.size() || rc != 0) return std::string();
+  return path;
+}
+
+void FlightRecorder::SignalDump(const char* why) {
+  // No locking, no allocation: the handler may fire while the tick
+  // thread holds mu_ (that is the whole point — the tick thread is
+  // presumed wedged).  Reading the ring racily is fine: every slot is
+  // POD with NUL-terminated strings, so the worst case is one event
+  // with mixed old/new fields, still valid JSON.
+  char path[512];
+  char buf[512];
+  snprintf(path, sizeof(path), "%s/htpu_flight.rank%d.json", dir_.c_str(),
+           rank_);
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  uint64_t cap = ring_.size();
+  uint64_t seq = seq_;
+  uint64_t n = seq < cap ? seq : cap;
+  uint64_t first = seq - n;
+  int len = snprintf(buf, sizeof(buf),
+                     "{\"rank\":%d,\"why\":\"%s\",\"dumped_at_us\":%lld,"
+                     "\"tick\":%llu,\"capacity\":%llu,\"recorded\":%llu,"
+                     "\"dropped\":%llu,\"events\":[",
+                     rank_, why ? why : "signal",
+                     (long long)WallClockUs(),
+                     (unsigned long long)tick_.load(
+                         std::memory_order_relaxed),
+                     (unsigned long long)cap, (unsigned long long)seq,
+                     (unsigned long long)first);
+  if (len > 0) (void)!write(fd, buf, size_t(len));
+  for (uint64_t i = 0; i < n; ++i) {
+    if (i) (void)!write(fd, ",", 1);
+    len = FormatEvent(buf, sizeof(buf), ring_[size_t((first + i) % cap)]);
+    if (len > 0) (void)!write(fd, buf, size_t(len));
+  }
+  (void)!write(fd, "]}\n", 3);
+  close(fd);
+}
+
+namespace {
+
+void Sigusr2Handler(int) {
+  FlightRecorder::Get().SignalDump("sigusr2");
+}
+
+}  // namespace
+
+void FlightRecorder::InstallSignalDump() {
+  static bool installed = false;
+  if (installed) return;
+  installed = true;
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = Sigusr2Handler;
+  sigemptyset(&sa.sa_mask);
+  sa.sa_flags = SA_RESTART;
+  sigaction(SIGUSR2, &sa, nullptr);
+}
+
+}  // namespace htpu

@@ -1,0 +1,107 @@
+// Abort-time flight recorder: a fixed-size ring of recent control/data
+// plane events kept in memory at all times and dumped as JSON when the
+// job dies (latched abort, op timeout, SIGUSR2).
+//
+// The reference's timeline answers "what happened while things worked";
+// this answers "what were the last N ticks doing when they stopped".
+// Recording must therefore be cheap enough to leave on unconditionally
+// (one mutex'd POD copy per event, no allocation after Reserve) and the
+// dump must work from the places jobs actually die: the latched-abort
+// path on the tick thread, and a signal handler poking a process whose
+// tick thread is wedged (HOROVOD_TPU_FAULT=hang leaves exactly that).
+//
+// Knobs:
+//   HOROVOD_TPU_FLIGHT_RECORDER_TICKS  ring depth in ticks (default 64;
+//                                      ~16 event slots per tick; 0 keeps
+//                                      recording with the default depth)
+//   HOROVOD_TPU_FLIGHT_RECORDER_DIR    dump directory (default $TMPDIR
+//                                      or /tmp); file name is
+//                                      htpu_flight.rank<R>.json
+#ifndef HTPU_FLIGHT_RECORDER_H_
+#define HTPU_FLIGHT_RECORDER_H_
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace htpu {
+
+// Wall-clock microseconds (CLOCK_REALTIME).  The flight recorder and the
+// clock-offset trailer both stamp with this so dumps and merged traces
+// share an absolute timebase.
+int64_t WallClockUs();
+
+// One recorded event.  POD with fixed-size, always-NUL-terminated string
+// fields: the signal-path dump may race an in-progress Record() and must
+// never read an unterminated or JSON-breaking byte (detail/kind are
+// sanitized to plain printable ASCII at record time).
+struct FlightEvent {
+  int64_t ts_us = 0;    // WallClockUs() at record time
+  uint64_t tick = 0;    // control-plane tick the event belongs to
+  int64_t bytes = 0;    // payload/frame size when meaningful, else 0
+  int32_t a = 0;        // event-specific: peer process / rank / fd
+  int32_t b = 0;        // event-specific: errno / count
+  char kind[16] = {0};  // e.g. "tick.send", "gather.fail", "abort"
+  char detail[96] = {0};  // tensor name, algo=.. wire=.., reason text
+};
+
+class FlightRecorder {
+ public:
+  // Process-wide singleton (one control plane per process in practice;
+  // transport-level failures have no plane pointer in scope anyway).
+  static FlightRecorder& Get();
+
+  // Ring capacity in EVENTS (SetCapacityTicks(n) ~= n * 16 events).
+  // Existing events are dropped on resize; cheap, call at init.
+  void SetCapacityEvents(int64_t events);
+  void SetCapacityTicks(int64_t ticks) { SetCapacityEvents(ticks * 16); }
+  int64_t capacity() const;
+
+  void SetRank(int rank);
+  int rank() const { return rank_; }
+  // Current tick, stamped onto subsequent events.
+  void SetTick(uint64_t tick) {
+    tick_.store(tick, std::memory_order_relaxed);
+  }
+
+  void Record(const char* kind, const char* detail, int64_t bytes = 0,
+              int32_t a = 0, int32_t b = 0);
+
+  // Full dump as a JSON object (rank, why, dumped_at_us, tick, dropped,
+  // events oldest-first).  Safe from any thread.
+  std::string SnapshotJson(const std::string& why) const;
+
+  // Write SnapshotJson to the per-rank dump path.  Returns the path, or
+  // "" when the write failed.  Safe from any thread (not from signals —
+  // use SignalDump there).
+  std::string Dump(const std::string& why);
+
+  // Signal-tolerant dump: fixed stack buffers, open(2)/write(2) only, no
+  // locking (a torn in-progress slot still yields valid JSON because all
+  // string fields stay NUL-terminated and sanitized).  Installed on
+  // SIGUSR2 by InstallSignalDump(); the launcher pokes hung ranks with
+  // it before escalating to SIGTERM.
+  void SignalDump(const char* why);
+
+  // Install the SIGUSR2 handler once per process.  Idempotent.
+  static void InstallSignalDump();
+
+  // Where Dump()/SignalDump() write for this rank.
+  std::string DumpPath() const;
+
+ private:
+  FlightRecorder();
+
+  mutable std::mutex mu_;
+  std::vector<FlightEvent> ring_;   // ring_[seq % capacity]
+  uint64_t seq_ = 0;                // total events ever recorded
+  std::atomic<uint64_t> tick_{0};
+  int rank_ = 0;
+  std::string dir_;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_FLIGHT_RECORDER_H_

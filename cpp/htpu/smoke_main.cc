@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "htpu/control.h"
+#include "htpu/flight_recorder.h"
 #include "htpu/wire.h"
 
 // c_api.cc is linked into this binary too; exercise the exported metrics
@@ -220,6 +221,47 @@ int RunProcess(int pidx, int port) {
       if (at == std::string::npos || atoll(js.c_str() + at + strlen(key)) < 2) {
         return Fail(pidx, "per-algo op counter missing or low");
       }
+    }
+  }
+
+  // Flight recorder: shrink the ring far below what the run above has
+  // recorded, force a wrap with more events than capacity, and check the
+  // snapshot is balanced JSON that owns up to the eviction.  Runs in
+  // every process (distinct per-rank dump files) under the sanitizers.
+  {
+    auto& fr = htpu::FlightRecorder::Get();
+    fr.SetCapacityEvents(8);
+    for (int i = 0; i < 32; ++i) {
+      fr.Record("smoke.wrap", "flight phase", i, i, pidx);
+    }
+    std::string js = fr.SnapshotJson("smoke");
+    if (js.empty() || js.front() != '{' || js.back() != '\n') {
+      return Fail(pidx, "flight snapshot malformed");
+    }
+    long depth = 0;
+    bool in_str = false, esc = false;
+    for (char c : js) {
+      if (esc) { esc = false; continue; }
+      if (in_str) {
+        if (c == '\\') esc = true;
+        else if (c == '"') in_str = false;
+        continue;
+      }
+      if (c == '"') in_str = true;
+      else if (c == '{') ++depth;
+      else if (c == '}') --depth;
+      if (depth < 0) break;
+    }
+    if (depth != 0 || in_str) {
+      return Fail(pidx, "flight snapshot braces unbalanced");
+    }
+    if (js.find("\"dropped\":") == std::string::npos ||
+        js.find("smoke.wrap") == std::string::npos) {
+      return Fail(pidx, "flight snapshot missing wrap evidence");
+    }
+    std::string dump = fr.Dump("smoke");
+    if (dump.empty() || access(dump.c_str(), R_OK) != 0) {
+      return Fail(pidx, "flight dump not written");
     }
   }
 

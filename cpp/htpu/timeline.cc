@@ -2,6 +2,8 @@
 
 #include <sstream>
 
+#include "htpu/flight_recorder.h"  // WallClockUs
+
 namespace htpu {
 
 namespace {
@@ -44,11 +46,19 @@ const char* ResponseTypeTraceName(ResponseType t) {
 
 }  // namespace
 
-Timeline::Timeline(const std::string& path) {
+Timeline::Timeline(const std::string& path, int rank) {
   file_ = fopen(path.c_str(), "w");
-  if (file_) fputs("[\n", file_);
+  if (file_) fputs("[", file_);
   t0_ = std::chrono::steady_clock::now();
   last_flush_ = t0_;
+  // Absolute anchor: ts 0 of this trace corresponds to t0_wall_us on
+  // this process's wall clock.  trace_merge.py keys per-rank alignment
+  // off this event.
+  std::ostringstream os;
+  os << "{\"name\": \"trace_t0\", \"ph\": \"i\", \"s\": \"g\", \"pid\": 0, "
+     << "\"ts\": 0, \"args\": {\"rank\": " << rank << ", \"t0_wall_us\": "
+     << WallClockUs() << "}}";
+  Emit(os.str());
 }
 
 Timeline::~Timeline() { Close(); }
@@ -62,8 +72,9 @@ int64_t Timeline::TsUs() const {
 void Timeline::Emit(const std::string& json_line) {
   std::lock_guard<std::mutex> l(mu_);
   if (closed_ || !file_) return;
+  fputs(first_event_ ? "\n" : ",\n", file_);
+  first_event_ = false;
   fputs(json_line.c_str(), file_);
-  fputs(",\n", file_);
   auto now = std::chrono::steady_clock::now();
   if (std::chrono::duration<double>(now - last_flush_).count() >
       kFlushEverySeconds) {
@@ -155,6 +166,32 @@ void Timeline::CacheHitTick(int64_t dur_us) {
   Emit(os.str());
 }
 
+void Timeline::TickSpan(uint64_t tick, int64_t dur_us) {
+  if (dur_us < 0) dur_us = 0;
+  std::ostringstream os;
+  os << "{\"ph\": \"X\", \"pid\": 0, \"ts\": " << TsUs() - dur_us
+     << ", \"dur\": " << dur_us << ", \"name\": \"TICK\", \"args\": "
+     << "{\"tick\": " << tick << "}}";
+  Emit(os.str());
+}
+
+void Timeline::Instant(const std::string& name,
+                       const std::string& args_json) {
+  std::ostringstream os;
+  os << "{\"name\": \"" << JsonEscape(name)
+     << "\", \"ph\": \"i\", \"s\": \"g\", \"pid\": 0, \"ts\": " << TsUs()
+     << ", \"args\": " << (args_json.empty() ? "{}" : args_json) << "}";
+  Emit(os.str());
+}
+
+void Timeline::ClockOffset(int rank, double offset_us,
+                           double uncertainty_us) {
+  std::ostringstream os;
+  os << "{\"rank\": " << rank << ", \"offset_us\": " << offset_us
+     << ", \"uncertainty_us\": " << uncertainty_us << "}";
+  Instant("clock_offset", os.str());
+}
+
 void Timeline::Counter(const std::string& name, int64_t value) {
   std::ostringstream os;
   os << "{\"ph\": \"C\", \"pid\": 0, \"ts\": " << TsUs() << ", \"name\": \""
@@ -170,7 +207,7 @@ void Timeline::Flush() {
 void Timeline::Close() {
   std::lock_guard<std::mutex> l(mu_);
   if (!closed_ && file_) {
-    fputs("{}]\n", file_);
+    fputs("\n]\n", file_);
     fclose(file_);
     file_ = nullptr;
     closed_ = true;

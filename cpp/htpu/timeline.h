@@ -21,7 +21,11 @@ namespace htpu {
 
 class Timeline {
  public:
-  explicit Timeline(const std::string& path);
+  // `rank` tags the trace with the recording rank: every trace opens
+  // with a "trace_t0" instant carrying {rank, t0_wall_us} so
+  // tools/trace_merge.py can map each file to its rank and anchor the
+  // monotonic timestamps to wall clock.
+  explicit Timeline(const std::string& path, int rank = 0);
   ~Timeline();
 
   bool ok() const { return file_ != nullptr; }
@@ -41,6 +45,17 @@ class Timeline {
   // negotiation tick served entirely from the response cache: visually
   // distinct from NEGOTIATE_* spans, dur = full Tick latency.
   void CacheHitTick(int64_t dur_us);
+  // Complete-event span on the control track covering one negotiation
+  // tick (worker: request send -> response received; coordinator:
+  // gather start -> broadcast done).  Emitted on EVERY rank so merged
+  // traces line the tick stream up across processes by args.tick.
+  void TickSpan(uint64_t tick, int64_t dur_us);
+  // Global instant on the control track with a raw JSON args object
+  // (caller-built, e.g. "{\"rank\": 1, \"offset_us\": 12.5}").
+  void Instant(const std::string& name, const std::string& args_json);
+  // Coordinator clock-sync metadata: the estimated wall-clock offset of
+  // `rank` relative to this process (positive = rank's clock is ahead).
+  void ClockOffset(int rank, double offset_us, double uncertainty_us);
   void Flush();
   void Close();
 
@@ -56,6 +71,11 @@ class Timeline {
   std::unordered_map<std::string, int> tensor_pids_;
   int next_pid_ = 1;
   bool closed_ = false;
+  bool first_event_ = true;   // comma bookkeeping: ",\n" BEFORE each
+                              // event after the first, so a killed
+                              // process leaves a trace missing only the
+                              // final "]" (trivially repairable) while
+                              // Close() writes strictly valid JSON.
 };
 
 }  // namespace htpu

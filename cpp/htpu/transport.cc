@@ -16,6 +16,7 @@
 #include <cstring>
 #include <thread>
 
+#include "htpu/flight_recorder.h"
 #include "htpu/metrics.h"
 
 namespace htpu {
@@ -225,6 +226,8 @@ bool SendFrame(int fd, const std::string& payload) {
     ssize_t w = sendmsg(fd, &msg, MSG_NOSIGNAL);
     if (w <= 0) {
       if (w < 0 && errno == EINTR) continue;
+      FlightRecorder::Get().Record("frame.send_fail", "",
+                                   int64_t(payload.size()), fd, errno);
       return false;
     }
     done += size_t(w);
@@ -241,7 +244,13 @@ bool SendFrame(int fd, const std::string& payload) {
 
 bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
   uint8_t hdr[4];
-  if (!RecvAll(fd, hdr, 4, timeout_ms)) return false;
+  if (!RecvAll(fd, hdr, 4, timeout_ms)) {
+    // EOF, error, or the poll deadline lapsing with no header — this is
+    // the site a missed heartbeat is actually observed at.
+    FlightRecorder::Get().Record("frame.recv_fail", "no frame header", 0,
+                                 fd, errno);
+    return false;
+  }
   uint32_t len = 0;
   for (int i = 0; i < 4; ++i) len |= uint32_t(hdr[i]) << (8 * i);
   if (len > kMaxFrameBytes) {
@@ -249,10 +258,14 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
             "htpu transport: incoming frame length %u exceeds the %llu-byte "
             "cap — corrupt stream or an unchunked oversized payload\n", len,
             (unsigned long long)kMaxFrameBytes);
+    FlightRecorder::Get().Record("frame.recv_fail", "oversized frame",
+                                 int64_t(len), fd, 0);
     return false;
   }
   payload->resize(len);
   if (len != 0 && !RecvAll(fd, &(*payload)[0], len, timeout_ms)) {
+    FlightRecorder::Get().Record("frame.recv_fail", "truncated payload",
+                                 int64_t(len), fd, errno);
     return false;
   }
   static std::atomic<long long>* frames =
@@ -305,13 +318,23 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
     int remain = int(std::chrono::duration_cast<std::chrono::milliseconds>(
                          deadline - std::chrono::steady_clock::now())
                          .count());
-    if (remain <= 0) return false;
+    if (remain <= 0) {
+      FlightRecorder::Get().Record("duplex.timeout", "",
+                                   int64_t(send_len + recv_len), send_fd,
+                                   recv_fd);
+      return false;
+    }
     int pr = poll(fds, nfds_t(nfds), remain);
     if (pr < 0) {
       if (errno == EINTR) continue;
       return false;
     }
-    if (pr == 0) return false;  // timeout
+    if (pr == 0) {
+      FlightRecorder::Get().Record("duplex.timeout", "",
+                                   int64_t(send_len + recv_len), send_fd,
+                                   recv_fd);
+      return false;  // timeout
+    }
     // POLLHUP on the send side is peer death: without it a hung-up
     // downstream neighbour left this loop busy-polling until the timeout
     // instead of failing the step the moment the kernel knew.
@@ -324,6 +347,9 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
       if (n < 0) {
         if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
           if (failed_fd) *failed_fd = send_fd;
+          FlightRecorder::Get().Record("duplex.send_fail", "",
+                                       int64_t(send_len - sent), send_fd,
+                                       errno);
           return false;
         }
       } else {
@@ -337,10 +363,15 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
       if (n < 0) {
         if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
           if (failed_fd) *failed_fd = recv_fd;
+          FlightRecorder::Get().Record("duplex.recv_fail", "",
+                                       int64_t(recv_len - rcvd), recv_fd,
+                                       errno);
           return false;
         }
       } else if (n == 0) {
         if (failed_fd) *failed_fd = recv_fd;
+        FlightRecorder::Get().Record("duplex.recv_fail", "peer closed",
+                                     int64_t(recv_len - rcvd), recv_fd, 0);
         return false;  // peer closed mid-transfer
       } else {
         rcvd += size_t(n);

@@ -676,6 +676,16 @@ class HandleManager:
                         lambda: self._results[handle] is not None, timeout):
                     name = self._names.get(handle, "")
                     op = f" (op '{name}')" if name else ""
+                    # Capture the last N ticks of control/transport events
+                    # before abandoning: a wedged collective is exactly the
+                    # moment post-hoc state is needed and live inspection is
+                    # impossible.
+                    from horovod_tpu import cpp_core
+                    cpp_core.flight_record("op.timeout", name, 0, handle,
+                                           int(timeout or 0))
+                    flight = cpp_core.flight_dump("op_timeout")
+                    flight_note = (f" [flight recorder: {flight}]"
+                                   if flight else "")
                     if abandon_on_timeout:
                         self._results.pop(handle, None)
                         self._mesh_hazard.discard(handle)
@@ -685,8 +695,9 @@ class HandleManager:
                             f"{timeout:.0f}s (HOROVOD_TPU_OP_TIMEOUT_S); the "
                             "handle has been abandoned. A peer rank likely "
                             "never submitted this collective — check for "
-                            "stall warnings on rank 0.")
-                    raise TimeoutError(f"handle {handle}{op} did not complete")
+                            "stall warnings on rank 0." + flight_note)
+                    raise TimeoutError(
+                        f"handle {handle}{op} did not complete" + flight_note)
                 return self._results[handle]
         finally:
             # Time-to-result from the framework thread's point of view —
@@ -1010,12 +1021,21 @@ class Controller:
 
         self.timeline = None
         timeline_path = os.environ.get("HOROVOD_TPU_TIMELINE", "")
-        if timeline_path and topology.rank == 0:
+        if timeline_path:
+            # Every rank traces (the reference traces only the
+            # coordinator; per-rank traces are what trace_merge.py and
+            # straggler attribution feed on).  The env value is a path
+            # template — resolve this rank's file from it.  Idempotent
+            # when run.py already filled it in for this child.
+            from horovod_tpu.timeline import per_rank_trace_path
+            rank_path = per_rank_trace_path(
+                timeline_path, topology.rank, topology.size)
             if self._use_cpp:
-                self.timeline = cpp_core.CppTimeline(timeline_path)
+                self.timeline = cpp_core.CppTimeline(
+                    rank_path, topology.rank)
             else:
                 from horovod_tpu.timeline import Timeline
-                self.timeline = Timeline(timeline_path)
+                self.timeline = Timeline(rank_path, topology.rank)
         if (self._control is not None and self.timeline is not None
                 and hasattr(self.timeline, "attach_to_control")):
             # Multi-process mode negotiates inside the C++ coordinator;
@@ -1255,6 +1275,16 @@ class Controller:
             report = self._pending_report
             self._pending_report = None
         abort_rank, abort_reason = report if report is not None else (-1, "")
+        if pending:
+            # Flight-recorder breadcrumb naming what this rank is about to
+            # negotiate: an abort dump then shows WHICH tensors were in
+            # flight on the stalled tick, not just that a tick stalled.
+            from horovod_tpu import cpp_core
+            names = ",".join(r.tensor_name for r in pending[:4])
+            if len(pending) > 4:
+                names += f",+{len(pending) - 4}"
+            cpp_core.flight_record("negotiate.pending", names,
+                                   0, len(pending))
         blob = wire.serialize_request_list(
             pending, shutdown=shutting,
             abort_rank=abort_rank, abort_reason=abort_reason)

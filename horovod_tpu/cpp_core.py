@@ -62,6 +62,20 @@ def _configure(lib) -> None:
     lib.htpu_timeline_create.restype = ctypes.c_void_p
     lib.htpu_timeline_create.argtypes = [ctypes.c_char_p]
     lib.htpu_timeline_destroy.argtypes = [ctypes.c_void_p]
+    # Newer symbols are guarded so a prebuilt library from an older round
+    # still loads (the hasattr idiom used for htpu_wire_encode below).
+    if hasattr(lib, "htpu_timeline_create_rank"):
+        lib.htpu_timeline_create_rank.restype = ctypes.c_void_p
+        lib.htpu_timeline_create_rank.argtypes = [
+            ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, "htpu_timeline_instant"):
+        lib.htpu_timeline_instant.restype = None
+        lib.htpu_timeline_instant.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    if hasattr(lib, "htpu_timeline_tick_span"):
+        lib.htpu_timeline_tick_span.restype = None
+        lib.htpu_timeline_tick_span.argtypes = [
+            ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_longlong]
     for fn in ("negotiate_start", "start"):
         f = getattr(lib, f"htpu_timeline_{fn}")
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -147,6 +161,21 @@ def _configure(lib) -> None:
     lib.htpu_metrics_snapshot.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_metrics_reset.restype = None
     lib.htpu_metrics_reset.argtypes = []
+    if hasattr(lib, "htpu_flight_record"):
+        lib.htpu_flight_record.restype = None
+        lib.htpu_flight_record.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int]
+        lib.htpu_flight_set_capacity.restype = None
+        lib.htpu_flight_set_capacity.argtypes = [ctypes.c_longlong]
+        lib.htpu_flight_set_rank.restype = None
+        lib.htpu_flight_set_rank.argtypes = [ctypes.c_int]
+        lib.htpu_flight_dump.restype = ctypes.c_int
+        lib.htpu_flight_dump.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_flight_snapshot.restype = ctypes.c_int
+        lib.htpu_flight_snapshot.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
 
 
 def load():
@@ -205,6 +234,68 @@ def _take_buffer(lib, out_ptr: ctypes.c_void_p, length: int) -> bytes:
         return ctypes.string_at(out_ptr, length)
     finally:
         lib.htpu_free(out_ptr)
+
+
+# ------------------------------------------------------- flight recorder
+
+def _flight_lib():
+    """The loaded library iff it exports the flight-recorder API, else
+    None — every helper below degrades to a no-op on a pure-Python run or
+    a stale prebuilt .so."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_flight_record"):
+        return None
+    return lib
+
+
+def flight_record(kind: str, detail: str = "", nbytes: int = 0,
+                  a: int = 0, b: int = 0) -> None:
+    """Append one event to the native flight-recorder ring (no-op without
+    the native core).  Python-side callers use this to mark host-level
+    context — op-timeout pending tensors, shutdown phases — so the abort
+    dump interleaves them with the C++ tick/transfer events."""
+    lib = _flight_lib()
+    if lib is not None:
+        lib.htpu_flight_record(kind.encode("utf-8"), detail.encode("utf-8"),
+                               int(nbytes), int(a), int(b))
+
+
+def flight_set_capacity(events: int) -> None:
+    lib = _flight_lib()
+    if lib is not None:
+        lib.htpu_flight_set_capacity(int(events))
+
+
+def flight_set_rank(rank: int) -> None:
+    lib = _flight_lib()
+    if lib is not None:
+        lib.htpu_flight_set_rank(int(rank))
+
+
+def flight_dump(why: str = "manual") -> str:
+    """Dump the ring to its per-rank JSON file; returns the path, or ""
+    when the dump failed or the native core is absent."""
+    lib = _flight_lib()
+    if lib is None:
+        return ""
+    out = ctypes.c_void_p()
+    n = lib.htpu_flight_dump(why.encode("utf-8"), ctypes.byref(out))
+    if n < 0:
+        return ""
+    return _take_buffer(lib, out, n).decode("utf-8", errors="replace")
+
+
+def flight_snapshot(why: str = "snapshot") -> str:
+    """The ring serialized as JSON (without touching disk); "" when the
+    native core is absent."""
+    lib = _flight_lib()
+    if lib is None:
+        return ""
+    out = ctypes.c_void_p()
+    n = lib.htpu_flight_snapshot(why.encode("utf-8"), ctypes.byref(out))
+    if n < 0:
+        return ""
+    return _take_buffer(lib, out, n).decode("utf-8", errors="replace")
 
 
 class CppMessageTable:
@@ -558,13 +649,18 @@ class CppTimeline:
     fallback merely raises.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rank: int = 0):
         self._lib = load()
         if self._lib is None:
             raise RuntimeError("native core not available")
-        self._ptr = self._lib.htpu_timeline_create(path.encode("utf-8"))
+        if hasattr(self._lib, "htpu_timeline_create_rank"):
+            self._ptr = self._lib.htpu_timeline_create_rank(
+                path.encode("utf-8"), int(rank))
+        else:   # stale prebuilt .so: trace_t0 reports rank 0
+            self._ptr = self._lib.htpu_timeline_create(path.encode("utf-8"))
         if not self._ptr:
             raise OSError(f"cannot open timeline file: {path}")
+        self.rank = rank
 
     def attach_to_control(self, control: "CppControlPlane") -> None:
         """Wire this writer into the native coordinator so its Tick loop
@@ -636,6 +732,23 @@ class CppTimeline:
         if not self._ptr:
             return
         self._lib.htpu_timeline_cache_hit_tick(self._ptr, int(dur_us))
+
+    def tick_span(self, tick: int, dur_us: int) -> None:
+        """TICK complete-event span tagged with the tick id — the
+        cross-rank alignment anchor trace_merge.py lines traces up by."""
+        if not self._ptr or not hasattr(self._lib,
+                                        "htpu_timeline_tick_span"):
+            return
+        self._lib.htpu_timeline_tick_span(self._ptr, int(tick), int(dur_us))
+
+    def instant(self, name: str, args: dict = None) -> None:
+        """Global instant event on the control track."""
+        if not self._ptr or not hasattr(self._lib, "htpu_timeline_instant"):
+            return
+        import json
+        self._lib.htpu_timeline_instant(
+            self._ptr, name.encode("utf-8"),
+            json.dumps(args or {}).encode("utf-8"))
 
     def flush(self) -> None:
         if self._ptr:

@@ -94,6 +94,14 @@ def main(argv=None):
             env["HOROVOD_TPU_METRICS_EVERY_S"] = str(args.metrics_every)
         if args.metrics_port > 0:
             env["HOROVOD_TPU_METRICS_PORT"] = str(args.metrics_port)
+        if env.get("HOROVOD_TPU_TIMELINE"):
+            # The env value is a per-rank path template; fill it in per
+            # child so every rank writes its own trace (merge afterwards
+            # with tools/trace_merge.py).  The controller's own resolution
+            # is idempotent over an already-filled path.
+            from horovod_tpu.timeline import per_rank_trace_path
+            env["HOROVOD_TPU_TIMELINE"] = per_rank_trace_path(
+                env["HOROVOD_TPU_TIMELINE"], pidx * rpp, size)
         procs.append(subprocess.Popen(cmd, env=env))
 
     # Fast-fail supervision (mpirun semantics): poll ALL children
@@ -143,6 +151,20 @@ def _supervise(procs, grace_s: float) -> int:
 def _reap(procs, sig, grace_s: float):
     """Signal all still-running children, give them ``grace_s`` to exit,
     then SIGKILL whatever remains."""
+    # SIGUSR2 first: the native core installs a flight-recorder dump
+    # handler, so a wedged child (e.g. HOROVOD_TPU_FAULT=hang, stuck in a
+    # blocking recv) leaves its last-N-ticks dump on disk before the
+    # terminate below destroys the evidence.  A child without the handler
+    # (never initialized the native core) dies to SIGUSR2's default
+    # disposition — acceptable, since _reap only runs when the job is
+    # being torn down anyway.
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGUSR2)
+            except OSError:
+                pass
+    time.sleep(0.2)
     for proc in procs:
         if proc.poll() is None:
             try:

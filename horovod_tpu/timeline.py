@@ -5,9 +5,13 @@ a trace "process" (metadata event naming it); spans cover the negotiation
 phase (NEGOTIATE_ALLREDUCE etc. with per-rank instant events), a QUEUE span
 (response constructed → executor start, the reference's time-in-queue
 bracket, ``operations.h:35``), the top-level operation, and nested
-activities (MEMCPY_IN_FUSION_BUFFER, XLA_ALLREDUCE, ...).  Opened on rank 0
-only, when ``HOROVOD_TPU_TIMELINE`` is set (reference
-``operations.cc:1556-1560``).  Output loads in ``chrome://tracing`` /
+activities (MEMCPY_IN_FUSION_BUFFER, XLA_ALLREDUCE, ...).  Opened on EVERY
+rank when ``HOROVOD_TPU_TIMELINE`` is set: the value is a path template
+(a literal ``{rank}`` placeholder, or ``.rank<R>`` inserted before the
+extension in multi-rank jobs — ``per_rank_trace_path``), each trace opens
+with a ``trace_t0`` wall-clock anchor, and the coordinator records
+``clock_offset`` estimates so ``tools/trace_merge.py`` can merge the
+per-rank files onto one timebase.  Output loads in ``chrome://tracing`` /
 Perfetto.
 
 This complements (does not replace) the XLA profiler: it shows the
@@ -22,9 +26,31 @@ the format specification.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional
+
+
+def per_rank_trace_path(template: str, rank: int, size: int = None) -> str:
+    """Resolve the ``HOROVOD_TPU_TIMELINE`` path template for one rank.
+
+    A literal ``{rank}`` placeholder is always substituted.  Without a
+    placeholder, multi-rank jobs (``size`` > 1 or unknown) get ``.rank<R>``
+    inserted before the extension — ``/tmp/t.json`` → ``/tmp/t.rank1.json``
+    — while single-rank jobs keep the literal path (back-compat with the
+    rank-0-only tracing of earlier rounds).  Idempotent: a path already
+    carrying this rank's suffix passes through unchanged (run.py fills the
+    template per child AND the controller resolves it again locally).
+    """
+    if "{rank}" in template:
+        return template.replace("{rank}", str(rank))
+    if size is not None and size <= 1:
+        return template
+    root, ext = os.path.splitext(template)
+    if root.endswith(f".rank{rank}"):
+        return template
+    return f"{root}.rank{rank}{ext}"
 
 
 def wire_activity(base: str, wire_dtype: str) -> str:
@@ -38,15 +64,24 @@ def wire_activity(base: str, wire_dtype: str) -> str:
 class Timeline:
     FLUSH_EVERY_S = 1.0   # reference timeline.h:32
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rank: int = 0):
         self._file = open(path, "w")
-        self._file.write("[\n")
+        self._file.write("[")
         self._lock = threading.Lock()
+        self._first_event = True
         self._t0 = time.monotonic()
+        t0_wall_us = int(time.time() * 1e6)
         self._tensor_pids: Dict[str, int] = {}
         self._next_pid = 1
         self._last_flush = time.monotonic()
         self._closed = False
+        self.rank = rank
+        # Absolute anchor: ts 0 of this trace is t0_wall_us on this
+        # process's wall clock.  trace_merge.py keys per-rank alignment
+        # off this event.
+        self._emit({"name": "trace_t0", "ph": "i", "s": "g", "pid": 0,
+                    "ts": 0, "args": {"rank": rank,
+                                      "t0_wall_us": t0_wall_us}})
 
     # ----------------------------------------------------------- primitives
 
@@ -57,7 +92,14 @@ class Timeline:
         with self._lock:
             if self._closed:
                 return
-            self._file.write(json.dumps(ev) + ",\n")
+            # Comma BEFORE each event after the first: a process killed
+            # mid-run leaves a file missing only the closing "]", which
+            # trace_merge.py repairs trivially, while close() produces
+            # strictly valid JSON (Perfetto's trace_processor rejects the
+            # old trailing-comma form).
+            self._file.write("\n" if self._first_event else ",\n")
+            self._first_event = False
+            self._file.write(json.dumps(ev))
             now = time.monotonic()
             if now - self._last_flush > self.FLUSH_EVERY_S:
                 self._file.flush()
@@ -125,6 +167,21 @@ class Timeline:
         self._emit({"ph": "X", "pid": 0, "ts": self._ts_us() - int(dur_us),
                     "dur": int(dur_us), "name": "CACHED_TICK"})
 
+    def tick_span(self, tick: int, dur_us: int) -> None:
+        """Complete-event span covering one negotiation tick, tagged with
+        the tick id in ``args`` — the cross-rank alignment anchor
+        ``trace_merge.py`` lines per-rank traces up by."""
+        dur_us = max(0, int(dur_us))
+        self._emit({"ph": "X", "pid": 0, "ts": self._ts_us() - dur_us,
+                    "dur": dur_us, "name": "TICK",
+                    "args": {"tick": int(tick)}})
+
+    def instant(self, name: str, args: dict = None) -> None:
+        """Global instant event on the control track (``clock_offset``
+        metadata, markers)."""
+        self._emit({"name": name, "ph": "i", "s": "g", "pid": 0,
+                    "ts": self._ts_us(), "args": args or {}})
+
     # ------------------------------------------------------------- counters
 
     def counter(self, name: str, value: int) -> None:
@@ -145,6 +202,6 @@ class Timeline:
     def close(self):
         with self._lock:
             if not self._closed:
-                self._file.write("{}]\n")
+                self._file.write("\n]\n")
                 self._file.close()
                 self._closed = True

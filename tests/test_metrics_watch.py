@@ -1,0 +1,79 @@
+"""tools/metrics_watch.py: torn-line tolerance and the gather-skew
+digest (PR: observability)."""
+
+import importlib.util
+import json
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "metrics_watch",
+    os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                 "metrics_watch.py"))
+metrics_watch = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(metrics_watch)
+
+
+def snap_line(rank, ts, counter):
+    return json.dumps({"rank": rank, "ts": ts,
+                       "counters": {"control.ticks": counter},
+                       "gauges": {}, "histograms": {}})
+
+
+class TestTornLines:
+    def test_partial_trailing_line_not_rendered_and_not_lost(
+            self, tmp_path, capsys):
+        # A snapshot caught mid-append must neither render as garbage nor
+        # be skipped once it completes.
+        path = tmp_path / "m.0.jsonl"
+        full = snap_line(0, 100, 7)
+        torn = snap_line(0, 101, 8)
+        path.write_text(full + "\n" + torn[:25])   # no trailing newline
+        rc = metrics_watch.follow([str(path)], once=True, name_filter="",
+                                  poll_s=0.01)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "control.ticks" in out and "7" in out
+        assert "101" not in out                    # torn snapshot held back
+        # The line completes; nothing was consumed past the boundary.
+        with open(path, "a") as f:
+            f.write(torn[25:] + "\n")
+        rc = metrics_watch.follow([str(path)], once=True, name_filter="",
+                                  poll_s=0.01)
+        assert rc == 0
+        assert "8" in capsys.readouterr().out
+
+    def test_corrupt_complete_line_skipped(self, tmp_path, capsys):
+        path = tmp_path / "m.0.jsonl"
+        path.write_text("{not json}\n" + snap_line(0, 100, 3) + "\n")
+        rc = metrics_watch.follow([str(path)], once=True, name_filter="",
+                                  poll_s=0.01)
+        assert rc == 0
+        assert "control.ticks" in capsys.readouterr().out
+
+
+class TestSkewDigest:
+    def _snap(self):
+        def hist(total, count):
+            return {"bounds": [0.001, 0.01, 0.1], "counts": [count, 0, 0, 0],
+                    "sum": total, "count": count}
+        return {"rank": 0, "ts": 100, "counters": {}, "gauges": {},
+                "histograms": {
+                    "control.gather_skew_seconds#rank=0": hist(0.004, 40),
+                    "control.gather_skew_seconds#rank=1": hist(0.360, 40)}}
+
+    def test_digest_names_slowest_rank(self):
+        lines = metrics_watch.render_skew_summary(self._snap(), "")
+        text = "\n".join(lines)
+        assert "gather arrival skew by rank" in text
+        assert "gather_skew[rank=0]" in text
+        assert "gather_skew[rank=1]" in text
+        assert "slowest rank" in text and " 1 " in text.split("slowest"
+                                                              " rank")[1]
+
+    def test_digest_absent_without_histograms(self):
+        snap = {"histograms": {"control.tick_seconds": {}}}
+        assert metrics_watch.render_skew_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(), None, "")
+        assert "gather arrival skew by rank" in out

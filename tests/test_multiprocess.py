@@ -306,7 +306,8 @@ TIMELINE_WORKER = textwrap.dedent("""
     pidx = hvd.process_index()
     hvd.shutdown()
     if pidx == 0:
-        events = json.loads(open(tl).read())
+        from horovod_tpu.timeline import per_rank_trace_path
+        events = json.loads(open(per_rank_trace_path(tl, 0, n)).read())
         by_pid = {}
         for e in events:
             if e.get("name") == "process_name":

@@ -10,7 +10,7 @@ import pytest
 
 from horovod_tpu import cpp_core
 from horovod_tpu.core import RequestType, ResponseType
-from horovod_tpu.timeline import Timeline, wire_activity
+from horovod_tpu.timeline import Timeline, per_rank_trace_path, wire_activity
 
 
 class _Entry:
@@ -33,7 +33,68 @@ class TestWireActivity:
         assert wire_activity("TCP_ALLREDUCE", "") == "TCP_ALLREDUCE"
 
 
+class TestPerRankTracePath:
+    def test_placeholder_substituted(self):
+        assert per_rank_trace_path("/tmp/t.{rank}.json", 3) == \
+            "/tmp/t.3.json"
+
+    def test_suffix_inserted_before_extension(self):
+        assert per_rank_trace_path("/tmp/t.json", 1, size=4) == \
+            "/tmp/t.rank1.json"
+
+    def test_single_rank_keeps_literal_path(self):
+        # Back-compat: 1-process jobs trace to exactly the configured file.
+        assert per_rank_trace_path("/tmp/t.json", 0, size=1) == "/tmp/t.json"
+
+    def test_idempotent_over_filled_path(self):
+        # run.py fills the template per child AND the controller resolves
+        # it again locally; the second pass must be a no-op.
+        once = per_rank_trace_path("/tmp/t.json", 2, size=4)
+        assert per_rank_trace_path(once, 2, size=4) == once
+
+
 class TestPythonTimeline:
+    def test_trace_t0_anchor_and_strict_json(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path), rank=2)
+        tl.counter("queue_depth", 1)
+        tl.close()
+        with open(path) as f:
+            text = f.read()
+        assert text.endswith("\n]\n")          # strictly valid, no {} pad
+        events = json.loads(text)
+        assert events[0]["name"] == "trace_t0"
+        assert events[0]["args"]["rank"] == 2
+        assert events[0]["ts"] == 0
+        assert events[0]["args"]["t0_wall_us"] > 0
+
+    def test_truncated_trace_is_repairable(self, tmp_path):
+        # A killed rank leaves a file missing only the closing "]"; the
+        # comma-before-event format keeps every complete line valid.
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path))
+        tl.counter("queue_depth", 1)
+        tl.counter("queue_depth", 2)
+        tl.flush()
+        with open(path) as f:
+            text = f.read()          # no close(): simulate SIGKILL
+        events = json.loads(text + "\n]")
+        assert [e for e in events if e.get("ph") == "C"]
+        tl.close()
+
+    def test_tick_span_and_instant(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path))
+        tl.tick_span(7, 1500)
+        tl.instant("clock_offset", {"rank": 1, "offset_us": 42.0})
+        tl.close()
+        events = load_trace(path)
+        ticks = [e for e in events if e.get("name") == "TICK"]
+        assert len(ticks) == 1
+        assert ticks[0]["ph"] == "X" and ticks[0]["pid"] == 0
+        assert ticks[0]["dur"] == 1500 and ticks[0]["args"]["tick"] == 7
+        offs = [e for e in events if e.get("name") == "clock_offset"]
+        assert offs and offs[0]["args"]["offset_us"] == 42.0
     def test_trace_parses_and_pid_metadata_once(self, tmp_path):
         path = tmp_path / "t.json"
         tl = Timeline(str(path))
@@ -106,3 +167,23 @@ class TestNativeTimeline:
         assert counters[0]["name"] == "queue_depth"
         assert counters[0]["args"]["value"] == 2
         assert counters[0]["pid"] == 0
+
+    def test_rank_anchor_tick_span_strict_json(self, tmp_path):
+        path = tmp_path / "native.json"
+        tl = cpp_core.CppTimeline(str(path), rank=1)
+        tl.tick_span(3, 250)
+        tl.instant("clock_offset", {"rank": 1, "offset_us": -7.5,
+                                    "uncertainty_us": 2.0})
+        tl.close()
+        with open(path) as f:
+            text = f.read()
+        assert text.endswith("\n]\n")
+        events = json.loads(text)
+        assert events[0]["name"] == "trace_t0"
+        assert events[0]["args"]["rank"] == 1
+        assert events[0]["args"]["t0_wall_us"] > 0
+        ticks = [e for e in events if e.get("name") == "TICK"]
+        assert ticks and ticks[0]["args"]["tick"] == 3
+        assert ticks[0]["dur"] == 250
+        offs = [e for e in events if e.get("name") == "clock_offset"]
+        assert offs and offs[0]["args"]["offset_us"] == -7.5

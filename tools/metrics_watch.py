@@ -115,6 +115,43 @@ def render_injit_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def render_skew_summary(snap: dict, name_filter: str) -> list[str]:
+    """Straggler digest from the coordinator's per-rank gather-skew
+    histograms (``control.gather_skew_seconds#rank=``): how late each
+    rank's request arrives at the negotiation barrier vs. the tick median.
+    The same signal ``tools/trace_merge.py`` reconstructs post-hoc from
+    per-rank traces."""
+    prefix = "control.gather_skew_seconds#rank="
+    hists = snap.get("histograms", {})
+    by_rank = {k[len(prefix):]: v for k, v in hists.items()
+               if k.startswith(prefix)}
+    if not by_rank:
+        return []
+    means = {}
+    lines = []
+    for rank in sorted(by_rank, key=lambda r: int(r) if r.isdigit() else 0):
+        name = f"gather_skew[rank={rank}]"
+        if name_filter and name_filter not in name:
+            continue
+        h = by_rank[rank]
+        count = h.get("count", 0)
+        mean = (h.get("sum", 0.0) / count) if count else 0.0
+        means[rank] = mean
+        text = f"n={count} mean={mean * 1e3:.3g}ms"
+        med = hist_median(h)
+        if med is not None:
+            text += f" p50={med * 1e3:.3g}ms"
+        lines.append(f"  {name:<52} {text}")
+    if lines:
+        lines.insert(0, "  -- gather arrival skew by rank --")
+        if len(means) > 1:
+            slowest = max(means, key=means.get)
+            if means[slowest] > 0:
+                lines.append(f"  {'slowest rank':<52} {slowest} "
+                             f"(mean {means[slowest] * 1e3:.3g}ms late)")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -161,6 +198,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
 
     lines.extend(render_algo_summary(snap, name_filter))
     lines.extend(render_injit_summary(snap, name_filter))
+    lines.extend(render_skew_summary(snap, name_filter))
     return "\n".join(lines)
 
 
@@ -173,21 +211,30 @@ def follow(paths, once: bool, name_filter: str, poll_s: float) -> int:
         printed = False
         for path in paths:
             try:
-                with open(path) as f:
+                # Binary mode: byte offsets stay exact under seek/tell.
+                with open(path, "rb") as f:
                     f.seek(offsets[path])
-                    chunk = f.read()
+                    raw = f.read()
                     offsets[path] = f.tell()
             except OSError:
                 continue
+            # A snapshot caught mid-append has no trailing newline yet.
+            # Rewind the offset to the start of that partial line so the
+            # next poll re-reads it whole — advancing past it here would
+            # silently drop the snapshot (the exporter never rewrites it).
+            cut = raw.rfind(b"\n") + 1
+            if cut < len(raw):
+                offsets[path] -= len(raw) - cut
+                raw = raw[:cut]
             fresh = []
-            for line in chunk.splitlines():
+            for line in raw.decode("utf-8", errors="replace").splitlines():
                 line = line.strip()
                 if not line:
                     continue
                 try:
                     fresh.append(json.loads(line))
                 except ValueError:
-                    continue   # torn line mid-write; picked up next poll
+                    continue   # corrupt complete line; nothing to recover
             if not fresh:
                 continue
             if once:

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+"""Merge per-rank horovod_tpu timeline traces onto one timebase.
+
+Every rank writes its own Chrome-tracing file when ``HOROVOD_TPU_TIMELINE``
+is set (see ``horovod_tpu/timeline.py``).  Each trace opens with a
+``trace_t0`` instant anchoring trace-ts 0 to that process's wall clock, and
+the coordinator's trace carries ``clock_offset`` instants — the NTP-style
+midpoint estimates it piggybacked on negotiation ticks (control.cc,
+``NoteClockSample``).  This tool:
+
+* loads each trace tolerantly (a killed rank leaves a file missing only the
+  trailing ``]``; repaired here),
+* maps every event onto the coordinator's wall clock:
+  ``merged_ts = ts + t0_wall[rank] - offset[rank] - t0_wall[coord]``,
+* remaps pids so ranks never collide (``rank*100000 + pid``) and labels
+  each track ``rank R: <name>``,
+* lines up the per-tick ``TICK`` spans across ranks to attribute
+  stragglers: which rank arrived latest at each negotiation barrier, and
+  how much wait it imposed on everyone else.
+
+Usage:
+    python tools/trace_merge.py /tmp/t.rank*.json -o merged.json
+    python tools/trace_merge.py /tmp/t.rank*.json --report-json report.json
+
+The merged file loads in Perfetto / chrome://tracing; the straggler report
+prints to stdout.  The numbers here should reconcile with the live
+``control.gather_skew_seconds#rank=*`` histograms in the metrics registry —
+the trace is the post-hoc view of the same signal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# pids get spread out per rank so tensors from different ranks never share
+# a track; per-rank pids are small integers (0 = control track, then one
+# per named tensor).
+PID_STRIDE = 100000
+
+
+# --------------------------------------------------------------- loading
+
+def load_trace(path: str) -> List[dict]:
+    """Load one per-rank trace, repairing the truncation a killed process
+    leaves behind.
+
+    The writers emit the separating comma BEFORE each event, so any
+    prefix of a trace is valid JSON once a ``]`` is appended — a rank
+    killed mid-run (the exact rank a straggler investigation cares about)
+    still merges.  A torn final line (killed mid-``write``) is dropped.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    repaired = text.rstrip()
+    if repaired.endswith(","):
+        repaired = repaired[:-1]
+    if not repaired.endswith("]"):
+        repaired += "\n]"
+    try:
+        return json.loads(repaired)
+    except json.JSONDecodeError:
+        # Torn final line: drop it and close the array.
+        cut = text.rfind(",\n")
+        if cut < 0:
+            raise
+        return json.loads(text[:cut] + "\n]")
+
+
+def trace_anchor(events: List[dict]) -> Tuple[Optional[int], Optional[int]]:
+    """(rank, t0_wall_us) from the trace_t0 anchor event, (None, None) if
+    the trace predates per-rank tracing."""
+    for ev in events:
+        if ev.get("name") == "trace_t0":
+            args = ev.get("args", {})
+            return args.get("rank"), args.get("t0_wall_us")
+    return None, None
+
+
+def clock_offsets(events: List[dict]) -> Dict[int, float]:
+    """Per-rank clock offsets (worker wall − coordinator wall, µs) from a
+    coordinator trace's ``clock_offset`` instants; the median over the
+    run's committed estimates per rank."""
+    samples: Dict[int, List[float]] = {}
+    for ev in events:
+        if ev.get("name") == "clock_offset":
+            args = ev.get("args", {})
+            r, off = args.get("rank"), args.get("offset_us")
+            if r is not None and off is not None:
+                samples.setdefault(int(r), []).append(float(off))
+    return {r: statistics.median(v) for r, v in samples.items()}
+
+
+# --------------------------------------------------------------- merging
+
+class RankTrace:
+    def __init__(self, path: str, events: List[dict],
+                 rank: Optional[int], t0_wall_us: Optional[int]):
+        self.path = path
+        self.events = events
+        self.rank = rank
+        self.t0_wall_us = t0_wall_us
+
+
+def _rank_from_filename(path: str) -> Optional[int]:
+    import re
+    m = re.search(r"rank(\d+)", path)
+    return int(m.group(1)) if m else None
+
+
+def read_traces(paths: List[str]) -> List[RankTrace]:
+    traces = []
+    for path in paths:
+        events = load_trace(path)
+        rank, t0 = trace_anchor(events)
+        if rank is None:
+            rank = _rank_from_filename(path)
+        if rank is None:
+            raise SystemExit(
+                f"trace_merge: cannot determine rank for {path} — no "
+                "trace_t0 event and no 'rank<N>' in the filename")
+        traces.append(RankTrace(path, events, rank, t0))
+    ranks = [t.rank for t in traces]
+    if len(set(ranks)) != len(ranks):
+        raise SystemExit(f"trace_merge: duplicate ranks in inputs: {ranks}")
+    return sorted(traces, key=lambda t: t.rank)
+
+
+def merge_traces(traces: List[RankTrace]) -> Tuple[List[dict], dict]:
+    """Merge onto the coordinator's timebase.
+
+    Returns (merged_events, info) where info records the per-rank shifts
+    applied (for tests and the report header).
+    """
+    # The coordinator is whichever trace carries clock_offset instants
+    # (it estimated everyone else's clock); fall back to the lowest rank.
+    coord = None
+    offsets: Dict[int, float] = {}
+    for t in traces:
+        offs = clock_offsets(t.events)
+        if offs:
+            coord = t
+            offsets = offs
+            break
+    if coord is None:
+        coord = traces[0]
+    coord_t0 = coord.t0_wall_us or 0
+
+    merged: List[dict] = []
+    shifts: Dict[int, float] = {}
+    have_wall = all(t.t0_wall_us is not None for t in traces)
+    for t in traces:
+        off = 0.0 if t.rank == coord.rank else offsets.get(t.rank, 0.0)
+        # merged_ts(ev) = ev.ts + shift.  Without wall anchors (legacy
+        # traces) fall back to raw per-rank ts — still viewable, just not
+        # aligned.
+        shift = ((t.t0_wall_us or 0) - off - coord_t0) if have_wall else 0.0
+        shifts[t.rank] = shift
+        base_pid = t.rank * PID_STRIDE
+        named_pids = set()
+        for ev in t.events:
+            ev = dict(ev)
+            pid = int(ev.get("pid", 0))
+            ev["pid"] = base_pid + pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    named_pids.add(pid)
+                    ev["args"] = {
+                        "name": f"rank {t.rank}: "
+                                f"{ev.get('args', {}).get('name', '')}"}
+            elif "ts" in ev:
+                ev["ts"] = ev["ts"] + shift
+            merged.append(ev)
+        if 0 not in named_pids:
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": base_pid,
+                           "args": {"name": f"rank {t.rank}: control"}})
+    merged.sort(key=lambda e: e.get("ts", 0))
+    info = {"coordinator_rank": coord.rank, "offsets_us": offsets,
+            "shifts_us": shifts, "aligned": have_wall}
+    return merged, info
+
+
+# ------------------------------------------------------------ stragglers
+
+def tick_table(traces: List[RankTrace],
+               shifts: Dict[int, float]) -> Dict[int, Dict[int, dict]]:
+    """tick id -> rank -> {"start": merged_us, "dur": us} from the TICK
+    spans every rank emits (control.cc Tick / timeline tick_span)."""
+    table: Dict[int, Dict[int, dict]] = {}
+    for t in traces:
+        shift = shifts.get(t.rank, 0.0)
+        for ev in t.events:
+            if ev.get("name") == "TICK" and ev.get("ph") == "X":
+                tick = ev.get("args", {}).get("tick")
+                if tick is None:
+                    continue
+                table.setdefault(int(tick), {})[t.rank] = {
+                    "start": float(ev["ts"]) + shift,
+                    "dur": float(ev.get("dur", 0))}
+    return table
+
+
+def straggler_report(traces: List[RankTrace], info: dict,
+                     top_k: int = 3) -> dict:
+    """Who made us slow: per-tick arrival skew at the negotiation barrier.
+
+    A rank's TICK span starts when its request is ready (worker: just
+    before sending; coordinator: gather start) — the same signal the live
+    ``control.gather_skew_seconds`` histograms observe.  The rank with the
+    latest corrected start on a tick is that tick's critical path: every
+    other rank's remaining wait is attributed to it.
+    """
+    ticks = tick_table(traces, info["shifts_us"])
+    per_rank: Dict[int, dict] = {
+        t.rank: {"ticks": 0, "late_sum_us": 0.0, "late_max_us": 0.0,
+                 "slowest_count": 0, "imposed_wait_us": 0.0}
+        for t in traces}
+    critical: List[dict] = []
+    for tick, by_rank in sorted(ticks.items()):
+        if len(by_rank) < 2:
+            continue
+        starts = {r: v["start"] for r, v in by_rank.items()}
+        med = statistics.median(starts.values())
+        slowest = max(starts, key=lambda r: starts[r])
+        imposed = sum(starts[slowest] - s for r, s in starts.items()
+                      if r != slowest)
+        for r, s in starts.items():
+            lateness = max(0.0, s - med)
+            pr = per_rank[r]
+            pr["ticks"] += 1
+            pr["late_sum_us"] += lateness
+            pr["late_max_us"] = max(pr["late_max_us"], lateness)
+        per_rank[slowest]["slowest_count"] += 1
+        per_rank[slowest]["imposed_wait_us"] += imposed
+        critical.append({"tick": tick, "slowest_rank": slowest,
+                         "skew_us": starts[slowest] - med,
+                         "imposed_wait_us": imposed})
+    for pr in per_rank.values():
+        pr["late_mean_us"] = (pr["late_sum_us"] / pr["ticks"]
+                              if pr["ticks"] else 0.0)
+        del pr["late_sum_us"]
+    critical.sort(key=lambda c: c["imposed_wait_us"], reverse=True)
+    ranking = sorted(per_rank,
+                     key=lambda r: per_rank[r]["imposed_wait_us"],
+                     reverse=True)
+    return {"coordinator_rank": info["coordinator_rank"],
+            "aligned": info["aligned"],
+            "offsets_us": info["offsets_us"],
+            "ticks_compared": len(critical),
+            "per_rank": per_rank,
+            "slowest_ranks": ranking[:top_k],
+            "worst_ticks": critical[:top_k]}
+
+
+def print_report(report: dict, file=None) -> None:
+    file = file or sys.stdout
+    p = lambda *a: print(*a, file=file)   # noqa: E731
+    p(f"# straggler report ({report['ticks_compared']} ticks compared, "
+      f"coordinator rank {report['coordinator_rank']}, "
+      f"{'offset-corrected' if report['aligned'] else 'UNALIGNED'})")
+    if report["offsets_us"]:
+        offs = ", ".join(f"rank {r}: {o:+.0f}us"
+                         for r, o in sorted(report["offsets_us"].items()))
+        p(f"  clock offsets vs coordinator: {offs}")
+    p("  rank  ticks  late_mean  late_max   slowest  imposed_wait")
+    for r in sorted(report["per_rank"]):
+        pr = report["per_rank"][r]
+        p(f"  {r:>4}  {pr['ticks']:>5}  {pr['late_mean_us']:>8.0f}us"
+          f"  {pr['late_max_us']:>7.0f}us  {pr['slowest_count']:>7}"
+          f"  {pr['imposed_wait_us']:>10.0f}us")
+    if report["slowest_ranks"]:
+        worst = report["slowest_ranks"][0]
+        pr = report["per_rank"][worst]
+        if pr["imposed_wait_us"] > 0:
+            p(f"  => rank {worst} is the dominant straggler: slowest on "
+              f"{pr['slowest_count']} tick(s), imposing "
+              f"{pr['imposed_wait_us'] / 1e3:.1f}ms of aggregate wait")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank horovod_tpu traces + straggler report")
+    ap.add_argument("traces", nargs="+", help="per-rank trace files")
+    ap.add_argument("-o", "--output", default="",
+                    help="write the merged Perfetto-loadable trace here")
+    ap.add_argument("--report-json", default="",
+                    help="also write the straggler report as JSON")
+    ap.add_argument("--top-k", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    traces = read_traces(args.traces)
+    merged, info = merge_traces(traces)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(merged, f)
+        print(f"trace_merge: wrote {len(merged)} events from "
+              f"{len(traces)} ranks to {args.output}", file=sys.stderr)
+    report = straggler_report(traces, info, top_k=args.top_k)
+    print_report(report)
+    if args.report_json:
+        with open(args.report_json, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
