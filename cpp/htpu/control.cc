@@ -473,10 +473,13 @@ void ControlPlane::MaybeInjectFault() {
 }
 
 void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
-  if (aborted_) return;   // first cause wins
-  aborted_ = true;
-  abort_rank_ = rank;
-  abort_reason_ = reason;
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    if (aborted_.load(std::memory_order_relaxed)) return;  // first cause wins
+    abort_rank_ = rank;
+    abort_reason_ = reason;
+    aborted_.store(true, std::memory_order_release);
+  }
   // Cached response sets and slot assignments are dead with the job —
   // a restarted control plane must renegotiate everything from scratch.
   CacheFlushAll();
@@ -490,8 +493,11 @@ void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
   FlightRecorder& fr = FlightRecorder::Get();
   fr.Record("abort", reason.c_str(), 0, rank);
   std::string dump = fr.Dump("abort");
-  if (!dump.empty() && abort_reason_.find(dump) == std::string::npos) {
-    abort_reason_ += " [flight recorder: " + dump + "]";
+  if (!dump.empty()) {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    if (abort_reason_.find(dump) == std::string::npos) {
+      abort_reason_ += " [flight recorder: " + dump + "]";
+    }
   }
 }
 
@@ -509,13 +515,17 @@ void ControlPlane::CacheFlushAll() {
 
 void ControlPlane::SerializeAbort(std::string* blob) const {
   ResponseList out;
-  out.abort_rank = abort_rank_;
-  out.abort_reason = abort_reason_;
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    out.abort_rank = abort_rank_;
+    out.abort_reason = abort_reason_;
+  }
   SerializeResponseList(out, blob);
 }
 
 bool ControlPlane::AbortedFailFast() {
-  if (!aborted_) return false;
+  if (!aborted()) return false;
+  std::lock_guard<std::mutex> lock(err_mu_);
   last_error_rank_ = abort_rank_;
   last_error_ = "job aborted: " + abort_reason_;
   return true;
@@ -536,14 +546,19 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
   int32_t rank = (peer >= 0 && size_t(peer) < all_first_ranks_.size())
                      ? all_first_ranks_[size_t(peer)]
                      : -1;
-  last_error_rank_ = rank >= 0 ? rank : first_rank_;
-  last_error_ =
+  int32_t err_rank = rank >= 0 ? rank : first_rank_;
+  std::string err =
       (failed >= 0
            ? "ring data-plane transfer failed: peer process of rank "
            : "ring data-plane transfer timed out waiting on rank ") +
-      std::to_string(last_error_rank_) +
+      std::to_string(err_rank) +
       (failed >= 0 ? " closed the connection or errored" : "");
-  FlightRecorder::Get().Record("xfer.fail", last_error_.c_str(),
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    last_error_rank_ = err_rank;
+    last_error_ = err;
+  }
+  FlightRecorder::Get().Record("xfer.fail", err.c_str(),
                                int64_t(send_len + recv_len), peer, errno);
   return false;
 }
@@ -1860,6 +1875,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
                                          char* data, int64_t nbytes,
                                          int wire) {
   if (!EnsureHierarchy()) {
+    std::lock_guard<std::mutex> lock(err_mu_);
     last_error_rank_ = first_rank_;
     last_error_ = "hierarchical allreduce: host-group topology setup failed";
     return false;
@@ -1929,6 +1945,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
 bool ControlPlane::SmallAllreduce(const std::string& dtype, char* data,
                                   int64_t nbytes, int wire) {
   if (!EnsureHierarchy()) {
+    std::lock_guard<std::mutex> lock(err_mu_);
     last_error_rank_ = first_rank_;
     last_error_ = "small allreduce: host-group topology setup failed";
     return false;

@@ -26,6 +26,7 @@
 #include <atomic>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
@@ -88,13 +89,16 @@ class ControlPlane {
   // True once a job-wide abort is latched (coordinator-broadcast ABORT,
   // lost coordinator link, or an injected fault).  After this, Tick
   // returns the latched abort response and the data plane fails fast.
-  bool aborted() const { return aborted_; }
+  bool aborted() const { return aborted_.load(std::memory_order_acquire); }
 
   // Attribution of the most recent failure on this process: the first
   // global rank of the offending process (ring-neighbour mapping of the
   // fd that died, or the latched abort's rank), or -1 when nothing has
-  // failed.  Read by the Python executor to build its abort report.
+  // failed.  Read by the Python executor to build its abort report —
+  // possibly from a different thread than the one that failed, hence
+  // err_mu_.
   void LastError(int32_t* rank, std::string* reason) const {
+    std::lock_guard<std::mutex> lock(err_mu_);
     *rank = last_error_rank_;
     *reason = last_error_;
   }
@@ -120,8 +124,8 @@ class ControlPlane {
   // on / taken off the wire).  Lets tests assert the ring's O(payload)
   // scaling — under the old star relay the coordinator moved ~P x payload.
   void DataBytes(long long* sent, long long* received) const {
-    *sent = data_bytes_sent_;
-    *received = data_bytes_recv_;
+    *sent = data_bytes_sent_.load(std::memory_order_relaxed);
+    *received = data_bytes_recv_.load(std::memory_order_relaxed);
   }
 
  private:
@@ -242,8 +246,12 @@ class ControlPlane {
   int fault_rank_ = -1;
   long long fault_tick_ = -1;
 
-  // Latched job-wide abort + last-failure attribution.
-  bool aborted_ = false;
+  // Latched job-wide abort + last-failure attribution.  The flag is
+  // atomic (polled off-thread by aborted()); the attribution strings
+  // ride err_mu_ because LastError()/SerializeAbort() may read them
+  // while the tick thread is still writing a newer failure.
+  std::atomic<bool> aborted_{false};
+  mutable std::mutex err_mu_;
   int32_t abort_rank_ = -1;
   std::string abort_reason_;
   int32_t last_error_rank_ = -1;
@@ -262,8 +270,10 @@ class ControlPlane {
   int ring_prev_fd_ = -1;   // from process (index-1+P) % P
   const char* ring_transport_ = "none";
   std::vector<int> all_first_ranks_;  // first global rank per process index
-  long long data_bytes_sent_ = 0;
-  long long data_bytes_recv_ = 0;
+  // Atomic so DataBytes() can be polled from any thread while the data
+  // plane is mid-collective; += keeps working on std::atomic.
+  std::atomic<long long> data_bytes_sent_{0};
+  std::atomic<long long> data_bytes_recv_{0};
 
   // Host topology persisted from the ring-setup address book (leader
   // election inputs for the hierarchical paths).

@@ -64,11 +64,52 @@ int64_t EnvCapacityEvents() {
 
 }  // namespace
 
+FlightRecorder::Ring* FlightRecorder::NewRing(uint64_t cap) {
+  Ring* r = new Ring;
+  r->cap = cap;
+  r->slots = new Slot[cap]();  // value-init: zeroed fields, NUL strings
+  return r;
+}
+
+void FlightRecorder::StoreSlot(Slot& s, const FlightEvent& ev) {
+  s.ts_us.store(ev.ts_us, std::memory_order_relaxed);
+  s.tick.store(ev.tick, std::memory_order_relaxed);
+  s.bytes.store(ev.bytes, std::memory_order_relaxed);
+  s.a.store(ev.a, std::memory_order_relaxed);
+  s.b.store(ev.b, std::memory_order_relaxed);
+  for (size_t i = 0; i < sizeof(ev.kind); ++i) {
+    s.kind[i].store(ev.kind[i], std::memory_order_relaxed);
+  }
+  for (size_t i = 0; i < sizeof(ev.detail); ++i) {
+    s.detail[i].store(ev.detail[i], std::memory_order_relaxed);
+  }
+}
+
+FlightEvent FlightRecorder::LoadSlot(const Slot& s) {
+  FlightEvent ev;
+  ev.ts_us = s.ts_us.load(std::memory_order_relaxed);
+  ev.tick = s.tick.load(std::memory_order_relaxed);
+  ev.bytes = s.bytes.load(std::memory_order_relaxed);
+  ev.a = s.a.load(std::memory_order_relaxed);
+  ev.b = s.b.load(std::memory_order_relaxed);
+  for (size_t i = 0; i < sizeof(ev.kind); ++i) {
+    ev.kind[i] = s.kind[i].load(std::memory_order_relaxed);
+  }
+  for (size_t i = 0; i < sizeof(ev.detail); ++i) {
+    ev.detail[i] = s.detail[i].load(std::memory_order_relaxed);
+  }
+  // CopySanitized never writes the last byte non-zero, so even a torn
+  // read stays terminated; belt-and-suspenders for hand-built events.
+  ev.kind[sizeof(ev.kind) - 1] = '\0';
+  ev.detail[sizeof(ev.detail) - 1] = '\0';
+  return ev;
+}
+
 FlightRecorder::FlightRecorder() {
   int64_t cap = EnvCapacityEvents();
   if (cap < kMinEvents) cap = kMinEvents;
   if (cap > kMaxEvents) cap = kMaxEvents;
-  ring_.resize(size_t(cap));
+  ring_.store(NewRing(uint64_t(cap)), std::memory_order_release);
   const char* d = getenv("HOROVOD_TPU_FLIGHT_RECORDER_DIR");
   if (!d || !*d) d = getenv("TMPDIR");
   if (!d || !*d) d = "/tmp";
@@ -83,19 +124,20 @@ FlightRecorder& FlightRecorder::Get() {
 void FlightRecorder::SetCapacityEvents(int64_t events) {
   if (events < kMinEvents) events = kMinEvents;
   if (events > kMaxEvents) events = kMaxEvents;
+  Ring* fresh = NewRing(uint64_t(events));
   std::lock_guard<std::mutex> lock(mu_);
-  ring_.assign(size_t(events), FlightEvent{});
-  seq_ = 0;
+  Ring* old = ring_.load(std::memory_order_relaxed);
+  fresh->next = old;  // retire, never free: a signal dump may hold it
+  ring_.store(fresh, std::memory_order_release);
+  seq_.store(0, std::memory_order_release);
 }
 
 int64_t FlightRecorder::capacity() const {
-  std::lock_guard<std::mutex> lock(mu_);
-  return int64_t(ring_.size());
+  return int64_t(ring_.load(std::memory_order_acquire)->cap);
 }
 
 void FlightRecorder::SetRank(int rank) {
-  std::lock_guard<std::mutex> lock(mu_);
-  rank_ = rank;
+  rank_.store(rank, std::memory_order_relaxed);
 }
 
 void FlightRecorder::Record(const char* kind, const char* detail,
@@ -109,29 +151,32 @@ void FlightRecorder::Record(const char* kind, const char* detail,
   CopySanitized(ev.kind, kind);
   CopySanitized(ev.detail, detail);
   std::lock_guard<std::mutex> lock(mu_);
-  ring_[size_t(seq_ % ring_.size())] = ev;
-  ++seq_;
+  Ring* r = ring_.load(std::memory_order_relaxed);
+  uint64_t seq = seq_.load(std::memory_order_relaxed);
+  StoreSlot(r->slots[size_t(seq % r->cap)], ev);
+  seq_.store(seq + 1, std::memory_order_release);
 }
 
 std::string FlightRecorder::SnapshotJson(const std::string& why) const {
   char buf[512];
   std::string out;
-  std::lock_guard<std::mutex> lock(mu_);
-  uint64_t cap = ring_.size();
-  uint64_t n = seq_ < cap ? seq_ : cap;
-  uint64_t first = seq_ - n;   // oldest retained event
+  const Ring* r = ring_.load(std::memory_order_acquire);
+  uint64_t cap = r->cap;
+  uint64_t seq = seq_.load(std::memory_order_acquire);
+  uint64_t n = seq < cap ? seq : cap;
+  uint64_t first = seq - n;   // oldest retained event
   snprintf(buf, sizeof(buf),
            "{\"rank\":%d,\"why\":\"%s\",\"dumped_at_us\":%lld,"
            "\"tick\":%llu,\"capacity\":%llu,\"recorded\":%llu,"
            "\"dropped\":%llu,\"events\":[",
-           rank_, why.c_str(), (long long)WallClockUs(),
+           rank(), why.c_str(), (long long)WallClockUs(),
            (unsigned long long)tick_.load(std::memory_order_relaxed),
-           (unsigned long long)cap, (unsigned long long)seq_,
+           (unsigned long long)cap, (unsigned long long)seq,
            (unsigned long long)first);
   out += buf;
   for (uint64_t i = 0; i < n; ++i) {
     if (i) out += ',';
-    FormatEvent(buf, sizeof(buf), ring_[size_t((first + i) % cap)]);
+    FormatEvent(buf, sizeof(buf), LoadSlot(r->slots[size_t((first + i) % cap)]));
     out += buf;
   }
   out += "]}\n";
@@ -139,8 +184,7 @@ std::string FlightRecorder::SnapshotJson(const std::string& why) const {
 }
 
 std::string FlightRecorder::DumpPath() const {
-  std::lock_guard<std::mutex> lock(mu_);
-  return dir_ + "/htpu_flight.rank" + std::to_string(rank_) + ".json";
+  return dir_ + "/htpu_flight.rank" + std::to_string(rank()) + ".json";
 }
 
 std::string FlightRecorder::Dump(const std::string& why) {
@@ -157,24 +201,27 @@ std::string FlightRecorder::Dump(const std::string& why) {
 void FlightRecorder::SignalDump(const char* why) {
   // No locking, no allocation: the handler may fire while the tick
   // thread holds mu_ (that is the whole point — the tick thread is
-  // presumed wedged).  Reading the ring racily is fine: every slot is
-  // POD with NUL-terminated strings, so the worst case is one event
-  // with mixed old/new fields, still valid JSON.
+  // presumed wedged).  Every shared read is an atomic load: the ring
+  // pointer (a retired ring is never freed), the sequence counter, and
+  // each slot field.  The worst case is one event with mixed old/new
+  // fields, still valid JSON because the strings stay NUL-terminated.
   char path[512];
   char buf[512];
+  int r0 = rank();
   snprintf(path, sizeof(path), "%s/htpu_flight.rank%d.json", dir_.c_str(),
-           rank_);
+           r0);
   int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (fd < 0) return;
-  uint64_t cap = ring_.size();
-  uint64_t seq = seq_;
+  const Ring* r = ring_.load(std::memory_order_acquire);
+  uint64_t cap = r->cap;
+  uint64_t seq = seq_.load(std::memory_order_acquire);
   uint64_t n = seq < cap ? seq : cap;
   uint64_t first = seq - n;
   int len = snprintf(buf, sizeof(buf),
                      "{\"rank\":%d,\"why\":\"%s\",\"dumped_at_us\":%lld,"
                      "\"tick\":%llu,\"capacity\":%llu,\"recorded\":%llu,"
                      "\"dropped\":%llu,\"events\":[",
-                     rank_, why ? why : "signal",
+                     r0, why ? why : "signal",
                      (long long)WallClockUs(),
                      (unsigned long long)tick_.load(
                          std::memory_order_relaxed),
@@ -183,7 +230,8 @@ void FlightRecorder::SignalDump(const char* why) {
   if (len > 0) (void)!write(fd, buf, size_t(len));
   for (uint64_t i = 0; i < n; ++i) {
     if (i) (void)!write(fd, ",", 1);
-    len = FormatEvent(buf, sizeof(buf), ring_[size_t((first + i) % cap)]);
+    FlightEvent ev = LoadSlot(r->slots[size_t((first + i) % cap)]);
+    len = FormatEvent(buf, sizeof(buf), ev);
     if (len > 0) (void)!write(fd, buf, size_t(len));
   }
   (void)!write(fd, "]}\n", 3);
@@ -199,9 +247,8 @@ void Sigusr2Handler(int) {
 }  // namespace
 
 void FlightRecorder::InstallSignalDump() {
-  static bool installed = false;
-  if (installed) return;
-  installed = true;
+  static std::atomic<bool> installed{false};
+  if (installed.exchange(true)) return;
   struct sigaction sa;
   memset(&sa, 0, sizeof(sa));
   sa.sa_handler = Sigusr2Handler;

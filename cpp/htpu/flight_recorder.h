@@ -5,7 +5,7 @@
 // The reference's timeline answers "what happened while things worked";
 // this answers "what were the last N ticks doing when they stopped".
 // Recording must therefore be cheap enough to leave on unconditionally
-// (one mutex'd POD copy per event, no allocation after Reserve) and the
+// (one POD copy into a preallocated atomic slot per event) and the
 // dump must work from the places jobs actually die: the latched-abort
 // path on the tick thread, and a signal handler poking a process whose
 // tick thread is wedged (HOROVOD_TPU_FAULT=hang leaves exactly that).
@@ -60,7 +60,7 @@ class FlightRecorder {
   int64_t capacity() const;
 
   void SetRank(int rank);
-  int rank() const { return rank_; }
+  int rank() const { return rank_.load(std::memory_order_relaxed); }
   // Current tick, stamped onto subsequent events.
   void SetTick(uint64_t tick) {
     tick_.store(tick, std::memory_order_relaxed);
@@ -92,14 +92,40 @@ class FlightRecorder {
   std::string DumpPath() const;
 
  private:
-  FlightRecorder();
+  // One ring slot: every field individually atomic so the lock-free
+  // readers (SignalDump, SnapshotJson) race Record() without undefined
+  // behavior.  Relaxed per-field access is enough — a torn event mixes
+  // old/new *fields*, and the char arrays stay NUL-terminated because
+  // the last byte is never written non-zero.
+  struct Slot {
+    std::atomic<int64_t> ts_us;
+    std::atomic<uint64_t> tick;
+    std::atomic<int64_t> bytes;
+    std::atomic<int32_t> a;
+    std::atomic<int32_t> b;
+    std::atomic<char> kind[16];
+    std::atomic<char> detail[96];
+  };
+  // Immutable once published: capacity changes swap in a whole new Ring
+  // and retire the old one (never freed — a signal handler may still be
+  // walking it; retired rings stay reachable through `next`).
+  struct Ring {
+    uint64_t cap = 0;
+    Slot* slots = nullptr;
+    Ring* next = nullptr;  // retired predecessor, kept for LSan/readers
+  };
 
-  mutable std::mutex mu_;
-  std::vector<FlightEvent> ring_;   // ring_[seq % capacity]
-  uint64_t seq_ = 0;                // total events ever recorded
+  FlightRecorder();
+  static Ring* NewRing(uint64_t cap);
+  static void StoreSlot(Slot& s, const FlightEvent& ev);
+  static FlightEvent LoadSlot(const Slot& s);
+
+  mutable std::mutex mu_;           // serializes writers only
+  std::atomic<Ring*> ring_{nullptr};
+  std::atomic<uint64_t> seq_{0};    // total events ever recorded
   std::atomic<uint64_t> tick_{0};
-  int rank_ = 0;
-  std::string dir_;
+  std::atomic<int> rank_{0};
+  std::string dir_;                 // set once in the ctor, then read-only
 };
 
 }  // namespace htpu

@@ -16,11 +16,14 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cmath>
+#include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "htpu/control.h"
@@ -224,6 +227,41 @@ int RunProcess(int pidx, int port) {
     }
   }
 
+  // Concurrent observer: a watchdog thread polls the plane's cross-
+  // thread accessors (exactly what the Python executor and its watchdog
+  // do from their own threads) while this thread keeps ticking and
+  // reducing.  Under TSan this verifies the accessor contracts —
+  // aborted()/DataBytes()/LastError() must be safe against a live tick
+  // thread — instead of trusting the header comments.
+  {
+    std::atomic<bool> stop{false};
+    long long observed = 0;
+    std::thread watcher([&] {
+      while (!stop.load(std::memory_order_acquire)) {
+        long long s = 0, r = 0;
+        cp->DataBytes(&s, &r);
+        int32_t lrank = -1;
+        std::string lreason;
+        cp->LastError(&lrank, &lreason);
+        if (cp->aborted()) break;
+        observed = s + r;
+      }
+    });
+    bool ok = true;
+    for (int i = 0; ok && i < 50; ++i) {
+      ok = cp->Tick(tick_blob, 0, &resp);
+      if (ok) {
+        std::vector<float> buf(256, float(pidx + 1));
+        ok = cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                              int64_t(buf.size() * sizeof(float)), "");
+      }
+    }
+    stop.store(true, std::memory_order_release);
+    watcher.join();
+    if (!ok) return Fail(pidx, "tick/allreduce under concurrent observer");
+    if (observed <= 0) return Fail(pidx, "observer saw no data-plane bytes");
+  }
+
   // Flight recorder: shrink the ring far below what the run above has
   // recorded, force a wrap with more events than capacity, and check the
   // snapshot is balanced JSON that owns up to the eviction.  Runs in
@@ -262,6 +300,36 @@ int RunProcess(int pidx, int port) {
     std::string dump = fr.Dump("smoke");
     if (dump.empty() || access(dump.c_str(), R_OK) != 0) {
       return Fail(pidx, "flight dump not written");
+    }
+  }
+
+  // Flight recorder under fire: one thread hammers Record() while this
+  // thread fires the SIGUSR2 handler (the launcher's poke-a-hung-rank
+  // path), calls the lock-free dump directly, and swaps the ring
+  // capacity under both.  The atomic-slot ring has to keep the dump
+  // race-free (TSan) and the retired rings alive (ASan: the old
+  // SetCapacityEvents would free the buffer a dump was still walking).
+  {
+    htpu::FlightRecorder::InstallSignalDump();
+    auto& fr = htpu::FlightRecorder::Get();
+    std::atomic<bool> stop{false};
+    std::thread hammer([&] {
+      int i = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        fr.Record("smoke.race", "concurrent record", i, i, pidx);
+        ++i;
+      }
+    });
+    for (int i = 0; i < 20; ++i) {
+      raise(SIGUSR2);
+      fr.SignalDump("smoke.direct");
+      fr.SetCapacityEvents(8 + (i % 2) * 56);
+    }
+    stop.store(true, std::memory_order_release);
+    hammer.join();
+    std::string dump = fr.Dump("smoke.signal");
+    if (dump.empty() || access(dump.c_str(), R_OK) != 0) {
+      return Fail(pidx, "signal-phase dump not written");
     }
   }
 

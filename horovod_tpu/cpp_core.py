@@ -34,9 +34,11 @@ _lib_lock = threading.Lock()
 
 def _configure(lib) -> None:
     lib.htpu_version.restype = ctypes.c_char_p
+    lib.htpu_free.restype = None
     lib.htpu_free.argtypes = [ctypes.c_void_p]
     lib.htpu_table_create.restype = ctypes.c_void_p
     lib.htpu_table_create.argtypes = [ctypes.c_int]
+    lib.htpu_table_destroy.restype = None
     lib.htpu_table_destroy.argtypes = [ctypes.c_void_p]
     lib.htpu_table_increment.restype = ctypes.c_int
     lib.htpu_table_increment.argtypes = [
@@ -46,6 +48,7 @@ def _configure(lib) -> None:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_table_num_pending.restype = ctypes.c_int
     lib.htpu_table_num_pending.argtypes = [ctypes.c_void_p]
+    lib.htpu_table_clear.restype = None
     lib.htpu_table_clear.argtypes = [ctypes.c_void_p]
     lib.htpu_table_stalled.restype = ctypes.c_int
     lib.htpu_table_stalled.argtypes = [
@@ -61,6 +64,7 @@ def _configure(lib) -> None:
         ctypes.POINTER(ctypes.c_void_p)]
     lib.htpu_timeline_create.restype = ctypes.c_void_p
     lib.htpu_timeline_create.argtypes = [ctypes.c_char_p]
+    lib.htpu_timeline_destroy.restype = None
     lib.htpu_timeline_destroy.argtypes = [ctypes.c_void_p]
     # Newer symbols are guarded so a prebuilt library from an older round
     # still loads (the hasattr idiom used for htpu_wire_encode below).
@@ -78,12 +82,16 @@ def _configure(lib) -> None:
             ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_longlong]
     for fn in ("negotiate_start", "start"):
         f = getattr(lib, f"htpu_timeline_{fn}")
+        f.restype = None
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.htpu_timeline_negotiate_rank_ready.restype = None
     lib.htpu_timeline_negotiate_rank_ready.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     for fn in ("negotiate_end", "end", "activity_end"):
         f = getattr(lib, f"htpu_timeline_{fn}")
+        f.restype = None
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.htpu_timeline_activity_start.restype = None
     lib.htpu_timeline_activity_start.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.htpu_timeline_counter.restype = None
@@ -94,11 +102,13 @@ def _configure(lib) -> None:
         ctypes.c_void_p, ctypes.c_longlong]
     lib.htpu_timeline_flush.restype = None
     lib.htpu_timeline_flush.argtypes = [ctypes.c_void_p]
+    lib.htpu_timeline_close.restype = None
     lib.htpu_timeline_close.argtypes = [ctypes.c_void_p]
     lib.htpu_control_create.restype = ctypes.c_void_p
     lib.htpu_control_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.htpu_control_destroy.restype = None
     lib.htpu_control_destroy.argtypes = [ctypes.c_void_p]
     lib.htpu_control_tick.restype = ctypes.c_int
     lib.htpu_control_tick.argtypes = [

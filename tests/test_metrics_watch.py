@@ -77,3 +77,23 @@ class TestSkewDigest:
     def test_digest_in_full_render(self):
         out = metrics_watch.render(self._snap(), None, "")
         assert "gather arrival skew by rank" in out
+
+
+class TestBadInputs:
+    """Missing/empty inputs produce a one-line error, not a traceback or
+    silence (PR: static analysis)."""
+
+    def test_missing_file_one_line_error(self, tmp_path, capsys):
+        rc = metrics_watch.main([str(tmp_path / "nope.jsonl"), "--once"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "no such file" in err and "nope.jsonl" in err
+        assert "Traceback" not in err
+
+    def test_empty_file_once_explains(self, tmp_path, capsys):
+        p = tmp_path / "m.0.jsonl"
+        p.write_text("")
+        rc = metrics_watch.main([str(p), "--once"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "no complete snapshots" in err

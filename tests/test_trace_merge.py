@@ -272,3 +272,24 @@ def test_cli_end_to_end(trace_files, tmp_path, capsys):
     with open(report_path) as f:
         report = json.load(f)
     assert report["slowest_ranks"][0] == STRAGGLER_RANK
+
+
+class TestBadInputs:
+    """Missing/empty/garbage inputs die with a one-line SystemExit, not a
+    traceback (PR: static analysis)."""
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(SystemExit, match="cannot read"):
+            trace_merge.load_trace(str(tmp_path / "nope.rank0.json"))
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "t.rank0.json"
+        p.write_text("")
+        with pytest.raises(SystemExit, match="is empty"):
+            trace_merge.load_trace(str(p))
+
+    def test_unrepairable_garbage(self, tmp_path):
+        p = tmp_path / "t.rank0.json"
+        p.write_text("this was never a trace")
+        with pytest.raises(SystemExit, match="not a Chrome-tracing"):
+            trace_merge.load_trace(str(p))

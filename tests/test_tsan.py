@@ -1,0 +1,42 @@
+"""ThreadSanitizer smoke for the native control plane (PR: static
+analysis).
+
+Builds the multi-process smoke runner under -fsanitize=thread and runs
+it.  Beyond the collective/abort pass the ASan smoke covers, the binary
+has two explicitly concurrent phases: a watchdog thread polling
+aborted()/DataBytes()/LastError() against a live tick loop, and the
+flight recorder hammered by a writer thread while SIGUSR2 dumps and
+capacity swaps fire.  Any data race is a hard failure.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+CPP_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "cpp")
+
+
+@pytest.mark.slow
+def test_tsan_native_smoke():
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain available")
+    probe = subprocess.run(
+        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input="int main(){return 0;}", text=True, capture_output=True)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks the tsan runtime")
+    build = subprocess.run(["make", "-C", CPP_DIR, "tsan"],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    # First report kills the run: a race is a failure, not a warning.
+    env["TSAN_OPTIONS"] = "halt_on_error=1"
+    run = subprocess.run([os.path.join(CPP_DIR, "htpu_smoke_tsan")],
+                         capture_output=True, text=True, timeout=240,
+                         env=env)
+    assert run.returncode == 0, run.stderr + run.stdout
+    assert "smoke: OK" in run.stderr, run.stderr
+    assert "WARNING: ThreadSanitizer" not in run.stderr, run.stderr

@@ -1,0 +1,83 @@
+"""Repo-native static analysis for the cross-language contracts.
+
+The native control plane and the Python layer agree on four hand-written
+contracts that nothing used to check mechanically:
+
+* ``HOROVOD_TPU_*`` knobs parsed independently by ``getenv`` in C++ and
+  ``os.environ`` in Python, documented in docs/running.md.
+* The 52-symbol ``extern "C"`` surface of cpp/htpu/c_api.cc mirrored by
+  hand-written ctypes signatures in horovod_tpu/cpp_core.py.
+* Metric names emitted on both sides and re-typed in tools, docs, and
+  bench readers.
+* The async-signal-safety of the SIGUSR2 flight-recorder dump path.
+
+Each checker lives in its own module and returns a list of
+:class:`Finding`.  ``python -m tools.analyze`` runs them all and exits
+non-zero on any finding; tests/test_static_analysis.py runs them as
+tier-1 tests plus planted-defect fixtures.  See docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Optional
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation: which checker, what, and where."""
+
+    checker: str            # "knobs" | "contract" | "metrics" | "signal"
+    message: str
+    file: str = ""          # repo-relative path when known
+    line: int = 0           # 1-based when known
+
+    def __str__(self) -> str:
+        loc = f"{self.file}:{self.line}: " if self.file else ""
+        return f"[{self.checker}] {loc}{self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def read_text(path: pathlib.Path) -> Optional[str]:
+    """File contents, or None when absent (fixture trees are partial)."""
+    try:
+        return path.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return None
+
+
+def strip_c_comments(text: str) -> str:
+    """Remove // and /* */ comments, preserving line numbers and string
+    literals (good enough for the declaration grammar we parse; the C++
+    sources never put '//' inside a string)."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append(text[i:min(j + 1, n)])
+            i = j + 1
+        elif text.startswith("//", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            end = n if j < 0 else j + 2
+            out.append("\n" * text.count("\n", i, end))
+            i = end
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1

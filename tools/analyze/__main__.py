@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.analyze [--json] [--root DIR] [--no-native]``.
+
+Runs all four contract checkers and exits non-zero when any finding
+survives.  ``--json`` prints a machine-readable report; ``--no-native``
+skips building/loading the native library (static checks only — used by
+the fixture tests and toolchain-less environments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import REPO_ROOT, Finding
+from . import contract, knobs, metric_names, signal_safety
+
+
+def run_all(root: pathlib.Path, native: bool = True):
+    findings = []
+    stats = {}
+    for name, fn in (
+            ("knobs", lambda: knobs.check(root)),
+            ("contract", lambda: contract.check(root, native=native)),
+            ("metrics", lambda: metric_names.check(root)),
+            ("signal", lambda: signal_safety.check(root))):
+        try:
+            f, s = fn()
+        except Exception as e:  # a checker crash is itself a finding
+            f, s = [Finding(name, f"checker crashed: {e!r}")], {}
+        findings += f
+        stats.update(s)
+    return findings, stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="Cross-language contract checks (knobs, C API/"
+                    "ctypes, metric names, signal safety). "
+                    "See docs/static-analysis.md.")
+    p.add_argument("--root", type=pathlib.Path, default=REPO_ROOT,
+                   help="tree to analyze (default: this repo)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--no-native", action="store_true",
+                   help="skip the dynamic (built-library) contract check")
+    args = p.parse_args(argv)
+
+    findings, stats = run_all(args.root.resolve(),
+                              native=not args.no_native)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "stats": stats,
+            "ok": not findings,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(str(f))
+        counts = {k: v for k, v in sorted(stats.items())
+                  if isinstance(v, int)}
+        summary = ", ".join(f"{k}={v}" for k, v in counts.items())
+        print(f"{'FAIL' if findings else 'OK'}: "
+              f"{len(findings)} finding(s); {summary}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

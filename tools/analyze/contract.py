@@ -1,0 +1,372 @@
+"""C API / ctypes contract checker: c_api.cc vs htpu.lds vs cpp_core.py.
+
+The native surface is the set of ``HTPU_API`` ``extern "C"`` functions in
+cpp/htpu/c_api.cc, exported through the ``htpu_*`` glob in cpp/htpu/
+htpu.lds and bound by hand-written ctypes signatures in
+horovod_tpu/cpp_core.py.  Static checks (always run, fixture-friendly):
+
+* every native symbol matches the ``htpu_`` export glob and the version
+  script keeps the ``global: htpu_*; local: *;`` shape;
+* every native symbol is referenced by cpp_core.py (a binding or a
+  stale-``.so`` hasattr/getattr guard) and every ``htpu_*`` symbol
+  cpp_core.py references exists natively;
+* every literal ``lib.X.argtypes = [...]`` / ``lib.X.restype = ...``
+  assignment matches the native declaration's arity and type widths.
+
+The dynamic check additionally loads the built library through
+cpp_core.load() and verifies exports plus the configured
+argtypes/restype of every symbol — this covers the loop-configured
+bindings the static parser skips.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, line_of, read_text, strip_c_comments
+
+# ---------------------------------------------------------------------------
+# Type-width compatibility.  Both the static parser and the dynamic
+# introspection normalise to ctypes-style names ("c_int", "LP_c_void_p",
+# "none") and compare against the class each C type allows.
+# ---------------------------------------------------------------------------
+
+_C_TYPE_CLASSES = {
+    # data pointers: ctypes passes bytes as c_char_p and opaque buffers
+    # as c_void_p interchangeably at the ABI level
+    "void*": {"c_void_p", "c_char_p"},
+    "char*": {"c_void_p", "c_char_p"},
+    "uint8_t*": {"c_void_p", "c_char_p", "LP_c_ubyte"},
+    "void**": {"LP_c_void_p"},
+    "char**": {"LP_c_char_p"},
+    "int": {"c_int", "c_int32"},
+    "int32_t": {"c_int", "c_int32"},
+    "long": {"c_long"},
+    "long long": {"c_longlong", "c_int64"},
+    "int64_t": {"c_longlong", "c_int64"},
+    "unsigned long long": {"c_ulonglong", "c_uint64"},
+    "uint64_t": {"c_ulonglong", "c_uint64"},
+    "size_t": {"c_size_t"},
+    "double": {"c_double"},
+    "float": {"c_float"},
+    "int*": {"LP_c_int", "LP_c_int32"},
+    "int32_t*": {"LP_c_int", "LP_c_int32"},
+    "long long*": {"LP_c_longlong", "LP_c_int64"},
+    "int64_t*": {"LP_c_longlong", "LP_c_int64"},
+    "uint64_t*": {"LP_c_ulonglong", "LP_c_uint64"},
+    "double*": {"LP_c_double"},
+    "float*": {"LP_c_float"},
+}
+
+
+def normalize_c_type(t: str) -> str:
+    t = t.replace("const", " ").strip()
+    t = re.sub(r"\s+", " ", t)
+    t = t.replace(" *", "*").replace("* ", "*")
+    return t
+
+
+def allowed_ctypes(c_type: str) -> set:
+    return _C_TYPE_CLASSES.get(normalize_c_type(c_type), set())
+
+
+def normalize_ctypes_token(tok: str) -> str:
+    """'ctypes.POINTER(ctypes.c_void_p)' -> 'LP_c_void_p' etc."""
+    tok = tok.strip().replace("ctypes.", "")
+    m = re.fullmatch(r"POINTER\(\s*(\w+)\s*\)", tok)
+    if m:
+        return "LP_" + m.group(1)
+    return tok or "none"
+
+
+def normalize_ctypes_obj(obj) -> str:
+    if obj is None:
+        return "none"
+    return getattr(obj, "__name__", str(obj))
+
+
+def allowed_ctypes_objs(c_type: str) -> set:
+    """The allowed class resolved to live ctypes types.  Name comparison
+    is wrong on LP64 where ctypes.c_int64 IS ctypes.c_long; live-type
+    identity absorbs the platform aliasing."""
+    import ctypes
+    out = set()
+    for name in allowed_ctypes(c_type):
+        try:
+            if name.startswith("LP_"):
+                out.add(ctypes.POINTER(getattr(ctypes, name[3:])))
+            else:
+                out.add(getattr(ctypes, name))
+        except AttributeError:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# c_api.cc and htpu.lds parsing
+# ---------------------------------------------------------------------------
+
+_DECL_RE = re.compile(
+    r"HTPU_API\s+(?P<ret>[\w ]+?[\w*])\s+(?P<name>\w+)\s*"
+    r"\((?P<params>[^)]*)\)", re.S)
+
+
+def parse_c_api(root: pathlib.Path) -> Tuple[Dict[str, dict], List[Finding]]:
+    """symbol -> {ret, params:[c types], line} from c_api.cc."""
+    findings: List[Finding] = []
+    path = root / "cpp" / "htpu" / "c_api.cc"
+    text = read_text(path)
+    if text is None:
+        return {}, [Finding("contract", "cpp/htpu/c_api.cc is missing")]
+    stripped = strip_c_comments(text)
+    decls: Dict[str, dict] = {}
+    for m in _DECL_RE.finditer(stripped):
+        name = m.group("name")
+        params_raw = m.group("params").strip()
+        params: List[str] = []
+        if params_raw and params_raw != "void":
+            for p in params_raw.split(","):
+                p = p.strip()
+                # Drop the trailing parameter name (keep '*'s).
+                p = re.sub(r"\b\w+$", "", p).strip()
+                params.append(normalize_c_type(p))
+        decls[name] = {
+            "ret": normalize_c_type(m.group("ret")),
+            "params": params,
+            "line": line_of(stripped, m.start()),
+        }
+        if not name.startswith("htpu_"):
+            findings.append(Finding(
+                "contract", f"{name} lacks the htpu_ prefix and is "
+                "hidden by the htpu.lds export glob",
+                "cpp/htpu/c_api.cc", decls[name]["line"]))
+    return decls, findings
+
+
+def check_lds(root: pathlib.Path) -> List[Finding]:
+    text = read_text(root / "cpp" / "htpu.lds")
+    if text is None:
+        return [Finding("contract", "cpp/htpu.lds is missing")]
+    findings = []
+    if not re.search(r"global:\s*htpu_\*\s*;", text):
+        findings.append(Finding(
+            "contract", "htpu.lds does not export the htpu_* glob",
+            "cpp/htpu.lds", 1))
+    if not re.search(r"local:\s*\*\s*;", text):
+        findings.append(Finding(
+            "contract", "htpu.lds does not hide non-htpu_ symbols "
+            "(local: *;)", "cpp/htpu.lds", 1))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# cpp_core.py static parsing
+# ---------------------------------------------------------------------------
+
+def _referenced_symbols(text: str) -> set:
+    # Plain references; an f-string template's literal prefix
+    # ("htpu_timeline_{fn}") is not itself a symbol.
+    refs = {m.group(1) for m in re.finditer(r"\b(htpu_\w+)\b", text)
+            if not text.startswith("{", m.end())}
+    # f-string bindings: getattr(lib, f"htpu_timeline_{fn}") inside a
+    # "for fn in (...)" loop — expand the loop tuple.
+    for m in re.finditer(r'f"(htpu_\w*\{(\w+)\}\w*)"', text):
+        template, var = m.group(1), m.group(2)
+        loop = None
+        for loop in re.finditer(
+                r"for\s+" + re.escape(var) + r"\s+in\s*\(([^)]*)\)",
+                text[:m.start()]):
+            pass
+        if loop:
+            for name in re.findall(r'"(\w+)"', loop.group(1)):
+                refs.add(template.replace("{" + var + "}", name))
+    return {r for r in refs if "{" not in r}
+
+
+_ARGTYPES_RE = re.compile(
+    r"lib\.(htpu_\w+)\.argtypes\s*=\s*\[(.*?)\]", re.S)
+_RESTYPE_RE = re.compile(r"lib\.(htpu_\w+)\.restype\s*=\s*([\w.()]+)")
+
+
+def static_bindings(text: str) -> Dict[str, dict]:
+    """Literal lib.X.argtypes/restype assignments (loop-configured
+    bindings are only visible to the dynamic check)."""
+    out: Dict[str, dict] = {}
+    for m in _ARGTYPES_RE.finditer(text):
+        toks = [normalize_ctypes_token(t)
+                for t in m.group(2).split(",") if t.strip()]
+        out.setdefault(m.group(1), {})["argtypes"] = toks
+        out[m.group(1)]["line"] = line_of(text, m.start())
+    for m in _RESTYPE_RE.finditer(text):
+        out.setdefault(m.group(1), {})["restype"] = \
+            normalize_ctypes_token(m.group(2))
+        out[m.group(1)].setdefault("line", line_of(text, m.start()))
+    return out
+
+
+def _check_signature(sym: str, decl: dict, argtypes: Optional[List[str]],
+                     restype: Optional[str], where: str,
+                     line: int) -> List[Finding]:
+    findings: List[Finding] = []
+    if argtypes is not None:
+        if len(argtypes) != len(decl["params"]):
+            findings.append(Finding(
+                "contract",
+                f"{sym}: ctypes argtypes arity {len(argtypes)} != native "
+                f"arity {len(decl['params'])}", where, line))
+        else:
+            for i, (tok, c_type) in enumerate(zip(argtypes, decl["params"])):
+                allowed = allowed_ctypes(c_type)
+                if allowed and tok not in allowed:
+                    findings.append(Finding(
+                        "contract",
+                        f"{sym}: argument {i} is {tok} but the native "
+                        f"parameter is '{c_type}' (expected one of "
+                        f"{sorted(allowed)})", where, line))
+    if restype is not None:
+        ret = decl["ret"]
+        if ret == "void":
+            if restype not in ("none", "None"):
+                findings.append(Finding(
+                    "contract",
+                    f"{sym}: restype {restype} but the native function "
+                    "returns void (use restype = None)", where, line))
+        else:
+            allowed = allowed_ctypes(ret)
+            if allowed and restype not in allowed:
+                findings.append(Finding(
+                    "contract",
+                    f"{sym}: restype {restype} incompatible with native "
+                    f"return type '{ret}'", where, line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def check_static(root: pathlib.Path) -> Tuple[List[Finding], dict]:
+    decls, findings = parse_c_api(root)
+    findings += check_lds(root)
+    cpp_core_text = read_text(root / "horovod_tpu" / "cpp_core.py")
+    if cpp_core_text is None:
+        findings.append(Finding(
+            "contract", "horovod_tpu/cpp_core.py is missing"))
+        return findings, {"symbols_total": len(decls)}
+
+    refs = _referenced_symbols(cpp_core_text)
+    for sym in sorted(set(decls) - refs):
+        findings.append(Finding(
+            "contract", f"{sym} is exported natively but cpp_core.py has "
+            "no ctypes binding or stale-.so guard for it",
+            "cpp/htpu/c_api.cc", decls[sym]["line"]))
+    for sym in sorted(refs - set(decls)):
+        findings.append(Finding(
+            "contract", f"{sym} is referenced by cpp_core.py but does "
+            "not exist in c_api.cc (stale binding)",
+            "horovod_tpu/cpp_core.py"))
+
+    bindings = static_bindings(cpp_core_text)
+    for sym, b in sorted(bindings.items()):
+        if sym not in decls:
+            continue  # already reported as stale above
+        findings += _check_signature(
+            sym, decls[sym], b.get("argtypes"), b.get("restype"),
+            "horovod_tpu/cpp_core.py", b.get("line", 0))
+
+    stats = {
+        "symbols_total": len(decls),
+        "symbols_bound_statically": len(bindings),
+        "symbols": sorted(decls),
+    }
+    return findings, stats
+
+
+def check_dynamic(root: pathlib.Path) -> Tuple[List[Finding], dict]:
+    """Load the built library via cpp_core and verify every export plus
+    the configured argtypes/restype of every declared symbol."""
+    decls, _ = parse_c_api(root)
+    findings: List[Finding] = []
+    try:
+        import sys
+        if str(root) not in sys.path:
+            sys.path.insert(0, str(root))
+        from horovod_tpu import cpp_core
+        lib = cpp_core.load()
+    except Exception as e:  # toolchain missing, build failure, ...
+        return [Finding(
+            "contract", f"native library unavailable for dynamic "
+            f"contract check: {e}")], {"symbols_dynamic": 0}
+    if lib is None:
+        return [Finding(
+            "contract", "cpp_core.load() returned None; cannot run the "
+            "dynamic contract check")], {"symbols_dynamic": 0}
+
+    checked = 0
+    for sym, decl in sorted(decls.items()):
+        fn = getattr(lib, sym, None)
+        if fn is None:
+            findings.append(Finding(
+                "contract", f"{sym} is declared in c_api.cc but the "
+                "built library does not export it",
+                "cpp/htpu/c_api.cc", decl["line"]))
+            continue
+        checked += 1
+        argtypes = fn.argtypes
+        if argtypes is None and decl["params"]:
+            findings.append(Finding(
+                "contract", f"{sym}: binding never declares argtypes "
+                f"({len(decl['params'])} native parameters unchecked)",
+                "horovod_tpu/cpp_core.py"))
+            continue
+        argtypes = list(argtypes or [])
+        if len(argtypes) != len(decl["params"]):
+            findings.append(Finding(
+                "contract",
+                f"{sym}: ctypes argtypes arity {len(argtypes)} != "
+                f"native arity {len(decl['params'])}",
+                "horovod_tpu/cpp_core.py"))
+        else:
+            for i, (obj, c_type) in enumerate(zip(argtypes,
+                                                  decl["params"])):
+                allowed = allowed_ctypes_objs(c_type)
+                if allowed and obj not in allowed:
+                    findings.append(Finding(
+                        "contract",
+                        f"{sym}: argument {i} is "
+                        f"{normalize_ctypes_obj(obj)} but the native "
+                        f"parameter is '{c_type}'",
+                        "horovod_tpu/cpp_core.py"))
+        ret = decl["ret"]
+        restype = fn.restype
+        if ret == "void":
+            if restype is not None:
+                findings.append(Finding(
+                    "contract",
+                    f"{sym}: restype {normalize_ctypes_obj(restype)} "
+                    "but the native function returns void (use "
+                    "restype = None)", "horovod_tpu/cpp_core.py"))
+        else:
+            allowed = allowed_ctypes_objs(ret)
+            if ret == "int":
+                import ctypes
+                allowed.add(ctypes.c_int)  # the ctypes default
+            if allowed and restype not in allowed:
+                findings.append(Finding(
+                    "contract",
+                    f"{sym}: restype {normalize_ctypes_obj(restype)} "
+                    f"incompatible with native return type '{ret}'",
+                    "horovod_tpu/cpp_core.py"))
+    return findings, {"symbols_dynamic": checked}
+
+
+def check(root: pathlib.Path, native: bool = True) \
+        -> Tuple[List[Finding], dict]:
+    findings, stats = check_static(root)
+    if native:
+        dyn_findings, dyn_stats = check_dynamic(root)
+        findings += dyn_findings
+        stats.update(dyn_stats)
+    return findings, stats

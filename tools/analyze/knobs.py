@@ -1,0 +1,348 @@
+"""Knob parity checker: HOROVOD_TPU_* reads vs. docs vs. run.py.
+
+Every knob is parsed independently wherever it is consumed — ``getenv``
+in cpp/htpu, ``os.environ`` in horovod_tpu — and documented by hand in
+docs/running.md / docs/observability.md.  This checker extracts all
+three views plus run.py's child-env propagation list and fails on:
+
+* a knob read in code (outside tests/) but absent from every docs table;
+* a docs-table knob that nothing reads any more;
+* default tokens that disagree numerically between C++, Python, and the
+  docs Default column (only numeric tokens are compared — "auto" vs. ""
+  style sentinels are resolved in code, not parseable here);
+* an env var run.py injects into children that the docs don't list, or
+  an env-contract table var run.py does not actually set.
+
+Default-token extraction is heuristic by design: it recognises the
+repo's two C++ idioms (a preceding ``type name = token;`` declaration
+feeding the strtol fallback, and a ``cond ? parse : kDefault`` ternary)
+and the Python ``os.environ.get(name, default)`` / ``env_flag`` forms,
+resolving simple module-level constants like ``64 << 10``.  A knob whose
+default the heuristics cannot see is simply not default-compared.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, line_of, read_text
+
+KNOB_RE = re.compile(r"HOROVOD_TPU_[A-Z0-9_]+")
+
+# Simple integer/float constant expressions we evaluate when resolving
+# named defaults (DEFAULT_INT8_FLOOR_BYTES = 64 << 10, 64 * 1024, ...).
+_CONST_EXPR_RE = re.compile(r"[-+*/()<\s0-9.eE]+")
+
+
+def _eval_const(expr: str) -> Optional[str]:
+    expr = expr.strip().rstrip(";").strip()
+    # C++ integer-literal suffixes (LL, u) on plain numbers.
+    expr = re.sub(r"\b(\d+)[uUlL]+\b", r"\1", expr)
+    if not expr or not _CONST_EXPR_RE.fullmatch(expr):
+        return None
+    try:
+        v = eval(expr, {"__builtins__": {}}, {})  # arithmetic only
+    except Exception:
+        return None
+    if isinstance(v, (int, float)):
+        return repr(v)
+    return None
+
+
+def _as_number(token: Optional[str]) -> Optional[float]:
+    if token is None:
+        return None
+    try:
+        return float(token)
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# C++ side
+# ---------------------------------------------------------------------------
+
+_CPP_DECL_DEFAULT_RE = re.compile(
+    r"^\s*(?:const\s+)?(?:long long|long|int64_t|int32_t|int|unsigned|"
+    r"uint64_t|double|bool|size_t)\s+\w+\s*=\s*([^;]+);")
+_CPP_TERNARY_DEFAULT_RE = re.compile(r"\?[^:;]+:\s*([A-Za-z0-9_]+)\s*;")
+_CPP_NAMED_CONST_RE = r"(?:constexpr|const)\s+[\w:<> ]+\s+{name}\s*=\s*([^;]+);"
+
+
+def _resolve_cpp_const(name: str, cpp_texts: Dict[str, str]) -> Optional[str]:
+    pat = re.compile(_CPP_NAMED_CONST_RE.format(name=re.escape(name)))
+    for text in cpp_texts.values():
+        m = pat.search(text)
+        if m:
+            return _eval_const(m.group(1))
+    return None
+
+
+def scan_cpp(root: pathlib.Path) -> Dict[str, List[dict]]:
+    """knob -> [{file, line, default}] for every getenv() site."""
+    sites: Dict[str, List[dict]] = {}
+    texts: Dict[str, str] = {}
+    for path in sorted((root / "cpp" / "htpu").glob("*")):
+        if path.suffix in (".cc", ".h") and path.name != "smoke_main.cc":
+            t = read_text(path)
+            if t is not None:
+                texts[str(path.relative_to(root))] = t
+    for rel, text in texts.items():
+        lines = text.splitlines()
+        for m in re.finditer(r'getenv\("(HOROVOD_TPU_[A-Z0-9_]+)"\)', text):
+            knob = m.group(1)
+            ln = line_of(text, m.start())
+            default = None
+            # Idiom 1: "type var = token;" within the 6 preceding lines
+            # (the strtol-with-fallback pattern).
+            for back in range(max(0, ln - 7), ln - 1):
+                dm = _CPP_DECL_DEFAULT_RE.match(lines[back])
+                if dm:
+                    default = dm.group(1).strip()
+            # Idiom 2: "cond ? parse(s) : kDefault;" on this/next lines.
+            if default is None:
+                window = "\n".join(lines[ln - 1:ln + 2])
+                tm = _CPP_TERNARY_DEFAULT_RE.search(window)
+                if tm:
+                    default = tm.group(1)
+            # Idiom 3: flag disabled only by an explicit "0"
+            # (HOROVOD_TPU_UDS) — the implied default is "1".
+            if default is None:
+                window = "\n".join(lines[ln - 1:ln + 2])
+                if '== "0"' in window:
+                    default = "1"
+            if default is not None and not _as_number(default):
+                default = _resolve_cpp_const(default, texts) or default
+            else:
+                default = (_eval_const(default) or default) if default else None
+            sites.setdefault(knob, []).append(
+                {"file": rel, "line": ln, "default": default})
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# Python side
+# ---------------------------------------------------------------------------
+
+_PY_STR_CONST_RE = re.compile(r'^(\w+)\s*=\s*"([^"]*)"\s*$', re.M)
+_PY_NUM_CONST_RE = re.compile(r"^(\w+)\s*=\s*([-0-9][0-9.eE <*+/()]*)\s*$",
+                              re.M)
+_PY_READ_RE = re.compile(
+    r"(?P<call>os\.environ\.get|os\.getenv|os\.environ\[|env_flag)\s*\(?"
+    r"\s*(?P<arg>\"[A-Z0-9_]+\"|[A-Za-z_]\w*)")
+
+
+def _py_module_consts(text: str) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for m in _PY_STR_CONST_RE.finditer(text):
+        consts[m.group(1)] = m.group(2)
+    for m in _PY_NUM_CONST_RE.finditer(text):
+        v = _eval_const(m.group(2))
+        if v is not None:
+            consts[m.group(1)] = v
+    return consts
+
+
+def _py_default_after(text: str, pos: int,
+                      consts: Dict[str, str]) -> Optional[str]:
+    """Default token from the window after the name argument."""
+    window = text[pos:pos + 160]
+    m = re.match(r'\s*,\s*"([^"]*)"', window, re.S)
+    if m:
+        return m.group(1)
+    m = re.match(r"\s*,\s*str\(\s*([\w.]+)\s*\)", window, re.S)
+    if m:
+        return consts.get(m.group(1).split(".")[-1])
+    m = re.match(r"\s*,\s*([-\w.]+)\s*[,)]", window, re.S)
+    if m:
+        tok = m.group(1)
+        return tok if _as_number(tok) is not None else consts.get(tok)
+    return None
+
+
+def _py_files(root: pathlib.Path,
+              include_tests: bool) -> List[Tuple[pathlib.Path, bool]]:
+    out: List[Tuple[pathlib.Path, bool]] = []
+    for base, test_only in (("horovod_tpu", False), ("tools", False),
+                            ("tests", True)):
+        d = root / base
+        if d.is_dir():
+            for p in sorted(d.rglob("*.py")):
+                # Skip the checkers themselves and their fixture corpus
+                # (planted-defect literals are not real knob reads).
+                if "analyze" in p.parts or \
+                        p.name == "test_static_analysis.py":
+                    continue
+                if test_only and not include_tests:
+                    continue
+                out.append((p, test_only))
+    for name in ("bench.py", "run.py"):
+        p = root / name
+        if p.is_file():
+            out.append((p, False))
+    return out
+
+
+def scan_python(root: pathlib.Path) -> Dict[str, List[dict]]:
+    """knob -> [{file, line, default, test_only}] for environ reads."""
+    sites: Dict[str, List[dict]] = {}
+    for path, test_only in _py_files(root, include_tests=True):
+        text = read_text(path)
+        if text is None:
+            continue
+        rel = str(path.relative_to(root))
+        consts = _py_module_consts(text)
+        for m in _PY_READ_RE.finditer(text):
+            arg = m.group("arg")
+            if arg.startswith('"'):
+                name = arg.strip('"')
+            else:
+                name = consts.get(arg, "")
+            if not name.startswith("HOROVOD_TPU_"):
+                continue
+            after = text[m.end():m.end() + 40]
+            # os.environ["X"] = ... is a write, not a read.
+            if (m.group("call") == "os.environ["
+                    and re.match(r'"?\]\s*=[^=]', after.lstrip('"'))):
+                continue
+            default = None
+            if m.group("call") == "env_flag":
+                default = "0"
+            elif m.group("call") != "os.environ[":
+                default = _py_default_after(text, m.end(), consts)
+            sites.setdefault(name, []).append({
+                "file": rel, "line": line_of(text, m.start()),
+                "default": default, "test_only": test_only})
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# Docs tables and run.py propagation
+# ---------------------------------------------------------------------------
+
+def scan_docs(root: pathlib.Path) -> Dict[str, dict]:
+    """knob -> {file, line, default} from markdown table rows whose first
+    cell names the knob.  Prose mentions don't count as documentation."""
+    documented: Dict[str, dict] = {}
+    for doc in ("docs/running.md", "docs/observability.md"):
+        text = read_text(root / doc)
+        if text is None:
+            continue
+        default_col = -1
+        for i, line in enumerate(text.splitlines(), 1):
+            if not line.lstrip().startswith("|"):
+                default_col = -1
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if not cells:
+                continue
+            if set(cells[0]) <= set("-: ") and cells[0]:
+                continue  # separator row
+            low = [c.lower().strip("`* ") for c in cells]
+            if "default" in low and not KNOB_RE.search(line):
+                default_col = low.index("default")
+                continue
+            row_knobs = KNOB_RE.findall(cells[0])
+            if not row_knobs:
+                continue
+            default = None
+            if 0 <= default_col < len(cells):
+                dm = re.search(r"`([^`]*)`", cells[default_col])
+                default = dm.group(1) if dm else None
+            # A row may document several knobs in one cell (the flash
+            # backward A/B pair); a shared Default cell only applies to
+            # a single-knob row.
+            for knob in row_knobs:
+                documented.setdefault(knob, {
+                    "file": doc, "line": i,
+                    "default": default if len(row_knobs) == 1 else None})
+    return documented
+
+
+def scan_run_propagation(root: pathlib.Path) -> Set[str]:
+    """Env vars run.py injects into every child process."""
+    text = read_text(root / "horovod_tpu" / "run.py")
+    if text is None:
+        return set()
+    out: Set[str] = set()
+    for m in re.finditer(r'"(HOROVOD_TPU_[A-Z0-9_]+)"\s*:', text):
+        out.add(m.group(1))
+    for m in re.finditer(r'env\["(HOROVOD_TPU_[A-Z0-9_]+)"\]\s*=', text):
+        out.add(m.group(1))
+    return out
+
+
+# The launcher's six-variable bootstrap contract (docs/running.md):
+# these must be unconditionally set on children.
+CONTRACT_VARS = (
+    "HOROVOD_TPU_COORD_ADDR", "HOROVOD_TPU_PROCESS_INDEX",
+    "HOROVOD_TPU_PROCESS_COUNT", "HOROVOD_TPU_SIZE",
+    "HOROVOD_TPU_RANK", "HOROVOD_TPU_LOCAL_SIZE",
+)
+
+
+def check(root: pathlib.Path) -> Tuple[List[Finding], dict]:
+    findings: List[Finding] = []
+    cpp = scan_cpp(root)
+    py = scan_python(root)
+    docs = scan_docs(root)
+    propagated = scan_run_propagation(root)
+
+    read_knobs = set(cpp) | set(py)
+    test_only = {k for k in py
+                 if k not in cpp and all(s["test_only"] for s in py[k])}
+    all_knobs = sorted(read_knobs | set(docs))
+
+    for knob in sorted(read_knobs - set(docs) - test_only):
+        site = (cpp.get(knob) or py[knob])[0]
+        findings.append(Finding(
+            "knobs", f"{knob} is read but not documented in any docs "
+            "knob table", site["file"], site["line"]))
+    for knob in sorted(set(docs) - read_knobs):
+        d = docs[knob]
+        findings.append(Finding(
+            "knobs", f"{knob} is documented but nothing reads it",
+            d["file"], d["line"]))
+
+    for knob in all_knobs:
+        tokens: Dict[str, float] = {}
+        reprs: Dict[str, str] = {}
+        for side, tok in (
+                ("cpp", next((s["default"] for s in cpp.get(knob, [])
+                              if s["default"] is not None), None)),
+                ("python", next((s["default"] for s in py.get(knob, [])
+                                 if s["default"] is not None), None)),
+                ("docs", (docs.get(knob) or {}).get("default"))):
+            num = _as_number(tok)
+            if num is not None:
+                tokens[side] = num
+                reprs[side] = str(tok)
+        if len(tokens) >= 2 and len(set(tokens.values())) > 1:
+            where = ", ".join(f"{s}={reprs[s]}" for s in sorted(tokens))
+            loc = docs.get(knob) or {"file": "", "line": 0}
+            findings.append(Finding(
+                "knobs", f"{knob} default diverges between sides: {where}",
+                loc.get("file", ""), loc.get("line", 0)))
+
+    for var in sorted(propagated - set(docs)):
+        findings.append(Finding(
+            "knobs", f"{var} is propagated to children by run.py but "
+            "not documented", "horovod_tpu/run.py"))
+    for var in CONTRACT_VARS:
+        if (root / "horovod_tpu" / "run.py").is_file() \
+                and var not in propagated:
+            findings.append(Finding(
+                "knobs", f"{var} is in the env contract but run.py does "
+                "not set it on children", "horovod_tpu/run.py"))
+
+    stats = {
+        "knobs_total": len(all_knobs),
+        "knobs_cpp": len(cpp),
+        "knobs_python": len(py),
+        "knobs_documented": len(docs),
+        "knobs_test_only": sorted(test_only),
+        "knobs": all_knobs,
+    }
+    return findings, stats

@@ -1,0 +1,201 @@
+"""Metric-name registry checker: emitters vs. consumers.
+
+Metric names follow the shared convention (metrics.h / metrics.py):
+``family`` or ``family#label=value[,label2=value2]``.  They are emitted
+from C++ through ``Metrics::Get().Counter/SetGauge/Observe`` and
+``ScopedTimer``, and from Python through ``registry.inc/observe/
+set_gauge`` — and then re-typed by hand in tools/metrics_watch.py,
+bench.py readers, and the docs/observability.md tables.  A rename on the
+emitting side silently zeroes every consumer; this checker makes that a
+red build instead.
+
+Emitted names come in two shapes:
+
+* exact — a full literal like ``"control.cache_hits"``;
+* prefix — a literal ending in ``=`` that gets a dynamic label value
+  appended (``"ring.allreduce.bytes_sent#wire=" + wire_label`` in C++,
+  ``f"injit.bytes#wire_dtype={key}"`` in Python).
+
+A consumer reference is valid when it equals an emitted exact name, or
+extends an emitted prefix, or is itself one of those prefixes, or is a
+registered derived name (computed by a consumer from raw counters,
+e.g. ``control.cache_hit_rate`` in metrics_watch).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import Dict, List, Set, Tuple
+
+from . import Finding, line_of, read_text
+
+# Names consumers compute locally rather than read from a snapshot.
+DERIVED_NAMES = {"control.cache_hit_rate"}
+
+_NAME_SHAPE = r"[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+(?:#[a-z0-9_]+=[^\s\"`]*)?"
+_NAME_SHAPE_RE = re.compile(rf"^{_NAME_SHAPE}$")
+
+# C++ emission sites; the first literal argument is the name (or the
+# name prefix when followed by '+' concatenation).
+_CPP_EMIT_RE = re.compile(
+    r'(?:\.Counter|\.SetGauge|\.Observe|ScopedTimer\s+\w+|ScopedTimer)\s*'
+    r'\(\s*"([^"]+)"\s*(\+)?', re.S)
+# Label prefixes built away from the call site ("control.clock_offset_us"
+# name vectors): any metric-shaped literal ending in '=' concatenated
+# with a dynamic value.
+_CPP_PREFIX_RE = re.compile(r'"([a-z0-9_.]+#[a-z0-9_]+=)"\s*\+')
+
+# Python emission sites: registry.inc / observe / set_gauge with a
+# literal or f-string first argument (possibly on the next line); a
+# following '+' or implicit f-string concatenation marks a prefix.
+_PY_EMIT_RE = re.compile(
+    r'\.(?:inc|observe|set_gauge)\(\s*(f?)"([^"]+)"\s*(\+|,|\)|f")', re.S)
+
+
+def _add(name: str, is_prefix: bool, exact: Set[str],
+         prefixes: Set[str]) -> None:
+    if is_prefix or name.endswith("="):
+        prefixes.add(name)
+    else:
+        exact.add(name)
+
+
+def scan_emitters(root: pathlib.Path) -> Tuple[Set[str], Set[str]]:
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    cpp_dir = root / "cpp" / "htpu"
+    for path in sorted(cpp_dir.glob("*.cc")):
+        if path.name == "smoke_main.cc":
+            continue
+        text = read_text(path)
+        if text is None:
+            continue
+        for m in _CPP_EMIT_RE.finditer(text):
+            _add(m.group(1), bool(m.group(2)), exact, prefixes)
+        for m in _CPP_PREFIX_RE.finditer(text):
+            _add(m.group(1), True, exact, prefixes)
+    hv = root / "horovod_tpu"
+    for path in sorted(hv.rglob("*.py")) if hv.is_dir() else []:
+        text = read_text(path)
+        if text is None:
+            continue
+        for m in _PY_EMIT_RE.finditer(text):
+            name = m.group(2)
+            if m.group(1):  # f-string: the prefix before the first brace
+                name = name.split("{")[0]
+                if not name:
+                    continue
+                _add(name, True, exact, prefixes)
+            else:
+                _add(name, m.group(3) in ("+", 'f"'), exact, prefixes)
+    return exact, prefixes
+
+
+def _matches(name: str, exact: Set[str], prefixes: Set[str]) -> bool:
+    if name in exact or name in DERIVED_NAMES:
+        return True
+    if name.endswith("="):
+        return name in prefixes
+    return any(name.startswith(p) for p in prefixes)
+
+
+def _family_roots(exact: Set[str], prefixes: Set[str]) -> Set[str]:
+    return {n.split(".", 1)[0] for n in exact | prefixes}
+
+
+def _consumer_literals(text: str) -> List[Tuple[str, int]]:
+    out = []
+    for m in re.finditer(r'"([^"\s]+)"', text):
+        name = m.group(1)
+        if _NAME_SHAPE_RE.match(name):
+            out.append((name, line_of(text, m.start())))
+    return out
+
+
+def _doc_table_names(text: str) -> List[Tuple[str, int]]:
+    """Metric names from observability.md table rows: code spans in the
+    first column, expanding the docs' compact notations —
+    ``a.b_sent/recv`` (two families), ``#wire=<fp32\\|bf16>`` (label
+    values, treated as a prefix), and a bare ``#label=value`` span
+    inheriting the previous span's family."""
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.strip().strip("|").split("|")[0]
+        last_family = ""
+        for span in re.findall(r"`([^`]+)`", first_cell):
+            span = span.replace("\\|", "|").strip()
+            if span.startswith("#") and last_family:
+                span = last_family + span
+            base, sep, label = span.partition("#")
+            if not re.match(r"^[a-z][a-z0-9_]*(\.[a-z0-9_/]+)+$", base):
+                continue
+            # a.bytes_sent/recv -> a.bytes_sent and a.bytes_recv;
+            # a.b/c -> a.b and a.c.
+            bases = [base]
+            m = re.match(r"^(.*\.)([a-z0-9_]+)/([a-z0-9_]+)$", base)
+            if m:
+                stem, first_leaf, alt = m.groups()
+                bases = [stem + first_leaf]
+                if "_" in first_leaf and "_" not in alt:
+                    bases.append(
+                        f"{stem}{first_leaf.rsplit('_', 1)[0]}_{alt}")
+                else:
+                    bases.append(stem + alt)
+            last_family = bases[0]
+            for b in bases:
+                if not sep:
+                    out.append((b, i))
+                    continue
+                lm = re.match(r"^([a-z0-9_]+=)(.*)$", label)
+                if not lm:
+                    continue
+                if re.fullmatch(r"[a-z0-9_]+", lm.group(2)):
+                    out.append((b + "#" + label, i))  # literal label value
+                else:
+                    out.append((b + "#" + lm.group(1), i))  # prefix
+    return out
+
+
+# consumer file -> extraction strategy
+_CONSUMERS = (
+    ("tools/metrics_watch.py", _consumer_literals),
+    ("bench.py", _consumer_literals),
+    ("docs/observability.md", _doc_table_names),
+)
+
+
+def check(root: pathlib.Path) -> Tuple[List[Finding], dict]:
+    exact, prefixes = scan_emitters(root)
+    findings: List[Finding] = []
+    refs_checked = 0
+    if not exact and not prefixes:
+        return findings, {"metrics_emitted": 0, "metric_refs_checked": 0}
+    # Only vet references into emitted metric families; other dotted
+    # literals in the consumers (tensor names, module paths) are not
+    # metric references.  A leaf rename keeps its family root, so the
+    # interesting breakage class stays covered.
+    roots = _family_roots(exact, prefixes)
+    for rel, extract in _CONSUMERS:
+        text = read_text(root / rel)
+        if text is None:
+            continue
+        seen = set()
+        for name, ln in extract(text):
+            if name in seen or name.split(".", 1)[0] not in roots:
+                continue
+            seen.add(name)
+            refs_checked += 1
+            if not _matches(name, exact, prefixes):
+                findings.append(Finding(
+                    "metrics", f"'{name}' is referenced here but no "
+                    "emitter produces it (renamed or stale?)", rel, ln))
+    stats = {
+        "metrics_emitted": len(exact) + len(prefixes),
+        "metrics_exact": sorted(exact),
+        "metrics_prefixes": sorted(prefixes),
+        "metric_refs_checked": refs_checked,
+    }
+    return findings, stats

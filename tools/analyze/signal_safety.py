@@ -1,0 +1,181 @@
+"""Async-signal-safety lint for the SIGUSR2 flight-recorder dump path.
+
+The launcher pokes hung ranks with SIGUSR2 before escalating to
+SIGTERM; the handler (flight_recorder.cc Sigusr2Handler -> SignalDump)
+may run while the tick thread is wedged holding arbitrary locks — so
+the entire path must stay on POSIX async-signal-safe ground: fixed
+stack buffers, snprintf, open(2)/write(2)/close(2), atomic loads.  One
+innocent-looking printf or std::string temporary deadlocks or corrupts
+the very dump that exists to debug the hang.
+
+This lint extracts the bodies of the signal-path roots from
+flight_recorder.cc, follows calls into other functions defined in the
+same file, and fails on any token from the deny list (allocation,
+locking, stdio streams, std::string construction, or a call back into
+the locked FlightRecorder API).  It is deliberately a lexical walk over
+one file — cheap enough for tier-1, and the dump path is required to
+stay self-contained in flight_recorder.cc for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import Dict, List, Tuple
+
+from . import Finding, read_text, strip_c_comments
+
+SIGNAL_ROOTS = ("Sigusr2Handler", "SignalDump")
+
+# Tokens that must never appear on the signal path.  Checked as whole
+# words; the entries cover C allocation/stdio, C++ locking and string
+# machinery, and the locked FlightRecorder entry points.
+DENY_TOKENS = {
+    "malloc": "allocates",
+    "calloc": "allocates",
+    "realloc": "allocates",
+    "new": "allocates",
+    "delete": "frees",
+    "fopen": "stdio stream (takes a lock, allocates)",
+    "fclose": "stdio stream",
+    "fwrite": "stdio stream",
+    "fread": "stdio stream",
+    "fprintf": "stdio stream",
+    "printf": "stdio stream",
+    "sprintf": "unbounded format into caller buffer",
+    "puts": "stdio stream",
+    "fputs": "stdio stream",
+    "fflush": "stdio stream",
+    "mutex": "locking",
+    "lock_guard": "locking",
+    "unique_lock": "locking",
+    "lock": "locking",
+    "unlock": "locking",
+    "to_string": "allocates a std::string",
+    "string": "allocates (std::string)",
+    "append": "allocates (std::string)",
+    "push_back": "may reallocate",
+    "resize": "reallocates",
+    "assign": "reallocates",
+    "getenv": "not async-signal-safe",
+    "exit": "runs atexit handlers (use _exit)",
+    "abort": "raises; not a dump primitive",
+    # Locked/allocating FlightRecorder API:
+    "Record": "takes mu_",
+    "SnapshotJson": "takes mu_ and allocates",
+    "Dump": "calls SnapshotJson/fopen",
+    "DumpPath": "takes mu_ and allocates",
+    "SetCapacityEvents": "takes mu_ and reallocates",
+    "SetRank": "takes mu_",
+    "capacity": "takes mu_",
+}
+
+# Safe calls the walk does not recurse into or flag (async-signal-safe
+# per POSIX, or lock-free accessors/atomics).
+ALLOW_TOKENS = {
+    "snprintf", "open", "write", "close", "clock_gettime", "raise",
+    "_exit", "memset", "memcpy", "strlen", "load", "store", "fetch_add",
+    "size", "data", "c_str", "min", "max", "size_t", "int64_t",
+    "uint64_t", "int32_t", "static_cast", "reinterpret_cast", "Get",
+    "WallClockUs", "FormatEvent", "LoadSlot", "SignalDump", "sizeof",
+    "if", "for", "while", "switch", "return",
+}
+
+_IDENT_RE = re.compile(r"\b([A-Za-z_]\w*)\b")
+
+
+def _blank_strings(text: str) -> str:
+    """Blank out string/char literal contents (keeping the quotes and
+    length) so braces and identifiers inside literals don't confuse the
+    brace matcher or the token scan."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in ('"', "'"):
+            j = i + 1
+            while j < n and text[j] != c:
+                if text[j] == "\\":
+                    out[j] = " "
+                    if j + 1 < n:
+                        out[j + 1] = " "
+                    j += 2
+                else:
+                    out[j] = " " if text[j] != "\n" else "\n"
+                    j += 1
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _function_bodies(text: str) -> Dict[str, Tuple[str, int]]:
+    """name -> (body, first_line) for functions defined in the file.
+    Brace-matched from each signature; good enough for the file's
+    plain (non-template-heavy) definitions."""
+    bodies: Dict[str, Tuple[str, int]] = {}
+    for m in re.finditer(
+            r"^[A-Za-z_][\w:<>&*, ]*?\b([A-Za-z_]\w*)\s*\([^;{)]*\)"
+            r"(?:\s*const)?\s*\{", text, re.M):
+        name = m.group(1)
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        bodies[name] = (text[m.end():i - 1],
+                        text.count("\n", 0, m.start()) + 1)
+    return bodies
+
+
+def check(root: pathlib.Path) -> Tuple[List[Finding], dict]:
+    rel = "cpp/htpu/flight_recorder.cc"
+    text = read_text(root / rel)
+    if text is None:
+        return [Finding("signal", f"{rel} is missing")], {
+            "signal_functions_walked": 0}
+    text = _blank_strings(strip_c_comments(text))
+    bodies = _function_bodies(text)
+    findings: List[Finding] = []
+
+    missing = [r for r in SIGNAL_ROOTS if r not in bodies]
+    for r in missing:
+        findings.append(Finding(
+            "signal", f"signal-path root {r}() not found in {rel} "
+            "(renamed? update tools/analyze/signal_safety.py)", rel))
+
+    walked: List[str] = []
+    queue = [r for r in SIGNAL_ROOTS if r in bodies]
+    while queue:
+        fn = queue.pop()
+        if fn in walked:
+            continue
+        walked.append(fn)
+        body, first_line = bodies[fn]
+        for im in _IDENT_RE.finditer(body):
+            ident = im.group(1)
+            line = first_line + body.count("\n", 0, im.start())
+            after = body[im.end():im.end() + 2].lstrip()
+            if ident in DENY_TOKENS:
+                findings.append(Finding(
+                    "signal",
+                    f"{fn}() reaches '{ident}' on the SIGUSR2 dump "
+                    f"path ({DENY_TOKENS[ident]})", rel, line))
+            elif (ident in bodies and ident not in ALLOW_TOKENS
+                  and after.startswith("(")):
+                # Recurse only into actual calls; a bare class name used
+                # as a qualifier (FlightRecorder::Get) is not a call
+                # into the constructor.
+                queue.append(ident)
+        # Calls into locally-defined helpers on the allow list still get
+        # walked so a regression inside them is caught.
+        for helper in ("FormatEvent", "LoadSlot", "WallClockUs"):
+            if helper in bodies and re.search(
+                    rf"\b{helper}\s*\(", body):
+                queue.append(helper)
+
+    stats = {"signal_functions_walked": sorted(walked)}
+    return findings, stats

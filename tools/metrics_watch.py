@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -248,6 +249,10 @@ def follow(paths, once: bool, name_filter: str, poll_s: float) -> int:
                     last[path] = snap
             printed = True
         if once:
+            if not printed:
+                print("metrics_watch: no complete snapshots in "
+                      + ", ".join(paths) + " (is the emitter running with "
+                      "HOROVOD_TPU_METRICS_EVERY_S set?)", file=sys.stderr)
             return 0 if printed else 1
         try:
             time.sleep(poll_s)
@@ -267,6 +272,13 @@ def main(argv=None) -> int:
     p.add_argument("--poll", type=float, default=1.0,
                    help="poll interval in seconds when following")
     args = p.parse_args(argv)
+    # Fail loudly up front on paths that can never produce output; the
+    # follow loop's silent retry is for files that exist but are mid-write.
+    missing = [f for f in args.files if not os.path.isfile(f)]
+    if missing:
+        print("metrics_watch: no such file: " + ", ".join(missing),
+              file=sys.stderr)
+        return 1
     return follow(args.files, args.once, args.filter, args.poll)
 
 

@@ -53,8 +53,16 @@ def load_trace(path: str) -> List[dict]:
     killed mid-run (the exact rank a straggler investigation cares about)
     still merges.  A torn final line (killed mid-``write``) is dropped.
     """
-    with open(path) as f:
-        text = f.read()
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise SystemExit(
+            f"trace_merge: cannot read {path}: {e.strerror or e}")
+    if not text.strip():
+        raise SystemExit(
+            f"trace_merge: {path} is empty — was the rank killed before "
+            "its first event, or HOROVOD_TPU_TIMELINE pointed elsewhere?")
     try:
         return json.loads(text)
     except json.JSONDecodeError:
@@ -67,11 +75,17 @@ def load_trace(path: str) -> List[dict]:
     try:
         return json.loads(repaired)
     except json.JSONDecodeError:
-        # Torn final line: drop it and close the array.
-        cut = text.rfind(",\n")
-        if cut < 0:
-            raise
-        return json.loads(text[:cut] + "\n]")
+        pass
+    # Torn final line: drop it and close the array.
+    cut = text.rfind(",\n")
+    if cut >= 0:
+        try:
+            return json.loads(text[:cut] + "\n]")
+        except json.JSONDecodeError:
+            pass
+    raise SystemExit(
+        f"trace_merge: {path} is not a Chrome-tracing JSON array "
+        "(and is beyond the killed-rank truncation repair)")
 
 
 def trace_anchor(events: List[dict]) -> Tuple[Optional[int], Optional[int]]:
