@@ -277,6 +277,26 @@ HTPU_API void htpu_control_destroy(void* cp) {
   delete static_cast<htpu::ControlPlane*>(cp);
 }
 
+// Elastic membership identity: the four values change together on a
+// RECONFIGURE; the Python controller re-reads them after any tick whose
+// response carried a reconfigure payload.  Safe from any thread.
+HTPU_API void htpu_control_membership(void* cp, int* process_index,
+                                      int* process_count, int* first_rank,
+                                      int* generation) {
+  int32_t pi = 0, pc = 0, fr = 0, gen = 0;
+  static_cast<htpu::ControlPlane*>(cp)->Membership(&pi, &pc, &fr, &gen);
+  *process_index = pi;
+  *process_count = pc;
+  *first_rank = fr;
+  *generation = gen;
+}
+
+// 1 when HOROVOD_TPU_ELASTIC=1 was honoured by this plane (a non-uniform
+// rank layout silently falls back to abort-on-failure).
+HTPU_API int htpu_control_elastic(void* cp) {
+  return static_cast<htpu::ControlPlane*>(cp)->elastic() ? 1 : 0;
+}
+
 // Serialized ResponseList into *out; length or -1.
 HTPU_API int htpu_control_tick(void* cp, const void* req_blob, int len,
                       long long fusion_threshold, void** out) {

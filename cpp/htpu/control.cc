@@ -125,6 +125,13 @@ bool StripClockTrailer(std::string* blob, int64_t* prev_resp_recv_us,
   return true;
 }
 
+// Standby handshake marker: a warm spare dials the coordinator with this
+// process_index; the coordinator parks the connection (replying with a
+// negative standby id) instead of seating it, and admits it at the next
+// RECONFIGURE.  Distinct from every legal process index and from the
+// park-ack ids themselves (-2, -3, ... assigned per parked standby).
+constexpr int kStandbyPidx = -1000000;
+
 }  // namespace
 
 std::unique_ptr<ControlPlane> ControlPlane::Create(
@@ -147,6 +154,37 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
     if (end && *end == '\0' && v > 0) hb_s = v;
   }
   cp->heartbeat_ms_ = int(std::min<long long>(hb_s * 1000LL, timeout_ms));
+  // Elastic membership: on a confirmed dead rank, reconfigure (re-rank
+  // survivors, re-bootstrap the ring, resume) instead of aborting the job.
+  // Off by default — non-elastic control traffic stays byte-identical to
+  // the abort-only wire.
+  if (const char* e = getenv("HOROVOD_TPU_ELASTIC")) {
+    cp->elastic_ = std::string(e) == "1";
+  }
+  if (const char* e = getenv("HOROVOD_TPU_ELASTIC_MIN_RANKS")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) cp->elastic_min_ranks_ = int(v);
+  }
+  if (cp->elastic_ &&
+      (process_count <= 0 || nranks_total % process_count != 0)) {
+    // Dense re-ranking assumes a uniform ranks-per-process layout; a
+    // fault layer must never take down a healthy job, so fall back to
+    // the abort path instead of mis-ranking survivors.
+    fprintf(stderr,
+            "htpu control: HOROVOD_TPU_ELASTIC=1 requires a uniform "
+            "ranks-per-process layout (%d ranks / %d processes); "
+            "falling back to abort-on-failure\n",
+            nranks_total, process_count);
+    cp->elastic_ = false;
+  }
+  cp->ranks_per_process_ =
+      cp->elastic_ ? nranks_total / process_count : 1;
+  cp->initial_process_count_ = process_count;
+  cp->coord_host_ = coord_host;
+  const char* sb = getenv("HOROVOD_TPU_STANDBY");
+  cp->is_standby_ = cp->elastic_ && process_index != 0 && sb &&
+                    std::string(sb) == "1";
   cp->ParseFaultEnv();
   // Flight recorder: rank-tag the process-wide ring and arm the SIGUSR2
   // dump so a wedged tick thread can still be made to leave forensics
@@ -174,21 +212,83 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
       cp->worker_fds_.assign(size_t(process_count), -1);
       cp->worker_first_rank_.assign(size_t(process_count), -1);
       cp->worker_first_rank_[0] = first_rank;
-      for (int i = 1; i < process_count; ++i) {
+      for (int seated = 1; seated < process_count;) {
         int fd = AcceptOne(cp->listen_fd_, timeout_ms);
         if (fd < 0) return nullptr;
         std::string hs;
         int pidx, frank;
         if (!RecvFrame(fd, &hs, timeout_ms) ||
-            !ParseHandshake(hs, &pidx, &frank) || pidx <= 0 ||
-            pidx >= process_count || cp->worker_fds_[size_t(pidx)] != -1) {
+            !ParseHandshake(hs, &pidx, &frank)) {
+          CloseFd(fd);
+          return nullptr;
+        }
+        if (cp->elastic_ && pidx == kStandbyPidx) {
+          // A warm spare dialed during bootstrap (run.py --num-standby
+          // launches them alongside the job): park it, keep seating.
+          if (!cp->ParkStandby(fd)) CloseFd(fd);
+          continue;
+        }
+        if (pidx <= 0 || pidx >= process_count ||
+            cp->worker_fds_[size_t(pidx)] != -1) {
           CloseFd(fd);
           return nullptr;
         }
         cp->worker_fds_[size_t(pidx)] = fd;
         cp->worker_first_rank_[size_t(pidx)] = frank;
+        ++seated;
       }
     }
+  } else if (cp->is_standby_) {
+    // Standby: dial the coordinator with the standby marker, learn our
+    // parked id from the ack, then block until a RECONFIGURE admits us
+    // (or the wait budget expires — e.g. the job shut down cleanly with
+    // no failure to backfill).
+    cp->coord_fd_ = DialRetry(coord_host, coord_port, timeout_ms);
+    if (cp->coord_fd_ < 0) return nullptr;
+    if (!SendFrame(cp->coord_fd_, HandshakeBlob(kStandbyPidx, first_rank))) {
+      return nullptr;
+    }
+    std::string ack;
+    if (!RecvFrame(cp->coord_fd_, &ack, timeout_ms) || ack.size() != 4) {
+      return nullptr;
+    }
+    int32_t sid = 0;
+    for (int i = 0; i < 4; ++i)
+      sid |= int32_t(uint32_t(uint8_t(ack[size_t(i)])) << (8 * i));
+    long wait_s = 600;
+    if (const char* e = getenv("HOROVOD_TPU_STANDBY_WAIT_S")) {
+      char* end = nullptr;
+      long v = strtol(e, &end, 10);
+      if (end && *end == '\0' && v > 0) wait_s = v;
+    }
+    FlightRecorder::Get().Record("elastic.standby_wait", coord_host.c_str(),
+                                 0, sid);
+    std::string frame;
+    ResponseList admit;
+    if (!RecvFrame(cp->coord_fd_, &frame, int(wait_s * 1000)) ||
+        !ParseResponseList(reinterpret_cast<const uint8_t*>(frame.data()),
+                           frame.size(), &admit) ||
+        !admit.has_elastic_ext || !admit.reconfigure) {
+      return nullptr;
+    }
+    const ElasticMember* me = nullptr;
+    for (const auto& m : admit.members) {
+      if (m.old_pidx == sid) {
+        me = &m;
+        break;
+      }
+    }
+    if (!me) return nullptr;   // broadcast reached us but we weren't seated
+    cp->process_index_ = me->new_pidx;
+    cp->first_rank_ = me->first_rank;
+    cp->process_count_ = int(admit.members.size());
+    cp->generation_ = admit.generation;
+    FlightRecorder::Get().SetRank(cp->first_rank_);
+    FlightRecorder::Get().Record("elastic.admitted", admit.lost_reason.c_str(),
+                                 0, me->new_pidx, admit.generation);
+    if (!cp->RebuildDataPlane()) return nullptr;
+    Metrics::Get().SetGauge("membership.generation", double(cp->generation_));
+    return cp;
   } else {
     cp->coord_fd_ = DialRetry(coord_host, coord_port, timeout_ms);
     if (cp->coord_fd_ < 0) return nullptr;
@@ -196,6 +296,9 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
                    HandshakeBlob(process_index, first_rank))) {
       return nullptr;
     }
+  }
+  if (cp->elastic_) {
+    Metrics::Get().SetGauge("membership.generation", 0.0);
   }
   if (process_count > 1 && !cp->SetupRing(coord_host)) return nullptr;
   if (cp->table_) {
@@ -391,6 +494,7 @@ ControlPlane::~ControlPlane() {
     }
   }
   for (int fd : worker_fds_) CloseFd(fd);
+  for (const auto& sb : standby_fds_) CloseFd(sb.first);
   CloseFd(coord_fd_);
   CloseFd(listen_fd_);
   CloseFd(ring_next_fd_);
@@ -404,72 +508,94 @@ ControlPlane::~ControlPlane() {
 // --------------------------------------------------------------- abort/fault
 
 void ControlPlane::ParseFaultEnv() {
-  // HOROVOD_TPU_FAULT=mode:rank=R:tick=T with mode one of
-  // crash/hang/drop_conn; R matches a process's FIRST global rank.  The
+  // HOROVOD_TPU_FAULT=mode:rank=R:tick=T[;mode:rank=R:tick=T...] with
+  // mode one of crash/hang/drop_conn/rejoin; R matches a process's FIRST
+  // global rank (at injection time — elastic re-ranking applies).  The
   // Python side (core.parse_fault_spec) validates strictly and raises on
   // malformed specs; this independent parse is lenient — a spec the
   // strict parser rejected can only get here via raw env tampering, and a
-  // fault layer must never take down a healthy job.
+  // fault layer must never take down a healthy job.  `rejoin` arms the
+  // coordinator to admit parked standbys at the first tick >= T, the
+  // deterministic readmit half of the elastic scenario tests.
   const char* f = getenv("HOROVOD_TPU_FAULT");
   if (!f || !*f) return;
-  std::string s(f);
-  size_t c = s.find(':');
-  std::string mode = s.substr(0, c);
-  long long rank = -1, tick = -1;
-  while (c != std::string::npos) {
-    size_t next = s.find(':', c + 1);
-    std::string kv = s.substr(
-        c + 1, next == std::string::npos ? std::string::npos : next - c - 1);
-    if (kv.rfind("rank=", 0) == 0) rank = atoll(kv.c_str() + 5);
-    else if (kv.rfind("tick=", 0) == 0) tick = atoll(kv.c_str() + 5);
-    c = next;
-  }
-  int m = mode == "crash" ? 1 : mode == "hang" ? 2
-          : mode == "drop_conn" ? 3 : 0;
-  if (m && rank >= 0 && tick > 0) {
-    fault_mode_ = m;
-    fault_rank_ = int(rank);
-    fault_tick_ = tick;
-  } else {
-    fprintf(stderr, "htpu control: ignoring malformed HOROVOD_TPU_FAULT=%s "
-            "(want crash|hang|drop_conn:rank=R:tick=T)\n", f);
+  std::string all(f);
+  size_t start = 0;
+  while (start <= all.size()) {
+    size_t semi = all.find(';', start);
+    std::string s = all.substr(
+        start, semi == std::string::npos ? std::string::npos : semi - start);
+    if (!s.empty()) {
+      size_t c = s.find(':');
+      std::string mode = s.substr(0, c);
+      long long rank = -1, tick = -1;
+      while (c != std::string::npos) {
+        size_t next = s.find(':', c + 1);
+        std::string kv = s.substr(
+            c + 1,
+            next == std::string::npos ? std::string::npos : next - c - 1);
+        if (kv.rfind("rank=", 0) == 0) rank = atoll(kv.c_str() + 5);
+        else if (kv.rfind("tick=", 0) == 0) tick = atoll(kv.c_str() + 5);
+        c = next;
+      }
+      int m = mode == "crash" ? 1 : mode == "hang" ? 2
+              : mode == "drop_conn" ? 3 : mode == "rejoin" ? 4 : 0;
+      if (m == 4 && rank >= 0 && tick > 0) {
+        if (int(rank) == first_rank_) rejoin_tick_ = tick;
+      } else if (m && rank >= 0 && tick > 0) {
+        FaultSpec fs;
+        fs.mode = m;
+        fs.rank = int(rank);
+        fs.tick = tick;
+        faults_.push_back(fs);
+      } else {
+        fprintf(stderr,
+                "htpu control: ignoring malformed HOROVOD_TPU_FAULT "
+                "spec '%s' (want crash|hang|drop_conn|rejoin:rank=R:tick=T"
+                "[;...])\n", s.c_str());
+      }
+    }
+    if (semi == std::string::npos) break;
+    start = semi + 1;
   }
 }
 
 void ControlPlane::MaybeInjectFault() {
-  if (!fault_mode_ || fault_rank_ != first_rank_ ||
-      tick_count_ != uint64_t(fault_tick_)) {
-    return;
-  }
-  if (fault_mode_ == 1) {
-    fprintf(stderr, "htpu fault injection: crashing rank %d at tick %llu\n",
-            first_rank_, (unsigned long long)tick_count_);
+  for (FaultSpec& fs : faults_) {
+    if (!fs.mode || fs.rank != first_rank_ ||
+        tick_count_ != uint64_t(fs.tick)) {
+      continue;
+    }
+    if (fs.mode == 1) {
+      fprintf(stderr, "htpu fault injection: crashing rank %d at tick %llu\n",
+              first_rank_, (unsigned long long)tick_count_);
+      fflush(stderr);
+      _exit(42);
+    }
+    if (fs.mode == 2) {
+      fprintf(stderr, "htpu fault injection: hanging rank %d at tick %llu\n",
+              first_rank_, (unsigned long long)tick_count_);
+      fflush(stderr);
+      FlightRecorder::Get().Record("fault.hang", "injected hang", 0,
+                                   first_rank_);
+      // Block the tick thread forever with sockets left open: the silent-
+      // worker case only the heartbeat deadline can catch.
+      for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
+    }
+    fprintf(stderr,
+            "htpu fault injection: dropping connections of rank %d at tick "
+            "%llu\n", first_rank_, (unsigned long long)tick_count_);
     fflush(stderr);
-    _exit(42);
-  }
-  if (fault_mode_ == 2) {
-    fprintf(stderr, "htpu fault injection: hanging rank %d at tick %llu\n",
-            first_rank_, (unsigned long long)tick_count_);
-    fflush(stderr);
-    FlightRecorder::Get().Record("fault.hang", "injected hang", 0,
+    FlightRecorder::Get().Record("fault.drop_conn", "injected conn drop", 0,
                                  first_rank_);
-    // Block the tick thread forever with sockets left open: the silent-
-    // worker case only the heartbeat deadline can catch.
-    for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
+    fs.mode = 0;  // fires once
+    for (int fd : worker_fds_) {
+      if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    }
+    if (coord_fd_ >= 0) shutdown(coord_fd_, SHUT_RDWR);
+    if (ring_next_fd_ >= 0) shutdown(ring_next_fd_, SHUT_RDWR);
+    if (ring_prev_fd_ >= 0) shutdown(ring_prev_fd_, SHUT_RDWR);
   }
-  fprintf(stderr,
-          "htpu fault injection: dropping connections of rank %d at tick "
-          "%llu\n", first_rank_, (unsigned long long)tick_count_);
-  fflush(stderr);
-  FlightRecorder::Get().Record("fault.drop_conn", "injected conn drop", 0,
-                               first_rank_);
-  fault_mode_ = 0;  // fires once
-  for (int fd : worker_fds_) {
-    if (fd >= 0) shutdown(fd, SHUT_RDWR);
-  }
-  if (coord_fd_ >= 0) shutdown(coord_fd_, SHUT_RDWR);
-  if (ring_next_fd_ >= 0) shutdown(ring_next_fd_, SHUT_RDWR);
-  if (ring_prev_fd_ >= 0) shutdown(ring_prev_fd_, SHUT_RDWR);
 }
 
 void ControlPlane::LatchAbort(int32_t rank, const std::string& reason) {
@@ -712,6 +838,10 @@ bool ControlPlane::ApplyResponseFrame(const ResponseList& parsed,
       clean.cache_flags = 0;
       clean.cache_assignments.clear();
       clean.cache_evictions.clear();
+      // The elastic stamp is per-delivery, not part of the cached set —
+      // the generation check already ran on the enclosing frame.
+      clean.has_elastic_ext = false;
+      clean.generation = 0;
       std::string cb;
       SerializeResponseList(clean, &cb);
       if (cache_set_.size() >= 16) cache_set_.clear();  // bounded, rebuilt fast
@@ -755,6 +885,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     // clock trailer, wait for the response list.
     std::string frame;
     CompressRequestFrame(request_list_blob, &frame);
+    if (elastic_) StampElasticRequest(&frame);
     AppendClockTrailer(last_resp_recv_us_, &frame);
     auto w0 = std::chrono::steady_clock::now();
     FlightRecorder::Get().Record("tick.send", "", int64_t(frame.size()),
@@ -793,6 +924,19 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
             response_list_blob->size(), &parsed)) {
       if (parsed.abort_rank >= 0) {
         LatchAbort(parsed.abort_rank, parsed.abort_reason);
+      } else if (elastic_ && parsed.has_elastic_ext && parsed.reconfigure) {
+        // Coordinated reconfiguration: adopt the new membership (or
+        // self-abort if evicted) and rebuild the data plane before
+        // handing the frame up — by the time Python sees it, the new
+        // ring is live and the next tick runs at the new generation.
+        ApplyReconfigure(parsed, response_list_blob);
+      } else if (elastic_ && parsed.has_elastic_ext &&
+                 parsed.generation != generation_) {
+        LatchAbort(first_rank_,
+                   "stale membership generation: coordinator is at "
+                   "generation " + std::to_string(parsed.generation) +
+                       ", this worker at " + std::to_string(generation_));
+        SerializeAbort(response_list_blob);
       } else if (!ApplyResponseFrame(parsed, response_list_blob)) {
         LatchAbort(first_rank_,
                    "response cache protocol error: coordinator replayed a "
@@ -841,24 +985,49 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   arrival_us[0] = WallClockUs();
   have_arrival[0] = true;
   if (clock_sync_.empty()) clock_sync_.resize(size_t(process_count_));
-  for (int i = 1; i < process_count_ && abort_rank < 0; ++i) {
+  if (elastic_) AcceptStandbys();
+  // Elastic: confirmed-dead process indices this gather.  The legacy path
+  // stops at the first failure; the elastic path keeps draining the
+  // remaining survivors' frames — they are needed intact so no tick-N
+  // request poisons the post-reconfigure stream.
+  std::vector<int> dead_procs;
+  for (int i = 1; i < process_count_; ++i) {
+    if (!elastic_ && abort_rank >= 0) break;   // legacy: first failure wins
     std::string blob;
     bool got = RecvFrame(worker_fds_[size_t(i)], &blob, heartbeat_ms_);
     int64_t t2_us = WallClockUs();
     int64_t t1_us = 0, t4_prev_us = 0;
     bool have_trailer =
         got && StripClockTrailer(&blob, &t4_prev_us, &t1_us);
-    if (!got ||
-        !ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
-                          blob.size(), &frames[size_t(i)])) {
-      abort_rank = worker_first_rank_[size_t(i)];
-      abort_reason =
-          "rank " + std::to_string(abort_rank) + " (process " +
-          std::to_string(i) + ") missed the " +
-          std::to_string(heartbeat_ms_ / 1000) +
-          "s heartbeat deadline (crashed, hung, or sent a corrupt frame)";
-      FlightRecorder::Get().Record("gather.fail", abort_reason.c_str(), 0,
-                                   i, got ? 0 : errno);
+    bool parsed_ok =
+        got &&
+        ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
+                         blob.size(), &frames[size_t(i)]);
+    // A frame stamped with a stale membership generation (a worker that
+    // missed a RECONFIGURE) is rejected like a corrupt frame.
+    bool stale = parsed_ok && elastic_ &&
+                 (!frames[size_t(i)].has_elastic_ext ||
+                  frames[size_t(i)].generation != generation_);
+    if (!parsed_ok || stale) {
+      if (abort_rank < 0) {
+        abort_rank = worker_first_rank_[size_t(i)];
+        abort_reason =
+            stale ? "rank " + std::to_string(abort_rank) + " (process " +
+                        std::to_string(i) +
+                        ") sent a frame from stale membership generation " +
+                        std::to_string(frames[size_t(i)].generation) +
+                        " (current " + std::to_string(generation_) + ")"
+                  : "rank " + std::to_string(abort_rank) + " (process " +
+                        std::to_string(i) + ") missed the " +
+                        std::to_string(heartbeat_ms_ / 1000) +
+                        "s heartbeat deadline (crashed, hung, or sent a "
+                        "corrupt frame)";
+      }
+      FlightRecorder::Get().Record(
+          "gather.fail",
+          (stale ? "stale generation" : "missed heartbeat / corrupt frame"),
+          0, i, got ? 0 : errno);
+      if (elastic_) dead_procs.push_back(i);
     } else {
       FlightRecorder::Get().Record("gather.recv", "",
                                    int64_t(blob.size()), i,
@@ -898,6 +1067,75 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     last_gather_done_ = gather_t1;
   }
 
+  if (elastic_ && abort_rank >= 0 && !shutdown) {
+    // Map every attributed failure onto a process index.  A worker-
+    // reported data-plane failure blames the peer process whose socket
+    // died — fold that process into the dead set alongside any gather
+    // (heartbeat) failures.
+    bool reconfigurable = true;
+    int reported = -1;
+    for (int p = 1; p < process_count_; ++p) {
+      if (worker_first_rank_[size_t(p)] == abort_rank) reported = p;
+    }
+    if (reported > 0) {
+      bool seen = false;
+      for (int p : dead_procs) seen = seen || p == reported;
+      if (!seen) dead_procs.push_back(reported);
+    }
+    // Only a non-coordinator process can be reconfigured away: the
+    // coordinator IS the control plane, and an unmappable rank means the
+    // attribution is already cross-generation garbage.
+    if (dead_procs.empty() || abort_rank == worker_first_rank_[0]) {
+      reconfigurable = false;
+    }
+    std::sort(dead_procs.begin(), dead_procs.end());
+    int survivors = process_count_ - int(dead_procs.size());
+    if (survivors * ranks_per_process_ < elastic_min_ranks_) {
+      // Shrinking below the floor: fall back to the PR 2 abort with the
+      // original attributed error.
+      fprintf(stderr,
+              "htpu elastic: %d surviving ranks would fall below "
+              "HOROVOD_TPU_ELASTIC_MIN_RANKS=%d; aborting instead of "
+              "reconfiguring\n",
+              survivors * ranks_per_process_, elastic_min_ranks_);
+      reconfigurable = false;
+    }
+    if (reconfigurable &&
+        CoordinateReconfigure(dead_procs, abort_rank, abort_reason,
+                              response_list_blob)) {
+      return true;
+    }
+    if (reconfigurable) {
+      // CoordinateReconfigure latched its own abort (rebuild failed) and
+      // serialized the abort frame; fall through to the broadcast below
+      // is wrong — survivors already got the RECONFIGURE frame — so just
+      // hand the abort to our own controller.
+      return true;
+    }
+  }
+  if (elastic_ && abort_rank < 0 && !shutdown && rejoin_tick_ >= 0 &&
+      tick_count_ >= uint64_t(rejoin_tick_)) {
+    // Armed `rejoin` fault action: grow the membership by admitting the
+    // parked standbys.  A standby still sitting in the listen backlog
+    // (nothing has reconfigured yet, so no one accepted it) counts —
+    // park it now.  The fault fires at the first tick >= T where a
+    // standby is parked AND a seat is open (admission never grows the
+    // world past its launch size), and stays armed until then — in the
+    // scripted 2->1->2 drill the rejoin tick may elapse before the
+    // crash's seat opens.  In-flight requests from this tick are
+    // dropped — survivors see the RECONFIGURE, complete them as
+    // retryable, and resubmit after restore, exactly like the shrink
+    // path.
+    AcceptStandbys();
+    if (!standby_fds_.empty() && process_count_ < initial_process_count_) {
+      rejoin_tick_ = -1;
+      CoordinateReconfigure(std::vector<int>(), -1,
+                            "standby rejoin (injected fault action)",
+                            response_list_blob);
+      return true;
+    }
+  }
+
   if (abort_rank >= 0) {
     // Broadcast the ABORT control message (best effort — some links may
     // already be dead) so every rank raises the same attributed error.
@@ -913,6 +1151,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
 
   ResponseList out;
   out.shutdown = shutdown;
+  // Elastic frames carry the membership generation both ways so stale
+  // traffic from before a reconfigure can never be misapplied.
+  out.has_elastic_ext = elastic_;
+  out.generation = generation_;
   // One acquire-load per tick: a concurrent detach (teardown without
   // shutdown, cpp_core.CppTimeline.__del__) must not tear the pointer
   // mid-loop.  A stale non-null value is safe — the writer is closed,
@@ -966,6 +1208,8 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
         mini.has_cache_ext = true;
         mini.cache_epoch = cache_->epoch();
         mini.cache_flags = kCacheServed;
+        mini.has_elastic_ext = elastic_;
+        mini.generation = generation_;
         SerializeResponseList(mini, response_list_blob);
         // Clock gather-done -> response-blob-ready: the pre-gather span
         // is waiting on peers and the post-serialize span is the
@@ -1204,6 +1448,15 @@ bool ControlPlane::BroadcastResponse(std::string* response_list_blob) {
   ScopedTimer bcast_timer("control.bcast_seconds");
   for (int i = 1; i < process_count_; ++i) {
     if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) {
+      if (elastic_) {
+        // A worker dead at broadcast time is next tick's heartbeat
+        // failure — the reconfigure path needs the survivors' frames,
+        // which are only gatherable at tick granularity.  Keep the tick
+        // alive and let the next gather confirm and reconfigure.
+        FlightRecorder::Get().Record("bcast.fail", "worker link lost", 0, i,
+                                     worker_fds_[size_t(i)]);
+        continue;
+      }
       // A worker died between its request and our response: abort the job
       // with attribution instead of failing this tick generically.  Workers
       // that already got the normal response read the abort next tick.
@@ -1227,6 +1480,280 @@ bool ControlPlane::BroadcastResponse(std::string* response_list_blob) {
                                int64_t(response_list_blob->size()), 0,
                                process_count_ - 1);
   return true;
+}
+
+// ------------------------------------------------- elastic membership
+//
+// Reconfiguration is synchronous inside Tick: the coordinator detects the
+// dead rank during the gather, drains the survivors' frames, broadcasts the
+// RECONFIGURE payload, and every process rebuilds its data plane before its
+// Tick returns — so by the time the Python controllers see the frame, the
+// re-ranked ring is live and the next tick already runs at the new
+// generation.  State machine per process:
+//   RUN -> QUIESCE (in-flight negotiation dropped; Python completes the
+//   handles as RETRYABLE) -> RERANK (dense new process indices, standbys
+//   admitted) -> REBOOTSTRAP (SetupRing / EnsureHierarchy re-entry over
+//   fresh sockets) -> RESTORE (driver replays params from the latest
+//   checkpoint) -> RUN.
+
+void ControlPlane::StampElasticRequest(std::string* frame) const {
+  RequestList list;
+  if (!ParseRequestList(reinterpret_cast<const uint8_t*>(frame->data()),
+                        frame->size(), &list)) {
+    return;   // corrupt frames pass through verbatim; the receiver rejects
+  }
+  // A frame that already carries the extension keeps its generation — the
+  // test seam that lets scenario tests inject stale-generation traffic.
+  if (!list.has_elastic_ext) {
+    list.has_elastic_ext = true;
+    list.generation = generation_;
+  }
+  frame->clear();
+  SerializeRequestList(list, frame);
+}
+
+bool ControlPlane::ParkStandby(int fd) {
+  int32_t id = next_standby_id_--;
+  std::string ack;
+  for (int i = 0; i < 4; ++i)
+    ack.push_back(char((uint32_t(id) >> (8 * i)) & 0xff));
+  if (!SendFrame(fd, ack)) return false;
+  standby_fds_.emplace_back(fd, id);
+  FlightRecorder::Get().Record("elastic.standby_parked", "", 0, id, fd);
+  Metrics::Get().SetGauge("elastic.standbys",
+                          double(standby_fds_.size()));
+  return true;
+}
+
+void ControlPlane::AcceptStandbys() {
+  if (listen_fd_ < 0) return;
+  for (;;) {
+    pollfd p{};
+    p.fd = listen_fd_;
+    p.events = POLLIN;
+    if (poll(&p, 1, 0) <= 0 || !(p.revents & POLLIN)) return;
+    int fd = AcceptOne(listen_fd_, 1000);
+    if (fd < 0) return;
+    std::string hs;
+    int pidx, frank;
+    if (!RecvFrame(fd, &hs, 2000) || !ParseHandshake(hs, &pidx, &frank) ||
+        pidx != kStandbyPidx) {
+      CloseFd(fd);   // stray or half-open connection; not a standby
+      continue;
+    }
+    if (!ParkStandby(fd)) CloseFd(fd);
+  }
+}
+
+bool ControlPlane::CoordinateReconfigure(const std::vector<int>& dead_procs,
+                                         int32_t lost_rank,
+                                         const std::string& reason,
+                                         std::string* response_list_blob) {
+  const auto t0 = std::chrono::steady_clock::now();
+  AcceptStandbys();   // a relaunched child may already be waiting
+  std::vector<char> dead(size_t(process_count_), 0);
+  for (int p : dead_procs) {
+    if (p > 0 && p < process_count_) dead[size_t(p)] = 1;
+  }
+
+  // Dense re-rank: survivors keep their relative order (the coordinator
+  // stays process 0), admitted standbys append, and first ranks follow
+  // the uniform ranks-per-process layout.
+  ResponseList out;
+  out.has_elastic_ext = true;
+  out.generation = generation_ + 1;
+  out.reconfigure = true;
+  out.lost_rank = lost_rank;
+  out.lost_reason = reason;
+  std::vector<int> new_fds, new_first;
+  for (int p = 0; p < process_count_; ++p) {
+    if (dead[size_t(p)]) continue;
+    ElasticMember m;
+    m.old_pidx = p;
+    m.new_pidx = int32_t(new_fds.size());
+    m.first_rank = m.new_pidx * ranks_per_process_;
+    out.members.push_back(m);
+    new_fds.push_back(p == 0 ? -1 : worker_fds_[size_t(p)]);
+    new_first.push_back(m.first_rank);
+  }
+  std::vector<std::pair<int, int32_t>> parked;
+  parked.swap(standby_fds_);
+  std::vector<int> admitted_fds;
+  for (auto& sb : parked) {
+    if (int(new_fds.size()) >= initial_process_count_) {
+      standby_fds_.push_back(sb);   // over launch size: stays parked
+      continue;
+    }
+    ElasticMember m;
+    m.old_pidx = sb.second;
+    m.new_pidx = int32_t(new_fds.size());
+    m.first_rank = m.new_pidx * ranks_per_process_;
+    out.members.push_back(m);
+    new_fds.push_back(sb.first);
+    new_first.push_back(m.first_rank);
+    admitted_fds.push_back(sb.first);
+  }
+  Metrics::Get().SetGauge("elastic.standbys", double(standby_fds_.size()));
+  const int new_count = int(new_fds.size());
+
+  std::string frame;
+  SerializeResponseList(out, &frame);
+  // Best-effort delivery to every OLD worker still connected — survivors
+  // apply it; an alive-but-evicted process (blamed by a peer, or caught
+  // sending stale-generation traffic) finds itself absent from the table
+  // and self-aborts with a clear reason — then to the admitted standbys.
+  for (int p = 1; p < process_count_; ++p) {
+    if (worker_fds_[size_t(p)] >= 0) SendFrame(worker_fds_[size_t(p)], frame);
+  }
+  for (int fd : admitted_fds) SendFrame(fd, frame);
+  for (int p : dead_procs) {
+    if (p > 0 && p < process_count_) {
+      CloseFd(worker_fds_[size_t(p)]);
+      worker_fds_[size_t(p)] = -1;
+    }
+  }
+
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    process_count_ = new_count;
+    generation_ += 1;
+  }
+  worker_fds_ = std::move(new_fds);
+  worker_first_rank_ = std::move(new_first);
+  FlushMembershipState();
+  table_.reset(new MessageTable(new_count * ranks_per_process_));
+  cache_.reset(new ResponseCache(cache_capacity_, new_count));
+  FlightRecorder::Get().Record("elastic.reconfigure", reason.c_str(),
+                               new_count, lost_rank, generation_);
+
+  if (!RebuildDataPlane()) {
+    LatchAbort(lost_rank >= 0 ? lost_rank : first_rank_,
+               "elastic reconfiguration failed: could not re-bootstrap the "
+               "data plane after: " + reason);
+    SerializeAbort(response_list_blob);
+    return false;
+  }
+  // Algo-selection inputs changed with the membership (host set, process
+  // count); recompute from the fresh ring address book.
+  int num_hosts = 1;
+  if (!host_fps_.empty()) {
+    std::unordered_set<std::string> uniq(host_fps_.begin(), host_fps_.end());
+    num_hosts = int(uniq.size());
+  }
+  int64_t crossover = kDefaultAlgoCrossoverBytes;
+  if (const char* e = getenv("HOROVOD_TPU_ALLREDUCE_CROSSOVER")) {
+    char* end = nullptr;
+    long long v = strtoll(e, &end, 10);
+    if (end && *end == '\0' && v >= 0) crossover = v;
+  }
+  table_->ConfigureAlgoSelection(num_hosts, new_count, crossover);
+
+  const double downtime =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  Metrics::Get().Counter("elastic.reconfigs")
+      ->fetch_add(1, std::memory_order_relaxed);
+  Metrics::Get().Observe("elastic.downtime_seconds", downtime);
+  Metrics::Get().SetGauge("elastic.last_downtime_s", downtime);
+  Metrics::Get().SetGauge("membership.generation", double(generation_));
+  fprintf(stderr,
+          "htpu elastic: reconfigured to %d process(es) at generation %d "
+          "in %.3fs (%s)\n",
+          new_count, generation_, downtime, reason.c_str());
+  *response_list_blob = std::move(frame);
+  return true;
+}
+
+bool ControlPlane::ApplyReconfigure(const ResponseList& parsed,
+                                    std::string* response_list_blob) {
+  const auto t0 = std::chrono::steady_clock::now();
+  const ElasticMember* me = nullptr;
+  for (const auto& m : parsed.members) {
+    if (m.old_pidx == process_index_) {
+      me = &m;
+      break;
+    }
+  }
+  if (me == nullptr) {
+    LatchAbort(first_rank_,
+               "evicted from the membership at generation " +
+                   std::to_string(parsed.generation) +
+                   " after: " + parsed.lost_reason);
+    SerializeAbort(response_list_blob);
+    return false;
+  }
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    process_index_ = me->new_pidx;
+    first_rank_ = me->first_rank;
+    process_count_ = int(parsed.members.size());
+    generation_ = parsed.generation;
+  }
+  FlightRecorder::Get().SetRank(first_rank_);
+  FlightRecorder::Get().Record("elastic.reconfigure",
+                               parsed.lost_reason.c_str(),
+                               int64_t(parsed.members.size()),
+                               parsed.lost_rank, parsed.generation);
+  FlushMembershipState();
+  if (!RebuildDataPlane()) {
+    LatchAbort(parsed.lost_rank >= 0 ? parsed.lost_rank : first_rank_,
+               "elastic reconfiguration failed: could not re-bootstrap the "
+               "data plane after: " + parsed.lost_reason);
+    SerializeAbort(response_list_blob);
+    return false;
+  }
+  const double downtime =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  Metrics::Get().Counter("elastic.reconfigs")
+      ->fetch_add(1, std::memory_order_relaxed);
+  Metrics::Get().Observe("elastic.downtime_seconds", downtime);
+  Metrics::Get().SetGauge("elastic.last_downtime_s", downtime);
+  Metrics::Get().SetGauge("membership.generation", double(generation_));
+  return true;
+}
+
+bool ControlPlane::RebuildDataPlane() {
+  // Torn-socket teardown: the old ring / hierarchy connections may hold
+  // half-written frames from the failed generation; nothing on them is
+  // salvageable, so close everything and bootstrap fresh.
+  CloseFd(ring_next_fd_);
+  ring_next_fd_ = -1;
+  CloseFd(ring_prev_fd_);
+  ring_prev_fd_ = -1;
+  ring_transport_ = "none";
+  CloseFd(leader_fd_);
+  leader_fd_ = -1;
+  for (int fd : member_fds_) CloseFd(fd);
+  member_fds_.clear();
+  CloseFd(leader_next_fd_);
+  leader_next_fd_ = -1;
+  CloseFd(leader_prev_fd_);
+  leader_prev_fd_ = -1;
+  hier_state_ = 0;   // EnsureHierarchy re-enters lazily on next hier/small
+  is_leader_ = false;
+  group_.clear();
+  leaders_.clear();
+  my_leader_pos_ = -1;
+  host_fps_.clear();
+  all_first_ranks_.clear();
+  if (process_count_ <= 1) return true;
+  return SetupRing(coord_host_);
+}
+
+void ControlPlane::FlushMembershipState() {
+  // Everything keyed by the old membership: cached response sets and slot
+  // tables (both halves — the coordinator also re-creates cache_ sized for
+  // the new process count), open negotiation spans, and the per-process
+  // clock/skew estimators (metric names embed ranks that just changed).
+  CacheFlushAll();
+  cache_client_epoch_ = 0;
+  negotiating_.clear();
+  clock_sync_.clear();
+  skew_names_.clear();
+  offset_names_.clear();
+  last_resp_recv_us_ = 0;
+  last_bcast_us_ = 0;
 }
 
 // ------------------------------------------------- clock sync / skew

@@ -86,6 +86,20 @@ class ControlPlane {
 
   int process_count() const { return process_count_; }
 
+  // ---- elastic membership (HOROVOD_TPU_ELASTIC=1) ----
+  // Current membership identity of this process.  All four values change
+  // together on a RECONFIGURE; the Python controller re-reads them after
+  // any tick whose response carried a reconfigure payload.
+  void Membership(int32_t* process_index, int32_t* process_count,
+                  int32_t* first_rank, int32_t* generation) const {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    *process_index = process_index_;
+    *process_count = process_count_;
+    *first_rank = first_rank_;
+    *generation = generation_;
+  }
+  bool elastic() const { return elastic_; }
+
   // True once a job-wide abort is latched (coordinator-broadcast ABORT,
   // lost coordinator link, or an injected fault).  After this, Tick
   // returns the latched abort response and the data plane fails fast.
@@ -142,6 +156,38 @@ class ControlPlane {
   bool RingAllgather(const std::string& in, std::string* out);
   bool RingBroadcast(int root_process, const std::string& in,
                      std::string* out);
+
+  // ---- elastic membership internals (all on the tick thread) ----
+  // Re-serialize an outbound RequestList with the elastic extension
+  // (current generation) stamped on it.
+  void StampElasticRequest(std::string* frame) const;
+  // Coordinator: assign the connection a negative standby id, send the
+  // 4-byte park-ack, and queue it for admission.  False on a dead socket.
+  bool ParkStandby(int fd);
+  // Coordinator: accept any standby connections parked on listen_fd_
+  // (non-blocking poll; each gets a park-ack frame carrying its negative
+  // standby id).  Safe to call every tick — cheap when nothing is pending.
+  void AcceptStandbys();
+  // Coordinator: build + broadcast the RECONFIGURE frame for the given set
+  // of dead process indices (empty for a pure standby-rejoin grow), admit
+  // parked standbys, adopt the new membership, and rebuild the data plane.
+  // On success *response_list_blob is the RECONFIGURE frame (returned to
+  // this process's own Python controller).  False => fell back to abort
+  // (blob is the abort frame).
+  bool CoordinateReconfigure(const std::vector<int>& dead_procs,
+                             int32_t lost_rank, const std::string& reason,
+                             std::string* response_list_blob);
+  // Worker: apply a received RECONFIGURE frame — adopt the new identity
+  // from the membership table (or self-abort if evicted), flush caches,
+  // and rebuild the data plane.  Mirrors the tail of CoordinateReconfigure.
+  bool ApplyReconfigure(const ResponseList& parsed,
+                        std::string* response_list_blob);
+  // Shared teardown + re-bootstrap: close ring/hierarchy sockets, reset
+  // clock/skew state, and re-run SetupRing under the new membership.
+  bool RebuildDataPlane();
+  // Flush everything keyed by the old membership: response cache (both
+  // halves), message table, negotiation spans, clock estimators.
+  void FlushMembershipState();
 
   // Failure-detection / abort machinery (all called from the tick thread;
   // the data plane runs on the same background thread, so no locking).
@@ -240,11 +286,20 @@ class ControlPlane {
   // liveness signal is — in a healthy job, roughly one tick interval).
   std::chrono::steady_clock::time_point last_gather_done_{};
 
-  // Fault injection (HOROVOD_TPU_FAULT=mode:rank=R:tick=T, matched
-  // against first_rank_): 0 = none, 1 = crash, 2 = hang, 3 = drop_conn.
-  int fault_mode_ = 0;
-  int fault_rank_ = -1;
-  long long fault_tick_ = -1;
+  // Fault injection (HOROVOD_TPU_FAULT=mode:rank=R:tick=T[;...], matched
+  // against first_rank_): 1 = crash, 2 = hang, 3 = drop_conn, 4 = rejoin
+  // (coordinator-side: admit parked standbys at tick >= T).  Multiple
+  // semicolon-separated specs are allowed so elastic scenarios can script
+  // a kill and a later readmit in one env var.
+  struct FaultSpec {
+    int mode = 0;
+    int rank = -1;
+    long long tick = -1;
+  };
+  std::vector<FaultSpec> faults_;
+  // Armed rejoin action (mode 4): fires on the coordinator once per arm,
+  // at the first tick >= rejoin_tick_ with at least one parked standby.
+  long long rejoin_tick_ = -1;
 
   // Latched job-wide abort + last-failure attribution.  The flag is
   // atomic (polled off-thread by aborted()); the attribution strings
@@ -341,6 +396,31 @@ class ControlPlane {
   // response has been broadcast with kCacheStoreSet (fast-path gate).
   std::unique_ptr<ResponseCache> cache_;
   std::unordered_set<std::string> cache_sets_broadcast_;
+
+  // ---- elastic membership (HOROVOD_TPU_ELASTIC=1) ----
+  bool elastic_ = false;
+  // Floor on the surviving global rank count: shrinking below it falls
+  // back to the PR 2 abort with the original attributed error.
+  int elastic_min_ranks_ = 1;
+  // Monotonic membership generation, bumped on every RECONFIGURE.  Rides
+  // the elastic wire extension on every frame in elastic mode; frames
+  // stamped with a stale generation are rejected.  Guarded by err_mu_ for
+  // the cross-thread Membership() reader; written only on the tick thread.
+  int32_t generation_ = 0;
+  // Ranks per process at Create (nranks_total / process_count when
+  // divisible) — the dense re-rank unit.
+  int ranks_per_process_ = 1;
+  // Membership may never grow past the launch size.
+  int initial_process_count_ = 0;
+  // Coordinator address book entry saved for SetupRing re-entry.
+  std::string coord_host_;
+  // Coordinator: parked standby connections (fd + the negative standby id
+  // each was ack'ed with), awaiting admission at the next reconfigure.
+  std::vector<std::pair<int, int32_t>> standby_fds_;
+  int32_t next_standby_id_ = -2;
+  // This process joined as a standby (HOROVOD_TPU_STANDBY=1) and parks in
+  // Create until a RECONFIGURE frame admits it.
+  bool is_standby_ = false;
 };
 
 }  // namespace htpu

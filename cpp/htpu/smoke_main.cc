@@ -363,6 +363,119 @@ int RunProcess(int pidx, int port) {
   return 0;
 }
 
+// Elastic round (HOROVOD_TPU_ELASTIC=1): three workers plus one parked
+// standby.  Process 2 dies without shutdown mid-run; instead of the abort
+// the first round latches, the coordinator must RECONFIGURE — survivors
+// bump to generation 1, the standby is admitted into the vacated slot,
+// the ring re-bootstraps, and an allreduce across the NEW membership must
+// still sum exactly.  Exercises park/admit, dense re-rank, membership
+// flush, data-plane rebuild, and the elastic metrics under the
+// sanitizers.  Forked into fresh children by main(), so the setenv calls
+// below never leak into the classic round.
+int RunElasticProcess(int pidx, int port) {
+  setenv("HOROVOD_TPU_ELASTIC", "1", 1);
+  setenv("HOROVOD_TPU_ELASTIC_MIN_RANKS", "1", 1);
+  // Single-host layout: the elastic round exercises the flat ring; the
+  // hierarchical paths already ran (and re-ran) in the classic round.
+  setenv("HOROVOD_TPU_HOST_FINGERPRINT", "smokeE", 1);
+  const bool standby = pidx >= kProcs;
+  if (standby) {
+    setenv("HOROVOD_TPU_STANDBY", "1", 1);
+    setenv("HOROVOD_TPU_STANDBY_WAIT_S", "60", 1);
+  }
+  // The standby's Create parks at the coordinator and only returns once
+  // the RECONFIGURE below admits it (already holding its new identity and
+  // a live ring); a standby that is never admitted gets nullptr.
+  auto cp = htpu::ControlPlane::Create(pidx, kProcs, "127.0.0.1", port,
+                                       /*first_rank=*/pidx,
+                                       /*nranks_total=*/kProcs,
+                                       /*timeout_ms=*/20000);
+  if (!cp) {
+    return Fail(pidx, standby ? "standby admission" : "elastic Create");
+  }
+
+  htpu::RequestList idle;
+  std::string tick_blob, resp;
+  htpu::SerializeRequestList(idle, &tick_blob);
+
+  if (!standby) {
+    // Healthy ticks + one allreduce across the original membership.
+    for (int i = 0; i < 3; ++i) {
+      if (!cp->Tick(tick_blob, 0, &resp)) return Fail(pidx, "elastic tick");
+    }
+    std::vector<float> buf(512, float(pidx + 1));
+    if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                          int64_t(buf.size() * sizeof(float)), "")) {
+      return Fail(pidx, "pre-loss allreduce");
+    }
+    for (float v : buf) {
+      if (std::fabs(v - 6.0f) > 0.01f) return Fail(pidx, "pre-loss value");
+    }
+
+    // Rank loss: process 2 dies without shutdown (same failure the classic
+    // round turns into an abort).
+    if (pidx == 2) {
+      fflush(nullptr);
+      _exit(0);
+    }
+    int32_t mp = -1, pc = -1, fr = -1, gen = -1;
+    for (int i = 0; i < 2000; ++i) {
+      cp->Membership(&mp, &pc, &fr, &gen);
+      if (gen >= 1) break;
+      if (cp->aborted()) return Fail(pidx, "aborted instead of reconfiguring");
+      if (!cp->Tick(tick_blob, 0, &resp)) return Fail(pidx, "reconfig tick");
+    }
+    cp->Membership(&mp, &pc, &fr, &gen);
+    if (gen != 1) return Fail(pidx, "generation never bumped");
+  }
+
+  // All three members of the new world: identity must be the dense
+  // re-rank (survivors keep 0/1, the standby fills slot 2) at the same
+  // size and generation, with no abort latched anywhere.
+  int32_t mp = -1, pc = -1, fr = -1, gen = -1;
+  cp->Membership(&mp, &pc, &fr, &gen);
+  if (pc != kProcs || gen != 1) return Fail(pidx, "post-reconfigure world");
+  if (standby && (mp != 2 || fr != 2)) return Fail(pidx, "standby slot");
+  if (cp->aborted()) return Fail(pidx, "abort latched after reconfigure");
+
+  // The rebuilt plane must negotiate and reduce exactly: contributions
+  // keyed by the NEW process index still sum to 1 + 2 + 3 = 6.
+  for (int i = 0; i < 2; ++i) {
+    if (!cp->Tick(tick_blob, 0, &resp)) return Fail(pidx, "post-reconfig tick");
+  }
+  std::vector<float> buf(512, float(mp + 1));
+  if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                        int64_t(buf.size() * sizeof(float)), "")) {
+    return Fail(pidx, "post-reconfigure allreduce");
+  }
+  for (float v : buf) {
+    if (std::fabs(v - 6.0f) > 0.01f) {
+      return Fail(pidx, "post-reconfigure value");
+    }
+  }
+
+  // Elastic metrics on the members that lived through the reconfigure
+  // (the admitted standby only carries the generation gauge).
+  if (!standby) {
+    void* mbuf = nullptr;
+    int len = htpu_metrics_snapshot(&mbuf);
+    if (len <= 0 || !mbuf) return Fail(pidx, "elastic metrics snapshot");
+    std::string js(static_cast<const char*>(mbuf), size_t(len));
+    htpu_free(mbuf);
+    for (const char* key : {"\"elastic.reconfigs\":",
+                            "\"membership.generation\":"}) {
+      size_t at = js.find(key);
+      if (at == std::string::npos ||
+          atoll(js.c_str() + at + strlen(key)) < 1) {
+        return Fail(pidx, "elastic metric missing or zero");
+      }
+    }
+  }
+  fprintf(stderr, "smoke proc %d: elastic reconfigure OK (gen %d, pidx %d)\n",
+          pidx, gen, mp);
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -387,6 +500,35 @@ int main() {
     waitpid(pids[p], &st, 0);
     if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
       fprintf(stderr, "smoke: proc %d exited abnormally (status %d)\n", p, st);
+      rc = 1;
+    }
+  }
+  if (rc != 0) return rc;
+
+  // Round 2: the same rank-2 death under HOROVOD_TPU_ELASTIC=1 must
+  // reconfigure instead of aborting.  kProcs workers plus one standby;
+  // every child (the deliberately dying proc 2 included) must exit 0.
+  int eport = FreePort();
+  if (eport < 0) {
+    fprintf(stderr, "smoke: no free port for elastic round\n");
+    return 1;
+  }
+  pid_t epids[kProcs + 1];
+  for (int p = 0; p < kProcs + 1; ++p) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (pid == 0) _exit(RunElasticProcess(p, eport));
+    epids[p] = pid;
+  }
+  for (int p = 0; p < kProcs + 1; ++p) {
+    int st = 0;
+    waitpid(epids[p], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr, "smoke: elastic proc %d exited abnormally (status %d)\n",
+              p, st);
       rc = 1;
     }
   }

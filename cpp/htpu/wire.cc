@@ -154,7 +154,8 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
   const bool with_algo = AnyAlgo(l.requests);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
-                | (with_algo ? kFlagAlgoExt : 0);
+                | (with_algo ? kFlagAlgoExt : 0)
+                | (l.has_elastic_ext ? kFlagElasticExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
@@ -164,6 +165,7 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
     PutI32(out, l.cache_epoch);
     PutStr(out, l.cache_bits);
   }
+  if (l.has_elastic_ext) PutI32(out, l.generation);
 }
 
 bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
@@ -188,6 +190,11 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
     if (!GetI32(data, len, &pos, &out->cache_epoch)) return false;
     if (!GetStr(data, len, &pos, &out->cache_bits)) return false;
   }
+  out->has_elastic_ext = (flags & kFlagElasticExt) != 0;
+  out->generation = 0;
+  if (out->has_elastic_ext) {
+    if (!GetI32(data, len, &pos, &out->generation)) return false;
+  }
   return pos == len;
 }
 
@@ -196,7 +203,8 @@ void SerializeResponseList(const ResponseList& l, std::string* out) {
   const bool with_algo = AnyAlgo(l.responses);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
-                | (with_algo ? kFlagAlgoExt : 0);
+                | (with_algo ? kFlagAlgoExt : 0)
+                | (l.has_elastic_ext ? kFlagElasticExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
@@ -212,6 +220,20 @@ void SerializeResponseList(const ResponseList& l, std::string* out) {
     }
     PutI32(out, int32_t(l.cache_evictions.size()));
     for (int32_t s : l.cache_evictions) PutI32(out, s);
+  }
+  if (l.has_elastic_ext) {
+    PutI32(out, l.generation);
+    PutI8(out, l.reconfigure ? 1 : 0);
+    if (l.reconfigure) {
+      PutI32(out, l.lost_rank);
+      PutStr(out, l.lost_reason);
+      PutI32(out, int32_t(l.members.size()));
+      for (const auto& m : l.members) {
+        PutI32(out, m.old_pidx);
+        PutI32(out, m.new_pidx);
+        PutI32(out, m.first_rank);
+      }
+    }
   }
 }
 
@@ -250,6 +272,30 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
     out->cache_evictions.resize(size_t(n));
     for (int32_t i = 0; i < n; ++i)
       if (!GetI32(data, len, &pos, &out->cache_evictions[size_t(i)])) return false;
+  }
+  out->has_elastic_ext = (flags & kFlagElasticExt) != 0;
+  out->generation = 0;
+  out->reconfigure = false;
+  out->lost_rank = -1;
+  out->lost_reason.clear();
+  out->members.clear();
+  if (out->has_elastic_ext) {
+    uint8_t reconf;
+    if (!GetI32(data, len, &pos, &out->generation)) return false;
+    if (!GetI8(data, len, &pos, &reconf)) return false;
+    out->reconfigure = reconf != 0;
+    if (out->reconfigure) {
+      if (!GetI32(data, len, &pos, &out->lost_rank)) return false;
+      if (!GetStr(data, len, &pos, &out->lost_reason)) return false;
+      if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+      out->members.resize(size_t(n));
+      for (int32_t i = 0; i < n; ++i) {
+        auto& m = out->members[size_t(i)];
+        if (!GetI32(data, len, &pos, &m.old_pidx)) return false;
+        if (!GetI32(data, len, &pos, &m.new_pidx)) return false;
+        if (!GetI32(data, len, &pos, &m.first_rank)) return false;
+      }
+    }
   }
   return pos == len;
 }

@@ -16,10 +16,14 @@
 //             sizes:vec<i64> wire_dtype:str [algo:str]
 // RequestList  := flags:i8 abort_rank:i32 abort_reason:str
 //                 requests:vec<Request> [cache_epoch:i32 bits:str]
+//                 [generation:i32]
 // ResponseList := flags:i8 abort_rank:i32 abort_reason:str
 //                 responses:vec<Response>
 //                 [cache_epoch:i32 cflags:i8
 //                  assignments:vec<slot:i32 name:str> evictions:vec<i32>]
+//                 [generation:i32 reconfigure:i8
+//                  (lost_rank:i32 lost_reason:str
+//                   members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)]
 //
 // flags was historically the shutdown bool, so legacy frames (including
 // abort frames) decode unchanged: bit 0 = shutdown, bit 1 = the trailing
@@ -52,7 +56,11 @@ namespace htpu {
 constexpr uint8_t kFlagShutdown = 0x01;
 constexpr uint8_t kFlagCacheExt = 0x02;
 constexpr uint8_t kFlagAlgoExt = 0x04;
-constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt | kFlagAlgoExt;
+// Elastic-membership extension (HOROVOD_TPU_ELASTIC=1 only — non-elastic
+// frames never set the bit, so PR 2 abort traffic stays byte-identical).
+constexpr uint8_t kFlagElasticExt = 0x08;
+constexpr uint8_t kKnownFlags =
+    kFlagShutdown | kFlagCacheExt | kFlagAlgoExt | kFlagElasticExt;
 constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
 constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
 constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits
@@ -113,6 +121,20 @@ struct RequestList {
   bool has_cache_ext = false;
   int32_t cache_epoch = 0;
   std::string cache_bits;
+  // Elastic-membership extension (serialized only when has_elastic_ext):
+  // the sender's membership generation.  The coordinator rejects frames
+  // from a stale generation (a worker that missed a RECONFIGURE).
+  bool has_elastic_ext = false;
+  int32_t generation = 0;
+};
+
+// One membership row of a RECONFIGURE frame: where the process identified
+// by `old_pidx` (its pre-reconfigure process index; admitted standbys use
+// their negative standby id) lands in the new membership.
+struct ElasticMember {
+  int32_t old_pidx = -1;
+  int32_t new_pidx = -1;
+  int32_t first_rank = -1;
 };
 
 struct ResponseList {
@@ -129,6 +151,17 @@ struct ResponseList {
   uint8_t cache_flags = 0;
   std::vector<std::pair<int32_t, std::string>> cache_assignments;
   std::vector<int32_t> cache_evictions;
+  // Elastic-membership extension (serialized only when has_elastic_ext):
+  // the coordinator's generation, plus — when `reconfigure` — the full
+  // RECONFIGURE payload: which rank was lost and why, and the survivor /
+  // standby re-ranking table.  A receiver absent from `members` has been
+  // evicted and must abort itself.
+  bool has_elastic_ext = false;
+  int32_t generation = 0;
+  bool reconfigure = false;
+  int32_t lost_rank = -1;
+  std::string lost_reason;
+  std::vector<ElasticMember> members;
 };
 
 // Serialization. Append to / read from a byte buffer.  `with_algo`

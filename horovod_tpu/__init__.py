@@ -70,8 +70,9 @@ from horovod_tpu import metrics        # noqa: F401, E402
 from horovod_tpu.ops.eager import (        # noqa: F401
     allreduce, allreduce_async, allgather, allgather_async, broadcast,
     broadcast_async, poll, synchronize, PerRank, scatter_ranks,
-    CollectiveError, HorovodAbortedError,
+    CollectiveError, HorovodAbortedError, HorovodRetryableError,
 )
+from horovod_tpu import elastic            # noqa: F401, E402
 from horovod_tpu.ops import injit          # noqa: F401
 from horovod_tpu.ops.injit import (        # noqa: F401
     SUM, AVERAGE, MIN, MAX,

@@ -81,6 +81,10 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         _state.mesh = _mesh_mod.build_ranks_mesh(_state.topology)
         from horovod_tpu import core as _core_mod
         _state.controller = _core_mod.Controller(_state.topology, _state.mesh)
+        # Elastic standby: the controller adopted the identity the
+        # coordinator assigned at admission (process index, rank, world
+        # size) — the env-derived snapshot above is a placeholder.
+        _state.topology = _state.controller.topology
         # Multi-process: the controller's layout exchange discovered which
         # processes share this host (reference: shared-memory comm split,
         # operations.cc:1499-1509); fold that into the topology so

@@ -24,6 +24,7 @@ import importlib
 import json
 import os
 import re
+import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 from horovod_tpu import basics
@@ -47,8 +48,42 @@ def save(directory: str, state: Any, epoch: int) -> Optional[str]:
     if basics.rank() != 0:
         return None
     path = checkpoint_path(directory, epoch)
+    # World-size sidecar lands BEFORE the checkpoint commits (same
+    # ordering argument as the optimizer spec): an elastic resume that
+    # sees checkpoint-N can always tell what world wrote it.
+    os.makedirs(os.path.abspath(directory), exist_ok=True)
+    with open(_world_meta_path(directory, epoch), "w") as f:
+        json.dump({"world_size": basics.size(),
+                   "process_count": basics.process_count()}, f)
     _checkpointer().save(path, state, force=True)
     return path
+
+
+def _world_meta_path(directory: str, epoch: int) -> str:
+    return checkpoint_path(directory, epoch) + ".world.json"
+
+
+def saved_world_size(directory: str, epoch: int) -> int:
+    """World size recorded when checkpoint ``epoch`` was written, or -1
+    for checkpoints predating the sidecar (or an unreadable one)."""
+    p = _world_meta_path(directory, epoch)
+    try:
+        with open(p) as f:
+            return int(json.load(f).get("world_size", -1))
+    except (OSError, ValueError):
+        return -1
+
+
+def _sharded_leaf_path(tree) -> Optional[str]:
+    """Path of the first leaf laid out across devices (not fully
+    replicated), or None.  Such state is bound to a specific world shape
+    and cannot survive an elastic world-size change."""
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+    for path, leaf in tree_flatten_with_path(tree)[0]:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_replicated:
+            return keystr(path)
+    return None
 
 
 def latest_epoch(directory: str) -> int:
@@ -391,6 +426,34 @@ def restore_and_broadcast(directory: str, like: Any,
         epoch = int(np.asarray(eager.broadcast(
             np.asarray(epoch, np.int64), root_rank,
             name="ckpt.resume_epoch")))
+    if epoch >= 0:
+        # Elastic resume: the world that wrote the checkpoint may be gone
+        # (a rank was lost and the job reconfigured).  Replicated state
+        # re-broadcasts from root at ANY world size; state laid out across
+        # devices is bound to the old world shape and must fail with a
+        # named leaf, not a shape error deep inside orbax.
+        saved = (saved_world_size(directory, epoch)
+                 if basics.rank() == root_rank else -1)
+        saved = int(np.asarray(eager.broadcast(
+            np.asarray(saved, np.int64), root_rank,
+            name="ckpt.world_size")))
+        cur = basics.size()
+        if saved >= 0 and saved != cur:
+            bad = _sharded_leaf_path(like)
+            if bad is not None:
+                raise ValueError(
+                    f"restore_and_broadcast: checkpoint-{epoch} in "
+                    f"{directory!r} was saved at world size {saved} but "
+                    f"the job is now size {cur}, and template leaf "
+                    f"{bad!r} is sharded across devices — sharded state "
+                    "cannot be re-laid-out across a different world; "
+                    "only replicated state survives an elastic "
+                    "world-size change (see docs/elasticity.md)")
+            print(
+                f"horovod_tpu checkpoint: checkpoint-{epoch} was written "
+                f"at world size {saved}; restoring into world size {cur} "
+                f"— replicated state re-broadcast from rank {root_rank}",
+                file=sys.stderr)
     if optional_keys and not isinstance(like, dict):
         # Fail on the FIRST call, not on the first resume after a
         # checkpoint exists.

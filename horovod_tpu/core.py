@@ -30,8 +30,10 @@ import collections
 import dataclasses
 import enum
 import os
+import sys
 import threading
 import time
+import traceback
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -49,6 +51,11 @@ class StatusType(enum.IntEnum):
     PRECONDITION_ERROR = 2
     ABORTED = 3
     INVALID_ARGUMENT = 4
+    # Elastic membership changed while this collective was in flight: the
+    # operation did NOT complete, but the job survives — restore from the
+    # latest checkpoint and resubmit (HorovodRetryableError, not
+    # HorovodAbortedError).
+    RETRYABLE = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +77,10 @@ class Status:
     @staticmethod
     def aborted(msg: str) -> "Status":
         return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def retryable(msg: str) -> "Status":
+        return Status(StatusType.RETRYABLE, msg)
 
     @staticmethod
     def invalid_argument(msg: str) -> "Status":
@@ -101,16 +112,19 @@ class FaultSpec:
     the fault on the tick thread; this Python-side parse exists to reject
     malformed specs loudly at init() instead of silently never firing.
     """
-    mode: str      # "crash" | "hang" | "drop_conn"
+    mode: str      # "crash" | "hang" | "drop_conn" | "rejoin"
     rank: int      # first global rank of the target process
     tick: int      # 1-based negotiation tick on which the fault fires
 
 
-_FAULT_MODES = ("crash", "hang", "drop_conn")
+_FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin")
 
 
 def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
-    """Strictly parse a fault spec; None for empty, ValueError on malformed."""
+    """Strictly parse ONE fault spec; None for empty, ValueError on
+    malformed.  ``rejoin`` arms the coordinator to admit parked standby
+    workers at the first tick >= T (elastic mode's deterministic readmit
+    trigger)."""
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -118,14 +132,14 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
     if len(parts) != 3 or parts[0] not in _FAULT_MODES:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
-            "'<crash|hang|drop_conn>:rank=<R>:tick=<T>'.")
+            "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>'.")
     kv = {}
     for part in parts[1:]:
         key, sep, val = part.partition("=")
         if not sep or key not in ("rank", "tick") or key in kv:
             raise ValueError(
                 f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
-                "'<crash|hang|drop_conn>:rank=<R>:tick=<T>'.")
+                "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>'.")
         try:
             kv[key] = int(val)
         except ValueError:
@@ -144,6 +158,18 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
             "(ticks are counted from 1).")
     return FaultSpec(parts[0], kv["rank"], kv["tick"])
+
+
+def parse_fault_specs(value: str) -> List[FaultSpec]:
+    """Parse a full HOROVOD_TPU_FAULT value: one spec, or several separated
+    by ';' (elastic scenarios script a kill and a later readmit together,
+    e.g. ``crash:rank=1:tick=30;rejoin:rank=0:tick=60``)."""
+    out: List[FaultSpec] = []
+    for piece in (value or "").split(";"):
+        parsed = parse_fault_spec(piece)
+        if parsed is not None:
+            out.append(parsed)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -903,7 +929,7 @@ class Controller:
         # Fail fast on malformed fault specs: the native core parses the
         # same variable leniently (warn + ignore), which would make a typo'd
         # injection test silently pass.
-        parse_fault_spec(os.environ.get("HOROVOD_TPU_FAULT", ""))
+        parse_fault_specs(os.environ.get("HOROVOD_TPU_FAULT", ""))
 
         # Native core (cpp/htpu): message table, fusion planner and timeline
         # run in C++ when the shared library is available; the Python classes
@@ -941,33 +967,63 @@ class Controller:
                 topology.process_index, topology.process_count,
                 host or "127.0.0.1", int(port), topology.rank,
                 topology.size, timeout_ms)
-            # Exchange the process layout once: (process_index, first_rank,
-            # local_size, host fingerprint) per process -> global
-            # rank->process map plus host grouping (the reference gets both
-            # from MPI comm splits, operations.cc:1499-1532; boot-id
-            # fingerprint equality is the TPU-native stand-in for
-            # MPI_Comm_split_type(SHARED) — hostname alone is ambiguous,
-            # see topology.host_fingerprint).
-            import struct
-            from horovod_tpu.topology import host_fingerprint
-            my_host = host_fingerprint(warn_truncation=True).encode()[:64]
-            mine = struct.pack("<3i64s", topology.process_index,
-                               topology.rank, topology.local_size, my_host)
-            blob = self._control.allgather(mine)
-            host_procs = []
-            all_hosts = set()
-            for off in range(0, len(blob), 76):
-                pidx, frank, lsize, host = struct.unpack_from(
-                    "<3i64s", blob, off)
-                for r in range(frank, frank + lsize):
-                    self._rank_to_process[r] = pidx
-                all_hosts.add(host.rstrip(b"\0"))
-                if host.rstrip(b"\0") == my_host.rstrip(b"\0"):
-                    host_procs.append(pidx)
-            host_procs.sort()
-            self.host_local_rank = host_procs.index(topology.process_index)
-            self.host_local_size = len(host_procs)
-            self.num_hosts = len(all_hosts)
+            if (os.environ.get("HOROVOD_TPU_STANDBY") == "1"
+                    and self._control.elastic()):
+                # Admitted standby: the native Create() blocked until the
+                # elastic coordinator seated this process into a live
+                # generation — adopt the identity it assigned.  The
+                # init-time layout exchange below is impossible here (the
+                # survivors are mid-training, not parked in an init
+                # collective), so the rank map comes from the dense
+                # re-rank arithmetic elastic mode guarantees.
+                pidx, pcount, first_rank, generation = (
+                    self._control.membership())
+                lsize = topology.local_size
+                topology = dataclasses.replace(
+                    topology, process_index=pidx, process_count=pcount,
+                    rank_override=first_rank,
+                    size_override=pcount * lsize)
+                self.topology = topology
+                self.size = topology.size
+                for r in range(pcount * lsize):
+                    self._rank_to_process[r] = r // lsize
+                _metrics.registry.set_gauge("membership.generation",
+                                            generation)
+                print(f"horovod_tpu elastic: standby admitted at "
+                      f"generation {generation} as rank {first_rank} "
+                      f"of {topology.size} (process {pidx} of {pcount})",
+                      file=sys.stderr)
+            else:
+                # Exchange the process layout once: (process_index,
+                # first_rank, local_size, host fingerprint) per process ->
+                # global rank->process map plus host grouping (the
+                # reference gets both from MPI comm splits,
+                # operations.cc:1499-1532; boot-id fingerprint equality is
+                # the TPU-native stand-in for MPI_Comm_split_type(SHARED)
+                # — hostname alone is ambiguous, see
+                # topology.host_fingerprint).
+                import struct
+                from horovod_tpu.topology import host_fingerprint
+                my_host = host_fingerprint(warn_truncation=True).encode()[:64]
+                mine = struct.pack("<3i64s", topology.process_index,
+                                   topology.rank, topology.local_size,
+                                   my_host)
+                blob = self._control.allgather(mine)
+                host_procs = []
+                all_hosts = set()
+                for off in range(0, len(blob), 76):
+                    pidx, frank, lsize, host = struct.unpack_from(
+                        "<3i64s", blob, off)
+                    for r in range(frank, frank + lsize):
+                        self._rank_to_process[r] = pidx
+                    all_hosts.add(host.rstrip(b"\0"))
+                    if host.rstrip(b"\0") == my_host.rstrip(b"\0"):
+                        host_procs.append(pidx)
+                host_procs.sort()
+                self.host_local_rank = host_procs.index(
+                    topology.process_index)
+                self.host_local_size = len(host_procs)
+                self.num_hosts = len(all_hosts)
         elif self.jit_only:
             # Host grouping without a control plane: the only cross-process
             # channel in jit-only mode is XLA itself, so allgather each
@@ -1250,6 +1306,12 @@ class Controller:
             try:
                 remote_shutdown = self._run_loop_once_distributed(shutting)
             except Exception as exc:   # noqa: BLE001
+                # The tick loop is dying — without it every later enqueue
+                # fails with the generic shut-down text, so name the real
+                # cause here (outstanding entries get it attributed too).
+                traceback.print_exc()
+                print(f"horovod_tpu: control tick loop failed: {exc!r}",
+                      file=sys.stderr)
                 self._fail_all(Status(StatusType.UNKNOWN_ERROR, repr(exc)))
                 self._shutdown.set()
                 return
@@ -1289,13 +1351,21 @@ class Controller:
             pending, shutdown=shutting,
             abort_rank=abort_rank, abort_reason=abort_reason)
         resp_blob = self._control.tick(blob, self.fusion_threshold)
-        responses, remote_shutdown, abort = wire.parse_response_list(resp_blob)
+        (responses, remote_shutdown, abort, _cache_ext,
+         elastic_ext) = wire.parse_response_list_elastic(resp_blob)
         if abort is not None:
             # Coordinator-broadcast ABORT (or a locally synthesized one when
             # the coordinator link itself died).  Latch, fail everything
             # with the attributed cause, and leave the tick loop.
             self._handle_abort(*abort)
             return True
+        if elastic_ext is not None and elastic_ext.reconfigure:
+            # Membership change (RECONFIGURE broadcast).  The native plane
+            # already re-ranked and re-bootstrapped inside Tick; adopt the
+            # new identity and KEEP ticking — survivors resume, they don't
+            # abort.
+            self._handle_reconfigure(elastic_ext)
+            return False
         ready = []
         for resp in responses:
             with self._lock:
@@ -1379,6 +1449,77 @@ class Controller:
                 status = self._abort_status
             self._shutdown.set()
         self._fail_all(status)
+
+    def _handle_reconfigure(self, ext):
+        """Adopt a membership change broadcast by the elastic coordinator.
+
+        By the time Tick returned the RECONFIGURE frame, the native plane
+        has already re-ranked the survivors, re-bootstrapped the data
+        plane and flushed its response cache.  The Python side quiesces:
+        every in-flight entry completes RETRYABLE (the elastic driver
+        restores from the latest checkpoint and re-submits — these
+        collectives negotiated against a world that no longer exists),
+        local negotiation state is dropped, and the controller re-reads
+        its identity from the native plane so ``hvd.rank()``/``size()``
+        report the post-reconfigure world."""
+        from horovod_tpu import cpp_core
+        if ext.lost_rank >= 0:
+            cause = (f"rank {ext.lost_rank} was lost "
+                     f"({ext.lost_reason or 'no reason recorded'})")
+        else:
+            cause = ext.lost_reason or "membership changed"
+        status = Status.retryable(
+            f"Horovod membership reconfigured at generation "
+            f"{ext.generation}: {cause}. Restore from the latest "
+            "checkpoint and retry.")
+        # Completes in-flight entries, clears the queue/tensor table and
+        # negotiation state — same quiesce as an abort, different status.
+        self._fail_all(status)
+        with self._lock:
+            # Failure reports attributed under the OLD generation must not
+            # ride the next tick — the coordinator already acted on them.
+            self._pending_report = None
+            self._last_reported = None
+            self._stall_warned.clear()
+        pidx, pcount, first_rank, generation = self._control.membership()
+        lsize = self.topology.local_size
+        new_size = pcount * lsize
+        self.topology = dataclasses.replace(
+            self.topology, process_index=pidx, process_count=pcount,
+            rank_override=first_rank, size_override=new_size)
+        self.size = new_size
+        # Dense re-rank: uniform ranks-per-process is an elastic-mode
+        # precondition (the native plane refuses elastic otherwise), so the
+        # rank map is pure arithmetic — no layout re-exchange over a ring
+        # whose peers are mid-training.
+        self._rank_to_process.clear()
+        for r in range(new_size):
+            self._rank_to_process[r] = r // lsize
+        ex = getattr(self, "_executor", None)
+        if ex is not None:
+            ex.topology = self.topology
+            ex.nranks = new_size
+        # The local message table is idle in distributed mode, but keep it
+        # sized to the live world so readiness counts stay correct if it is
+        # ever consulted.
+        if self._use_cpp:
+            self._message_table = cpp_core.CppMessageTable(
+                new_size, self.timeline)
+        else:
+            self._message_table = MessageTable(new_size, self.timeline)
+        self._message_table.configure_algo_selection(
+            self.num_hosts, pcount, algo_crossover_bytes())
+        # Fold into the framework-global snapshot so rank()/size() queries
+        # report the new identity.
+        from horovod_tpu import basics
+        if basics._state.controller is self:
+            basics._state.topology = self.topology
+        _metrics.registry.set_gauge("membership.generation", generation)
+        cpp_core.flight_record(
+            "elastic.adopted", f"gen={generation}", first_rank, new_size)
+        print(f"horovod_tpu elastic: continuing at generation {generation} "
+              f"as rank {first_rank} of {new_size} "
+              f"(process {pidx} of {pcount})", file=sys.stderr)
 
     def _maybe_check_stalls_distributed(self):
         if self.stall_check_disabled or self.topology.process_index != 0:

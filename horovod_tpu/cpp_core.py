@@ -162,6 +162,14 @@ def _configure(lib) -> None:
     lib.htpu_control_data_bytes.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong)]
+    if hasattr(lib, "htpu_control_membership"):
+        lib.htpu_control_membership.restype = None
+        lib.htpu_control_membership.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.htpu_control_elastic.restype = ctypes.c_int
+        lib.htpu_control_elastic.argtypes = [ctypes.c_void_p]
     lib.htpu_control_ring_transport.restype = ctypes.c_char_p
     lib.htpu_control_ring_transport.argtypes = [ctypes.c_void_p]
     lib.htpu_control_set_timeline.restype = None
@@ -612,6 +620,30 @@ class CppControlPlane:
         n = self._lib.htpu_control_stalled(self._ptr, age_s,
                                            ctypes.byref(out))
         return _parse_stall_records(_take_buffer(self._lib, out, n))
+
+    def membership(self):
+        """Current elastic membership identity of this process:
+        ``(process_index, process_count, first_rank, generation)``.  All
+        four change together on a RECONFIGURE — re-read after any tick
+        whose response carried a reconfigure payload.  Generation is 0
+        (and the rest Create-time constants) on non-elastic planes or an
+        older native core."""
+        if not hasattr(self._lib, "htpu_control_membership"):
+            return -1, -1, -1, 0
+        pi = ctypes.c_int()
+        pc = ctypes.c_int()
+        fr = ctypes.c_int()
+        gen = ctypes.c_int()
+        self._lib.htpu_control_membership(
+            self._ptr, ctypes.byref(pi), ctypes.byref(pc), ctypes.byref(fr),
+            ctypes.byref(gen))
+        return pi.value, pc.value, fr.value, gen.value
+
+    def elastic(self) -> bool:
+        """True when HOROVOD_TPU_ELASTIC=1 was honoured by this plane."""
+        if not hasattr(self._lib, "htpu_control_elastic"):
+            return False
+        return bool(self._lib.htpu_control_elastic(self._ptr))
 
     def last_error(self):
         """Attribution of the most recent native failure on this process:

@@ -1,0 +1,124 @@
+"""Elastic membership: survive rank loss by reconfiguring, not aborting.
+
+With ``HOROVOD_TPU_ELASTIC=1`` the coordinator reacts to a confirmed-dead
+rank by broadcasting RECONFIGURE instead of ABORT: survivors quiesce their
+in-flight collectives (completed RETRYABLE, not ABORTED), ranks are
+re-assigned densely (optionally admitting parked standbys launched with
+``run.py --elastic --num-standby=N``), the data plane is re-bootstrapped,
+and the job resumes under a bumped **membership generation** — every
+control frame carries the generation, so stragglers from the old world are
+rejected rather than corrupting the new one.
+
+State machine (per process; see docs/elasticity.md for the full matrix)::
+
+    RUN -> QUIESCE -> RERANK -> REBOOTSTRAP -> RESTORE -> RUN
+
+The native plane (cpp/htpu/control.cc) owns QUIESCE/RERANK/REBOOTSTRAP;
+this module owns RESTORE: :func:`run_elastic` re-enters the training
+function from the latest checkpoint whenever a collective completes with
+:class:`~horovod_tpu.ops.eager.HorovodRetryableError`.
+
+Falls back to the classic abort path (PR 2 semantics, byte-identical
+wire frames) when elastic mode is off or the surviving world would drop
+below ``HOROVOD_TPU_ELASTIC_MIN_RANKS``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from horovod_tpu import basics
+
+
+def enabled() -> bool:
+    """True when this process runs in elastic mode
+    (``HOROVOD_TPU_ELASTIC=1``)."""
+    return os.environ.get("HOROVOD_TPU_ELASTIC", "") == "1"
+
+
+def min_ranks() -> int:
+    """Smallest world size a reconfiguration may shrink to
+    (``HOROVOD_TPU_ELASTIC_MIN_RANKS``, default 1); below it the job
+    aborts with the original attributed failure."""
+    return int(os.environ.get("HOROVOD_TPU_ELASTIC_MIN_RANKS", "1"))
+
+
+def is_standby() -> bool:
+    """True when this process was launched as a parked standby
+    (``HOROVOD_TPU_STANDBY=1``): it holds no rank until a
+    reconfiguration admits it."""
+    return os.environ.get("HOROVOD_TPU_STANDBY", "") == "1"
+
+
+def generation() -> int:
+    """Current membership generation: 0 until the first reconfiguration,
+    bumped once per membership change; -1 before init or when no native
+    control plane is attached (single-process jobs)."""
+    if not basics.is_initialized():
+        return -1
+    ctl = getattr(basics.controller(), "_control", None)
+    if ctl is None:
+        return -1
+    return ctl.membership()[3]
+
+
+def init(ranks: Optional[Sequence[int]] = None) -> None:
+    """``hvd.init()`` for elastic jobs.
+
+    Identical to :func:`horovod_tpu.init` except for standbys: a standby
+    whose admission wait expires without a seat (the job finished healthy
+    and never needed it) exits 0 instead of raising — a spare that was
+    never used is success, not failure.
+    """
+    try:
+        basics.init(ranks)
+    except Exception as exc:   # noqa: BLE001 — an unseated spare has no job
+        if is_standby():
+            print(f"horovod_tpu elastic: standby never admitted ({exc}); "
+                  "exiting cleanly", file=sys.stderr)
+            raise SystemExit(0)
+        raise
+
+
+def run_elastic(train: Callable[[Any, int], Any], *, directory: str,
+                like: Any, root_rank: int = 0,
+                optional_keys: Tuple[str, ...] = (),
+                max_reconfigures: int = 32) -> Any:
+    """Drive a training function across membership changes.
+
+    ``train(state, resume_epoch)`` is entered with ``state`` restored
+    from the latest checkpoint in ``directory`` (``like`` is the pytree
+    template; ``resume_epoch`` is -1 on a fresh start) and re-entered —
+    freshly restored — every time it raises
+    :class:`~horovod_tpu.ops.eager.HorovodRetryableError`, i.e. every
+    time the membership reconfigured under it.  ``train`` should
+    checkpoint periodically with :func:`horovod_tpu.checkpoint.save`;
+    work since the last checkpoint is replayed after a reconfiguration.
+
+    Returns ``train``'s return value.  Aborts
+    (:class:`~horovod_tpu.ops.eager.HorovodAbortedError`) and every other
+    exception propagate unchanged — only membership changes retry.
+    """
+    from horovod_tpu import checkpoint
+    from horovod_tpu.ops.eager import HorovodRetryableError
+
+    attempts = 0
+    while True:
+        # The restore itself runs collectives (epoch agreement + parameter
+        # broadcast), so a membership change landing mid-restore retries
+        # the same way one landing mid-train does.
+        try:
+            state, epoch = checkpoint.restore_and_broadcast(
+                directory, like, root_rank=root_rank,
+                optional_keys=optional_keys)
+            return train(state, epoch)
+        except HorovodRetryableError as exc:
+            attempts += 1
+            if attempts > max_reconfigures:
+                raise
+            print(f"horovod_tpu elastic: membership changed (generation "
+                  f"{generation()}): {exc}; restoring from "
+                  f"{directory!r} and re-entering train "
+                  f"(reconfiguration {attempts})", file=sys.stderr)

@@ -53,6 +53,16 @@ class HorovodAbortedError(CollectiveError):
     :class:`CollectiveError` so existing handlers keep working."""
 
 
+class HorovodRetryableError(CollectiveError):
+    """The collective was quiesced by an elastic membership change
+    (``HOROVOD_TPU_ELASTIC=1``): a rank was lost (or a standby admitted)
+    and the job reconfigured instead of aborting.  The op did NOT run —
+    restore model state from the latest checkpoint and re-submit under
+    the new membership (see :func:`horovod_tpu.elastic.run_elastic` and
+    docs/elasticity.md).  Subclasses :class:`CollectiveError` so
+    existing handlers keep working."""
+
+
 _name_counter = [0]
 
 
@@ -218,6 +228,8 @@ def synchronize(handle: int, timeout: Optional[float] = 300.0,
     if not status.ok():
         if status.type == StatusType.ABORTED:
             raise HorovodAbortedError(status.reason)
+        if status.type == StatusType.RETRYABLE:
+            raise HorovodRetryableError(status.reason)
         raise CollectiveError(status.reason)
     return result
 

@@ -451,12 +451,32 @@ class DistributedExecutor(Executor):
         """A TCP data-plane failure means a peer process died mid-collective:
         attribute it to the ring neighbour the native core recorded, so this
         rank's error carries the same (rank, reason) every other rank will
-        get from the coordinator's ABORT broadcast."""
+        get from the coordinator's ABORT broadcast.
+
+        In elastic mode the same failure is the RECONFIGURE trigger, not a
+        job abort: the op is quiesced RETRYABLE so the driver restores and
+        retries under the new membership.  A natively latched abort (reason
+        prefixed ``job aborted:``, e.g. the loss would shrink the world
+        below HOROVOD_TPU_ELASTIC_MIN_RANKS) still outranks — and if the
+        coordinator only decides to abort on its next gather, the retry
+        fails with that attributed abort instead."""
         if isinstance(exc, ConnectionError):
             try:
                 rank, reason = self._control.last_error()
             except Exception:   # noqa: BLE001 — attribution is best-effort
                 rank, reason = -1, ""
+            latched = reason.startswith("job aborted:")
+            try:
+                elastic = not latched and self._control.elastic()
+            except Exception:   # noqa: BLE001 — pure-python control plane
+                elastic = False
+            if elastic:
+                cause = (f"rank {rank} failed: {reason}"
+                         if rank >= 0 and reason else str(exc) or repr(exc))
+                return Status.retryable(
+                    "Horovod membership changing: in-flight collective "
+                    f"quiesced ({cause}). Restore from the latest "
+                    "checkpoint and retry.")
             if rank >= 0 and reason:
                 return Status.aborted(
                     f"Horovod job aborted: rank {rank} failed: {reason}")

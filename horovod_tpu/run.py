@@ -63,9 +63,28 @@ def main(argv=None):
                    help="seconds survivors get to exit on their own after a "
                         "process fails (the abort broadcast normally takes "
                         "them down) before SIGTERM, then SIGKILL")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic membership (sets HOROVOD_TPU_ELASTIC=1 in "
+                        "every child): a lost rank reconfigures the job "
+                        "instead of aborting it, and crashed children are "
+                        "relaunched as parked standbys (docs/elasticity.md)")
+    p.add_argument("--num-standby", type=int, default=0,
+                   help="parked standby processes launched alongside the "
+                        "job (elastic mode only): hold no rank until a "
+                        "reconfiguration admits them")
+    p.add_argument("--elastic-min-ranks", type=int, default=0,
+                   help="floor for elastic shrink (sets "
+                        "HOROVOD_TPU_ELASTIC_MIN_RANKS); a loss that would "
+                        "drop the world below it aborts classically")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="total crashed children relaunched as standbys "
+                        "before the launcher stops replacing them "
+                        "(elastic mode)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to run (prefix with --)")
     args = p.parse_args(argv)
+    if not args.elastic and args.num_standby:
+        p.error("--num-standby requires --elastic")
 
     cmd = args.command
     if cmd and cmd[0] == "--":
@@ -78,9 +97,7 @@ def main(argv=None):
     rpp = args.ranks_per_process
     size = nproc_total * rpp
 
-    procs = []
-    for i in range(args.num_proc):
-        pidx = args.process_index_base + i
+    def child_env(pidx: int, standby: bool = False) -> dict:
         env = dict(os.environ)
         env.update({
             "HOROVOD_TPU_COORD_ADDR": coord,
@@ -90,6 +107,13 @@ def main(argv=None):
             "HOROVOD_TPU_RANK": str(pidx * rpp),
             "HOROVOD_TPU_LOCAL_SIZE": str(rpp),
         })
+        if args.elastic:
+            env["HOROVOD_TPU_ELASTIC"] = "1"
+            if args.elastic_min_ranks > 0:
+                env["HOROVOD_TPU_ELASTIC_MIN_RANKS"] = str(
+                    args.elastic_min_ranks)
+        if standby:
+            env["HOROVOD_TPU_STANDBY"] = "1"
         if args.metrics_every > 0:
             env["HOROVOD_TPU_METRICS_EVERY_S"] = str(args.metrics_every)
         if args.metrics_port > 0:
@@ -102,7 +126,36 @@ def main(argv=None):
             from horovod_tpu.timeline import per_rank_trace_path
             env["HOROVOD_TPU_TIMELINE"] = per_rank_trace_path(
                 env["HOROVOD_TPU_TIMELINE"], pidx * rpp, size)
-        procs.append(subprocess.Popen(cmd, env=env))
+        return env
+
+    procs = [
+        subprocess.Popen(cmd, env=child_env(args.process_index_base + i))
+        for i in range(args.num_proc)]
+
+    if args.elastic:
+        # Standby process indices live above the worker range so each
+        # spare handshakes with a unique, nonzero index; the coordinator
+        # assigns the real rank at admission.
+        standbys = []
+        next_standby_pidx = [max(nproc_total,
+                                 args.process_index_base + args.num_proc)]
+
+        def spawn_standby():
+            pidx = next_standby_pidx[0]
+            next_standby_pidx[0] += 1
+            sb = subprocess.Popen(cmd, env=child_env(pidx, standby=True))
+            standbys.append(sb)
+            return sb
+
+        for _ in range(args.num_standby):
+            spawn_standby()
+        try:
+            return _supervise_elastic(procs, standbys, spawn_standby,
+                                      args.max_restarts,
+                                      args.kill_on_failure_grace)
+        except KeyboardInterrupt:
+            _reap(procs + standbys, sig=signal.SIGTERM, grace_s=5.0)
+            return 130
 
     # Fast-fail supervision (mpirun semantics): poll ALL children
     # concurrently; the moment one exits non-zero, give the survivors a
@@ -145,6 +198,57 @@ def _supervise(procs, grace_s: float) -> int:
                       file=sys.stderr)
             _reap(procs, sig=signal.SIGTERM, grace_s=5.0)
             return first_rc
+        time.sleep(0.1)
+
+
+def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
+                       grace_s: float) -> int:
+    """Elastic supervision: a non-coordinator crash is survivable (the job
+    reconfigures around it), so instead of the fast-fail grace window the
+    crashed child is relaunched as a parked standby — ready to be admitted
+    back at the next membership change.  The job's outcome is the
+    coordinator's exit code (process 0 cannot be lost elastically), and
+    standby exits never fail the job: an unused spare exiting 0 is
+    success, a reaped one is teardown."""
+    restarts = 0
+    handled = set()
+    coord_done_at = None
+    while True:
+        workers_running = False
+        for i, proc in enumerate(procs):
+            rc = proc.poll()
+            if rc is None:
+                workers_running = True
+            elif i > 0 and rc != 0 and i not in handled:
+                handled.add(i)
+                if restarts < max_restarts:
+                    restarts += 1
+                    sb = spawn_standby()
+                    print(f"horovod_tpu.run: process {i} (pid {proc.pid}) "
+                          f"exited with code {rc}; elastic mode — "
+                          f"relaunched as standby pid {sb.pid} "
+                          f"(restart {restarts}/{max_restarts})",
+                          file=sys.stderr)
+                else:
+                    print(f"horovod_tpu.run: process {i} (pid {proc.pid}) "
+                          f"exited with code {rc}; restart budget "
+                          f"({max_restarts}) exhausted — not replaced",
+                          file=sys.stderr)
+        rc0 = procs[0].poll()
+        if rc0 is not None:
+            if coord_done_at is None:
+                coord_done_at = time.monotonic()
+            stragglers = time.monotonic() - coord_done_at > grace_s
+            if not workers_running or stragglers:
+                # Admitted standbys exit through the same shutdown
+                # broadcast as the workers — give them a moment before
+                # reaping the parked (or wedged) remainder.
+                deadline = time.monotonic() + 5.0
+                while (time.monotonic() < deadline
+                       and any(p.poll() is None for p in standbys)):
+                    time.sleep(0.1)
+                _reap(procs + standbys, sig=signal.SIGTERM, grace_s=5.0)
+                return rc0
         time.sleep(0.1)
 
 

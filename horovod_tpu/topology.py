@@ -321,7 +321,12 @@ def resolve(ranks: Optional[Sequence[int]] = None) -> Topology:
                 "collectives would stall. Pass --ranks-per-process matching "
                 "the per-process device count (or adjust JAX_PLATFORMS/"
                 "XLA_FLAGS so each process sees the intended devices).")
-        if rank + len(local) > size:
+        # A standby's env-derived identity is a placeholder that lives
+        # ABOVE the live rank space (run.py hands spares process indices
+        # past the worker range); the controller adopts the real seat at
+        # admission, so only seated processes get the overflow check.
+        standby = os.environ.get("HOROVOD_TPU_STANDBY", "") == "1"
+        if rank + len(local) > size and not standby:
             raise RuntimeError(
                 f"horovod_tpu: rank layout overflows the job: first rank "
                 f"{rank} + {len(local)} local devices > size {size}.")

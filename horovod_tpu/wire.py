@@ -32,7 +32,11 @@ Abort = Optional[Tuple[int, str]]
 FLAG_SHUTDOWN = 0x01
 FLAG_CACHE_EXT = 0x02
 FLAG_ALGO_EXT = 0x04
-_KNOWN_FLAGS = FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
+# Elastic-membership extension (HOROVOD_TPU_ELASTIC=1 only — non-elastic
+# frames never set the bit, so PR 2 abort traffic stays byte-identical).
+FLAG_ELASTIC_EXT = 0x08
+_KNOWN_FLAGS = (FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
+                | FLAG_ELASTIC_EXT)
 
 # Response-cache extension cflags (ResponseList direction only).
 CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
@@ -63,6 +67,30 @@ class ResponseCacheExt:
     assignments: List[Tuple[int, str]] = dataclasses.field(
         default_factory=list)
     evictions: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class RequestElasticExt:
+    """Trailing RequestList elastic extension: ``generation:i32`` — the
+    sender's membership generation, so the coordinator can reject frames
+    from a worker that missed a RECONFIGURE."""
+    generation: int = 0
+
+
+@dataclasses.dataclass
+class ResponseElasticExt:
+    """Trailing ResponseList elastic extension:
+    ``generation:i32 reconfigure:i8 (lost_rank:i32 lost_reason:str
+    members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)``.
+
+    ``members`` is the survivor/standby re-ranking table of a RECONFIGURE
+    frame; a receiver absent from it has been evicted."""
+    generation: int = 0
+    reconfigure: bool = False
+    lost_rank: int = -1
+    lost_reason: str = ""
+    members: List[Tuple[int, int, int]] = dataclasses.field(
+        default_factory=list)
 
 
 def _put_str(out: bytearray, s: str) -> None:
@@ -182,6 +210,7 @@ def serialize_request_list(requests: List[Request],
                            abort_rank: int = -1,
                            abort_reason: str = "",
                            cache_ext: Optional[RequestCacheExt] = None,
+                           elastic_ext: Optional[RequestElasticExt] = None,
                            ) -> bytes:
     # Without a cache extension the output is byte-identical to the legacy
     # (pre-cache) format, so HOROVOD_TPU_CACHE_CAPACITY=0 stays on the old
@@ -192,6 +221,8 @@ def serialize_request_list(requests: List[Request],
     with_algo = _any_algo(requests)
     if with_algo:
         flags |= FLAG_ALGO_EXT
+    if elastic_ext is not None:
+        flags |= FLAG_ELASTIC_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
@@ -203,11 +234,14 @@ def serialize_request_list(requests: List[Request],
         out += struct.pack("<i", cache_ext.epoch)
         out += struct.pack("<i", len(cache_ext.bits))
         out += cache_ext.bits
+    if elastic_ext is not None:
+        out += struct.pack("<i", elastic_ext.generation)
     return bytes(out)
 
 
-def parse_request_list_ex(data: bytes) -> Tuple[
-        List[Request], bool, Abort, Optional[RequestCacheExt]]:
+def parse_request_list_elastic(data: bytes) -> Tuple[
+        List[Request], bool, Abort, Optional[RequestCacheExt],
+        Optional[RequestElasticExt]]:
     rd = _Reader(data)
     flags = rd.i8()
     _check_flags(flags, "request list")
@@ -223,11 +257,21 @@ def parse_request_list_ex(data: bytes) -> Tuple[
         bits = bytes(rd.data[rd.pos:rd.pos + nbits])
         rd.pos += nbits
         ext = RequestCacheExt(epoch=epoch, bits=bits)
+    elastic = None
+    if flags & FLAG_ELASTIC_EXT:
+        elastic = RequestElasticExt(generation=rd.i32())
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in request list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
     abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return reqs, shutdown, abort, ext, elastic
+
+
+def parse_request_list_ex(data: bytes) -> Tuple[
+        List[Request], bool, Abort, Optional[RequestCacheExt]]:
+    """Elastic-agnostic view: tolerates (and discards) the v3 extension."""
+    reqs, shutdown, abort, ext, _ = parse_request_list_elastic(data)
     return reqs, shutdown, abort, ext
 
 
@@ -242,6 +286,7 @@ def serialize_response_list(responses: List[Response],
                             abort_rank: int = -1,
                             abort_reason: str = "",
                             cache_ext: Optional[ResponseCacheExt] = None,
+                            elastic_ext: Optional[ResponseElasticExt] = None,
                             ) -> bytes:
     flags = (FLAG_SHUTDOWN if shutdown else 0)
     if cache_ext is not None:
@@ -249,6 +294,8 @@ def serialize_response_list(responses: List[Response],
     with_algo = _any_algo(responses)
     if with_algo:
         flags |= FLAG_ALGO_EXT
+    if elastic_ext is not None:
+        flags |= FLAG_ELASTIC_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
@@ -269,11 +316,21 @@ def serialize_response_list(responses: List[Response],
         out += struct.pack("<i", len(cache_ext.evictions))
         for slot in cache_ext.evictions:
             out += struct.pack("<i", slot)
+    if elastic_ext is not None:
+        out += struct.pack("<i", elastic_ext.generation)
+        out += struct.pack("<B", 1 if elastic_ext.reconfigure else 0)
+        if elastic_ext.reconfigure:
+            out += struct.pack("<i", elastic_ext.lost_rank)
+            _put_str(out, elastic_ext.lost_reason)
+            out += struct.pack("<i", len(elastic_ext.members))
+            for old_pidx, new_pidx, first_rank in elastic_ext.members:
+                out += struct.pack("<iii", old_pidx, new_pidx, first_rank)
     return bytes(out)
 
 
-def parse_response_list_ex(data: bytes) -> Tuple[
-        List[Response], bool, Abort, Optional[ResponseCacheExt]]:
+def parse_response_list_elastic(data: bytes) -> Tuple[
+        List[Response], bool, Abort, Optional[ResponseCacheExt],
+        Optional[ResponseElasticExt]]:
     rd = _Reader(data)
     flags = rd.i8()
     _check_flags(flags, "response list")
@@ -294,11 +351,31 @@ def parse_response_list_ex(data: bytes) -> Tuple[
             flush=bool(cflags & CACHE_FLUSH),
             store_set=bool(cflags & CACHE_STORE_SET),
             assignments=assignments, evictions=evictions)
+    elastic = None
+    if flags & FLAG_ELASTIC_EXT:
+        generation = rd.i32()
+        reconfigure = bool(rd.i8())
+        lost_rank, lost_reason, members = -1, "", []
+        if reconfigure:
+            lost_rank = rd.i32()
+            lost_reason = rd.str_()
+            members = [(rd.i32(), rd.i32(), rd.i32())
+                       for _ in range(rd.i32())]
+        elastic = ResponseElasticExt(
+            generation=generation, reconfigure=reconfigure,
+            lost_rank=lost_rank, lost_reason=lost_reason, members=members)
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in response list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
     abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return resps, shutdown, abort, ext, elastic
+
+
+def parse_response_list_ex(data: bytes) -> Tuple[
+        List[Response], bool, Abort, Optional[ResponseCacheExt]]:
+    """Elastic-agnostic view: tolerates (and discards) the v3 extension."""
+    resps, shutdown, abort, ext, _ = parse_response_list_elastic(data)
     return resps, shutdown, abort, ext
 
 

@@ -1,0 +1,559 @@
+"""Elastic membership: reconfigure instead of abort (PR: elasticity).
+
+Fast tests cover the pure-Python pieces: multi-spec fault parsing
+(``crash;rejoin`` drills), the elastic wire extensions and the
+golden-frame guard (non-elastic frames stay byte-identical to the PR 2
+format), the RETRYABLE -> HorovodRetryableError mapping, the
+``run_elastic`` restore loop, the launcher's new knobs, and the
+checkpoint world-size sidecar.  Slow tests launch real elastic process
+groups over the native control plane:
+
+* kill one of two ranks mid-training — the survivor resumes at
+  generation 1 with bit-identical restored params and a recorded
+  downtime, never seeing :class:`HorovodAbortedError`;
+* the same kill under ``HOROVOD_TPU_ELASTIC_MIN_RANKS=2`` — classic
+  abort fallback with the original attributed error;
+* an injected ``rejoin`` fault — a 2-process world grows back to 3 by
+  admitting a parked standby;
+* a worker that ticks from a stale membership generation is rejected;
+* ``python -m horovod_tpu.run --elastic`` relaunches a crashed child as
+  a standby and exits 0 on the coordinator's success.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cpp_core, elastic, wire
+from horovod_tpu.core import (Status, StatusType, parse_fault_spec,
+                              parse_fault_specs)
+
+# ------------------------------------------------------------------ fast unit
+
+
+class TestParseFaultSpecs:
+    def test_empty_is_empty(self):
+        assert parse_fault_specs("") == []
+        assert parse_fault_specs("  ") == []
+
+    def test_single(self):
+        (fs,) = parse_fault_specs("crash:rank=1:tick=5")
+        assert (fs.mode, fs.rank, fs.tick) == ("crash", 1, 5)
+
+    def test_rejoin_mode(self):
+        fs = parse_fault_spec("rejoin:rank=0:tick=120")
+        assert (fs.mode, fs.rank, fs.tick) == ("rejoin", 0, 120)
+
+    def test_kill_then_readmit_drill(self):
+        specs = parse_fault_specs("crash:rank=1:tick=40;rejoin:rank=0:tick=120")
+        assert [(s.mode, s.rank, s.tick) for s in specs] == [
+            ("crash", 1, 40), ("rejoin", 0, 120)]
+
+    def test_empty_pieces_skipped(self):
+        assert len(parse_fault_specs("crash:rank=1:tick=5;")) == 1
+
+    def test_malformed_piece_raises(self):
+        with pytest.raises(ValueError, match="HOROVOD_TPU_FAULT"):
+            parse_fault_specs("crash:rank=1:tick=5;explode:rank=0:tick=1")
+
+
+class TestElasticWire:
+    def test_non_elastic_frames_byte_identical(self):
+        """Golden-frame guard: elastic_ext=None must serialize exactly the
+        bytes the pre-elastic writer produced (no flag bit, no trailer)."""
+        for blob in (wire.serialize_request_list([]),
+                     wire.serialize_response_list([])):
+            assert not blob[0] & wire.FLAG_ELASTIC_EXT
+        plain = wire.serialize_request_list([], shutdown=True)
+        assert wire.serialize_request_list([], shutdown=True,
+                                           elastic_ext=None) == plain
+        plain_r = wire.serialize_response_list([], shutdown=True)
+        assert wire.serialize_response_list([], shutdown=True,
+                                            elastic_ext=None) == plain_r
+        _, _, _, _, ext = wire.parse_request_list_elastic(plain)
+        assert ext is None
+        _, _, _, _, rext = wire.parse_response_list_elastic(plain_r)
+        assert rext is None
+
+    def test_request_ext_roundtrip(self):
+        blob = wire.serialize_request_list(
+            [], shutdown=False,
+            elastic_ext=wire.RequestElasticExt(generation=7))
+        reqs, shutdown, abort, _cache, ext = (
+            wire.parse_request_list_elastic(blob))
+        assert reqs == [] and not shutdown and abort is None
+        assert ext is not None and ext.generation == 7
+        assert blob != wire.serialize_request_list([], shutdown=False)
+
+    def test_response_ext_roundtrip(self):
+        members = [(0, 0, 0), (1, 1, 1), (-2, 2, 2)]
+        blob = wire.serialize_response_list(
+            [], shutdown=False,
+            elastic_ext=wire.ResponseElasticExt(
+                generation=3, reconfigure=True, lost_rank=2,
+                lost_reason="rank 2 (process 2) missed the heartbeat",
+                members=members))
+        _, _, _, _, ext = wire.parse_response_list_elastic(blob)
+        assert ext.generation == 3 and ext.reconfigure
+        assert ext.lost_rank == 2 and "heartbeat" in ext.lost_reason
+        assert list(ext.members) == members
+
+    def test_heartbeat_stamp_only_frame(self):
+        """Steady-state elastic frames carry only the generation (no
+        reconfigure payload) — the cheap per-tick stamp."""
+        blob = wire.serialize_response_list(
+            [], shutdown=False,
+            elastic_ext=wire.ResponseElasticExt(generation=4))
+        _, _, _, _, ext = wire.parse_response_list_elastic(blob)
+        assert ext.generation == 4 and not ext.reconfigure
+        assert ext.members == [] and ext.lost_rank == -1
+
+    def test_elastic_agnostic_parsers_tolerate_ext(self):
+        """Pre-elastic parse entry points must skip the v3 trailer rather
+        than reject frames from an elastic peer."""
+        blob = wire.serialize_request_list(
+            [], shutdown=True,
+            elastic_ext=wire.RequestElasticExt(generation=2))
+        reqs, shutdown, abort = wire.parse_request_list(blob)
+        assert reqs == [] and shutdown and abort is None
+        rblob = wire.serialize_response_list(
+            [], shutdown=False,
+            elastic_ext=wire.ResponseElasticExt(generation=2,
+                                                reconfigure=True,
+                                                members=[(0, 0, 0)]))
+        resps, shutdown, abort = wire.parse_response_list(rblob)
+        assert resps == [] and not shutdown and abort is None
+
+
+class TestRetryableStatus:
+    def test_status_constructor(self):
+        st = Status.retryable("membership reconfigured")
+        assert st.type == StatusType.RETRYABLE and not st.ok()
+        assert "reconfigured" in st.reason
+
+    def test_retryable_raises_typed_error(self, hvd):
+        from horovod_tpu import basics
+        hm = basics.controller().handle_manager
+        h = hm.allocate(name="el.typed")
+        hm.mark_done(h, Status.retryable(
+            "Horovod membership reconfigured at generation 1: rank 1 lost"))
+        with pytest.raises(hvd.HorovodRetryableError, match="generation 1"):
+            hvd.synchronize(h)
+
+    def test_retryable_error_taxonomy(self, hvd):
+        assert issubclass(hvd.HorovodRetryableError, hvd.CollectiveError)
+        assert not issubclass(hvd.HorovodRetryableError,
+                              hvd.HorovodAbortedError)
+
+
+class TestElasticKnobs:
+    def test_defaults(self, monkeypatch):
+        for var in ("HOROVOD_TPU_ELASTIC", "HOROVOD_TPU_ELASTIC_MIN_RANKS",
+                    "HOROVOD_TPU_STANDBY"):
+            monkeypatch.delenv(var, raising=False)
+        assert not elastic.enabled()
+        assert elastic.min_ranks() == 1
+        assert not elastic.is_standby()
+
+    def test_enabled(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_ELASTIC", "1")
+        monkeypatch.setenv("HOROVOD_TPU_ELASTIC_MIN_RANKS", "3")
+        monkeypatch.setenv("HOROVOD_TPU_STANDBY", "1")
+        assert elastic.enabled()
+        assert elastic.min_ranks() == 3
+        assert elastic.is_standby()
+
+    def test_launcher_rejects_standby_without_elastic(self, capsys):
+        from horovod_tpu import run as run_mod
+        with pytest.raises(SystemExit):
+            run_mod.main(["-np", "1", "--num-standby", "1", "--", "true"])
+        assert "--elastic" in capsys.readouterr().err
+
+
+class TestRunElastic:
+    def _patch_restore(self, monkeypatch, calls):
+        from horovod_tpu import checkpoint
+
+        def fake_restore(directory, like, root_rank=0, optional_keys=()):
+            calls.append(directory)
+            return {"w": len(calls)}, len(calls) - 2
+        monkeypatch.setattr(checkpoint, "restore_and_broadcast",
+                            fake_restore)
+
+    def test_reenters_train_on_membership_change(self, monkeypatch):
+        from horovod_tpu.ops.eager import HorovodRetryableError
+        calls, entries = [], []
+
+        def train(state, epoch):
+            entries.append((state, epoch))
+            if len(entries) < 3:
+                raise HorovodRetryableError("membership reconfigured")
+            return "finished"
+        self._patch_restore(monkeypatch, calls)
+        out = elastic.run_elastic(train, directory="/ckpt", like={"w": 0})
+        assert out == "finished"
+        assert len(calls) == 3            # restored fresh before every entry
+        assert entries[0] == ({"w": 1}, -1)
+        assert entries[2] == ({"w": 3}, 1)
+
+    def test_gives_up_after_max_reconfigures(self, monkeypatch):
+        from horovod_tpu.ops.eager import HorovodRetryableError
+        calls = []
+
+        def train(state, epoch):
+            raise HorovodRetryableError("flapping membership")
+        self._patch_restore(monkeypatch, calls)
+        with pytest.raises(HorovodRetryableError, match="flapping"):
+            elastic.run_elastic(train, directory="/ckpt", like={},
+                                max_reconfigures=2)
+        assert len(calls) == 3            # initial + 2 retries
+
+    def test_other_errors_propagate_unretried(self, monkeypatch):
+        calls = []
+
+        def train(state, epoch):
+            raise RuntimeError("real bug")
+        self._patch_restore(monkeypatch, calls)
+        with pytest.raises(RuntimeError, match="real bug"):
+            elastic.run_elastic(train, directory="/ckpt", like={})
+        assert len(calls) == 1
+
+
+class TestCheckpointWorldSize:
+    def test_save_records_world_size(self, hvd, tmp_path):
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        checkpoint.save(d, {"w": np.arange(4, dtype=np.float32)}, 0)
+        assert checkpoint.saved_world_size(d, 0) == hvd.size()
+
+    def test_missing_sidecar_is_unknown(self, tmp_path):
+        from horovod_tpu import checkpoint
+        assert checkpoint.saved_world_size(str(tmp_path), 3) == -1
+
+    def test_replicated_state_restores_across_world_sizes(
+            self, hvd, tmp_path, capfd):
+        import json
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        w = np.arange(6, dtype=np.float32)
+        checkpoint.save(d, {"w": w}, 0)
+        # Pretend a different (now-gone) world wrote it.
+        with open(checkpoint._world_meta_path(d, 0), "w") as f:
+            json.dump({"world_size": hvd.size() + 1}, f)
+        state, epoch = checkpoint.restore_and_broadcast(d, {"w": np.zeros(6)})
+        assert epoch == 0
+        np.testing.assert_array_equal(np.asarray(state["w"]), w)
+        assert "world size" in capfd.readouterr().err
+
+    def test_sharded_state_fails_with_named_leaf(self, hvd, tmp_path,
+                                                 monkeypatch):
+        import json
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        checkpoint.save(d, {"w": np.arange(6, dtype=np.float32)}, 0)
+        with open(checkpoint._world_meta_path(d, 0), "w") as f:
+            json.dump({"world_size": hvd.size() + 1}, f)
+        monkeypatch.setattr(checkpoint, "_sharded_leaf_path",
+                            lambda tree: "['w']")
+        with pytest.raises(ValueError) as ei:
+            checkpoint.restore_and_broadcast(d, {"w": np.zeros(6)})
+        msg = str(ei.value)
+        assert "['w']" in msg and "sharded" in msg
+        assert str(hvd.size() + 1) in msg and str(hvd.size()) in msg
+
+
+# ------------------------------------------------------- slow multi-process
+
+pytestmark_native = pytest.mark.skipif(
+    not cpp_core.available(), reason="native core not built")
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, signal, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint, cpp_core, elastic
+
+    if os.environ.get("HOROVOD_TPU_STANDBY") == "1":
+        # Drills that exercise the `rejoin` action park the spare AFTER
+        # the crash has opened a seat; without the delay the spare may
+        # park first and be admitted directly by the shrink reconfigure.
+        time.sleep(float(os.environ.get("TEST_STANDBY_DELAY_S", "0")))
+    elastic.init()
+    ckpt = os.environ["TEST_CKPT_DIR"]
+    die_rank = int(os.environ.get("TEST_DIE_RANK", "-1"))
+    expect_size = int(os.environ.get("TEST_EXPECT_SIZE", "1"))
+    w0 = np.arange(8, dtype=np.float32)
+
+    def train(state, resume_epoch):
+        gen = elastic.generation()
+        if gen == 0:
+            checkpoint.save(ckpt, state, 0)
+        # Keep training until the drill's terminal membership: generation
+        # 0 is always pre-failure (the checkpointed steady state the
+        # killer interrupts), later generations until the world reaches
+        # the expected size (a 2->1->2 drill passes through a 1-process
+        # generation on the way back up).
+        if gen == 0 or hvd.size() != expect_size:
+            t0 = time.monotonic()
+            i = 0
+            while time.monotonic() - t0 < 90:
+                if elastic.generation() != gen:
+                    # Reconfigured between steps (no op was in flight to
+                    # complete RETRYABLE): surface it like one.
+                    raise hvd.HorovodRetryableError(
+                        "membership changed between steps")
+                if hvd.rank() == die_rank and i == 5:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                hvd.allreduce(np.ones(8, np.float32), name=f"el.{gen}.{i}")
+                i += 1
+            print(f"NO_RECONFIG rank={hvd.rank()}", flush=True)
+            sys.exit(5)
+        ok = bool(np.array_equal(np.asarray(state["w"]), w0))
+        snap = cpp_core.metrics_snapshot()
+        down = (snap.get("histograms", {}).get("elastic.downtime_seconds")
+                or {}).get("count", 0)
+        print(f"RESUMED rank={hvd.rank()} size={hvd.size()} gen={gen} "
+              f"epoch={resume_epoch} state_ok={ok} downtime_n={down}",
+              flush=True)
+        return state
+
+    t0 = time.monotonic()
+    try:
+        elastic.run_elastic(train, directory=ckpt, like={"w": w0})
+    except hvd.HorovodAbortedError as e:
+        print(f"ABORTED rank={hvd.rank()} dt={time.monotonic() - t0:.1f} "
+              f"msg={e}", flush=True)
+        sys.exit(3)
+    print(f"DONE dt={time.monotonic() - t0:.1f}", flush=True)
+""")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def start_elastic_procs(nprocs, tmp_path, extra_env=None, num_standby=0,
+                        script=ELASTIC_WORKER):
+    port = free_port()
+    procs = []
+    for i in range(nprocs + num_standby):
+        standby = i >= nprocs
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": str(nprocs),
+            "HOROVOD_TPU_SIZE": str(nprocs),
+            "HOROVOD_TPU_RANK": str(i),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_ELASTIC": "1",
+            "TEST_CKPT_DIR": str(tmp_path),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        env.update(extra_env or {})
+        if standby:
+            env["HOROVOD_TPU_STANDBY"] = "1"
+            env["HOROVOD_TPU_STANDBY_WAIT_S"] = "60"
+            # Fault specs target a first-rank AT INJECTION TIME; an
+            # admitted standby adopting that rank would re-fire the
+            # drill's crash on the replacement it just admitted.
+            env.pop("HOROVOD_TPU_FAULT", None)
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        if "HOROVOD_TPU_FAULT" not in (extra_env or {}) and not standby:
+            env.pop("HOROVOD_TPU_FAULT", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    return procs
+
+
+def finish(proc, timeout=120):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        return None, out
+
+
+@pytest.mark.slow
+@pytestmark_native
+class TestElasticMultiprocess:
+    def test_kill_one_of_two_reconfigures_and_resumes(self, tmp_path):
+        """ISSUE acceptance: kill one of two ranks mid-training.  The
+        survivor must resume as a single-rank job at generation 1 with
+        bit-identical restored params and a recorded downtime — and never
+        see HorovodAbortedError."""
+        procs = start_elastic_procs(2, tmp_path, {"TEST_DIE_RANK": "1"})
+        results = [finish(p) for p in procs]
+        assert results[1][0] == -signal.SIGKILL
+        rc, out = results[0]
+        assert rc == 0, out
+        assert "ABORTED" not in out, out
+        assert "RESUMED rank=0 size=1 gen=1" in out, out
+        assert "state_ok=True" in out, out
+        downtime_n = int(out.split("downtime_n=")[1].split()[0])
+        assert downtime_n >= 1, out
+        assert "reconfigured to 1 process(es) at generation 1" in out, out
+        dt = float(out.split("dt=")[1].split()[0])
+        assert dt < 60, (dt, out)
+
+    def test_shrink_below_min_ranks_falls_back_to_abort(self, tmp_path):
+        """A loss that would shrink below HOROVOD_TPU_ELASTIC_MIN_RANKS
+        keeps the classic PR 2 abort with the original attributed error."""
+        procs = start_elastic_procs(
+            2, tmp_path, {"TEST_DIE_RANK": "1",
+                          "HOROVOD_TPU_ELASTIC_MIN_RANKS": "2"})
+        results = [finish(p) for p in procs]
+        assert results[1][0] == -signal.SIGKILL
+        rc, out = results[0]
+        assert rc == 3, out
+        assert "ABORTED" in out and "rank 1" in out, out
+        assert "RESUMED" not in out, out
+        assert "aborting instead of reconfiguring" in out, out
+
+    def test_crash_then_rejoin_grows_back(self, tmp_path):
+        """The scripted 2->1->2 drill (satellite d): the native `crash`
+        fault kills rank 1, the job reconfigures down to one process, and
+        the armed `rejoin` action then admits the parked standby —
+        growing the membership back to two at generation 2.  Every final
+        member (the admitted spare included) resumes with the restored
+        params."""
+        procs = start_elastic_procs(
+            2, tmp_path,
+            {"HOROVOD_TPU_FAULT": "crash:rank=1:tick=40;rejoin:rank=0:tick=400",
+             "TEST_EXPECT_SIZE": "2",
+             "TEST_STANDBY_DELAY_S": "6"},
+            num_standby=1)
+        results = [finish(p) for p in procs]
+        rc1, out1 = results[1]
+        assert rc1 == 42, out1   # _exit(42) from the injected crash
+        assert "htpu fault injection: crashing rank 1" in out1, out1
+        rc0, out0 = results[0]
+        assert rc0 == 0, out0
+        assert "ABORTED" not in out0, out0
+        assert "reconfigured to 1 process(es) at generation 1" in out0, out0
+        assert "reconfigured to 2 process(es) at generation 2" in out0, out0
+        assert "rejoin" in out0, out0
+        assert "RESUMED rank=0 size=2 gen=2" in out0, out0
+        assert "state_ok=True" in out0 and "DONE" in out0, out0
+        rc2, out2 = results[2]
+        assert rc2 == 0, out2
+        assert "standby admitted at generation 2" in out2, out2
+        assert "RESUMED rank=1 size=2 gen=2" in out2, out2
+        assert "state_ok=True" in out2 and "DONE" in out2, out2
+
+    def test_stale_generation_frame_rejected(self, tmp_path):
+        """A worker ticking from a stale membership generation must never
+        have its old-world requests applied: the coordinator evicts it and
+        reconfigures the rest of the job without it (the elastic analogue
+        of the PR 2 corrupt-frame abort), and the evicted worker latches
+        an attributed abort naming the stale generation.  Uses the
+        StampElasticRequest pass-through seam: a request frame that
+        already carries an elastic extension keeps its (stale)
+        generation."""
+        driver = textwrap.dedent("""
+            import os, sys
+            from horovod_tpu import cpp_core, wire
+
+            pidx = int(os.environ["HOROVOD_TPU_PROCESS_INDEX"])
+            host, _, port = os.environ["HOROVOD_TPU_COORD_ADDR"].rpartition(":")
+            cp = cpp_core.CppControlPlane(pidx, 2, host, int(port), pidx, 2,
+                                          20000)
+            assert cp.elastic(), "plane ignored HOROVOD_TPU_ELASTIC"
+            idle = wire.serialize_request_list([])
+            stale = wire.serialize_request_list(
+                [], elastic_ext=wire.RequestElasticExt(generation=5))
+            for i in range(3):
+                cp.tick(idle, 0)
+            resp = cp.tick(stale if pidx == 1 else idle, 0)
+            _, _, abort, _, ext = wire.parse_response_list_elastic(resp)
+            if pidx == 1:
+                # The stale sender is evicted: no new-world seat, and its
+                # requests never reached the response path.
+                assert abort is not None, "expected eviction abort"
+                assert "evicted from the membership" in abort[1], abort
+                assert "stale membership generation 5" in abort[1], abort
+            else:
+                # The survivor reconfigures around the stale rank with the
+                # staleness as the attributed cause.
+                assert abort is None, abort
+                assert ext is not None and ext.reconfigure, ext
+                assert "stale membership generation 5" in ext.lost_reason, \\
+                    ext
+                assert len(ext.members) == 1, ext
+                pi, pc, fr, gen = cp.membership()
+                assert (pi, pc, gen) == (0, 1, 1), (pi, pc, fr, gen)
+            print(f"STALE_REJECTED pidx={pidx}", flush=True)
+        """)
+        port = free_port()
+        procs = []
+        for i in range(2):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+                "HOROVOD_TPU_PROCESS_INDEX": str(i),
+                "HOROVOD_TPU_ELASTIC": "1",
+            })
+            env.pop("HOROVOD_TPU_FAULT", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", driver], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        results = [finish(p, timeout=60) for p in procs]
+        for i, (rc, out) in enumerate(results):
+            assert rc == 0, (i, out)
+            assert "STALE_REJECTED" in out, (i, out)
+
+    def test_launcher_elastic_relaunches_crashed_child_as_standby(
+            self, tmp_path):
+        """run.py --elastic: a crashed child is relaunched as a parked
+        standby, the reconfigured job runs to completion, and the launcher
+        exits 0 on the coordinator's success."""
+        wf = tmp_path / "worker.py"
+        wf.write_text(ELASTIC_WORKER)
+        ckpt = tmp_path / "ckpt"
+        env = dict(os.environ)
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        env.pop("HOROVOD_TPU_FAULT", None)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                    "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+                    "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+                    "HOROVOD_TPU_STANDBY_WAIT_S": "30",
+                    "TEST_CKPT_DIR": str(ckpt),
+                    "TEST_DIE_RANK": "1"})
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             "--elastic", "--", sys.executable, str(wf)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, start_new_session=True)
+        try:
+            out, _ = proc.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            raise
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, out
+        assert "relaunched as standby" in out, out
+        assert "RESUMED rank=0 size=1 gen=1" in out, out
+        assert "DONE" in out, out
+        assert elapsed < 120, elapsed

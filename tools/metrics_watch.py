@@ -153,6 +153,31 @@ def render_skew_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def render_elastic_summary(snap: dict, name_filter: str) -> list:
+    """One-line elastic digest: membership generation, reconfiguration
+    count, and the last reconfiguration's downtime — present only on jobs
+    that exported the elastic series (docs/elasticity.md)."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    gen = gauges.get("membership.generation")
+    reconfigs = counters.get("elastic.reconfigs", 0)
+    if gen is None and not reconfigs:
+        return []
+    if name_filter and all(name_filter not in n for n in (
+            "membership.generation", "elastic.reconfigs",
+            "elastic.last_downtime_s")):
+        return []
+    text = f"generation={int(gen or 0)} reconfigs={reconfigs}"
+    last = gauges.get("elastic.last_downtime_s")
+    if last is not None:
+        text += f" last_downtime={last:.3g}s"
+    standbys = gauges.get("elastic.standbys")
+    if standbys:
+        text += f" standbys={int(standbys)}"
+    return ["  -- elastic membership --",
+            f"  {'elastic':<52} {text}"]
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -200,6 +225,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_algo_summary(snap, name_filter))
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
+    lines.extend(render_elastic_summary(snap, name_filter))
     return "\n".join(lines)
 
 
