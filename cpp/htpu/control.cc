@@ -132,6 +132,44 @@ bool StripClockTrailer(std::string* blob, int64_t* prev_resp_recv_us,
 // park-ack ids themselves (-2, -3, ... assigned per parked standby).
 constexpr int kStandbyPidx = -1000000;
 
+// Failover rendezvous hello: pidx:i32 first_rank:i32 generation:i32
+// (little-endian).  Deliberately 12 bytes — NOT the 8-byte bootstrap
+// handshake — so a hello that strays onto a listener in standby-accepting
+// mode fails ParseHandshake's size check and is closed, never parked.
+std::string FailoverHello(int32_t pidx, int32_t first_rank,
+                          int32_t generation) {
+  std::string s;
+  for (int32_t v : {pidx, first_rank, generation}) {
+    for (int i = 0; i < 4; ++i)
+      s.push_back(char((uint32_t(v) >> (8 * i)) & 0xff));
+  }
+  return s;
+}
+
+bool ParseFailoverHello(const std::string& s, int32_t* pidx,
+                        int32_t* first_rank, int32_t* generation) {
+  if (s.size() != 12) return false;
+  auto rd = [&s](int off) {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i)
+      v |= uint32_t(uint8_t(s[size_t(off + i)])) << (8 * i);
+    return int32_t(v);
+  };
+  *pidx = rd(0);
+  *first_rank = rd(4);
+  *generation = rd(8);
+  return true;
+}
+
+// "host:port" -> (host, port); false on a malformed address.
+bool SplitHostPort(const std::string& addr, std::string* host, int* port) {
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos || colon + 1 >= addr.size()) return false;
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return *port > 0;
+}
+
 }  // namespace
 
 std::unique_ptr<ControlPlane> ControlPlane::Create(
@@ -182,9 +220,44 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
       cp->elastic_ ? nranks_total / process_count : 1;
   cp->initial_process_count_ = process_count;
   cp->coord_host_ = coord_host;
+  // Coordinator-failover deadlines (elastic only).  A worker whose
+  // coordinator link is silent for HOROVOD_TPU_COORD_TIMEOUT_S (or tears)
+  // starts the successor election; the whole rendezvous walk gets
+  // HOROVOD_TPU_RENDEZVOUS_S before degrading to the classic abort.
+  long coord_timeout_s = 30;
+  if (const char* e = getenv("HOROVOD_TPU_COORD_TIMEOUT_S")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) coord_timeout_s = v;
+  }
+  cp->coord_timeout_ms_ =
+      int(std::min<long long>(coord_timeout_s * 1000LL, timeout_ms));
+  long rendezvous_s = 30;
+  if (const char* e = getenv("HOROVOD_TPU_RENDEZVOUS_S")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) rendezvous_s = v;
+  }
+  cp->rendezvous_ms_ = int(rendezvous_s * 1000);
+  double backoff_max_s = 1.0;
+  if (const char* e = getenv("HOROVOD_TPU_CONNECT_BACKOFF_MAX_S")) {
+    char* end = nullptr;
+    double v = strtod(e, &end);
+    if (end && *end == '\0' && v > 0) backoff_max_s = v;
+  }
+  cp->connect_backoff_max_s_ = backoff_max_s;
   const char* sb = getenv("HOROVOD_TPU_STANDBY");
   cp->is_standby_ = cp->elastic_ && process_index != 0 && sb &&
                     std::string(sb) == "1";
+  // Pre-announced failover rendezvous port: every elastic process (the
+  // coordinator included — it may have been a worker in a previous
+  // incarnation's book) opens it before bootstrap and advertises it
+  // through the SetupRing address book, so survivors can elect + find a
+  // successor with no post-failure negotiation.
+  if (cp->elastic_ && process_count > 1) {
+    cp->failover_listen_fd_ = Listen(0, &cp->failover_port_);
+    if (cp->failover_listen_fd_ < 0) return nullptr;
+  }
   cp->ParseFaultEnv();
   // Flight recorder: rank-tag the process-wide ring and arm the SIGUSR2
   // dump so a wedged tick thread can still be made to leave forensics
@@ -355,6 +428,11 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   std::string record = host + "\t" + std::to_string(ring_port) + "\t" +
                        std::to_string(first_rank_) + "\t" +
                        HostFingerprint() + "\t" + uds_path;
+  // Elastic: a 6th field advertises the pre-announced failover rendezvous
+  // port (non-elastic books keep the 5-field legacy shape exactly).
+  if (elastic_ && failover_port_ > 0) {
+    record += "\t" + std::to_string(failover_port_);
+  }
 
   auto cleanup = [&]() {
     CloseFd(ring_listen);
@@ -393,7 +471,7 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   }
 
   // 4. Parse the book (one tab-separated record per process).
-  std::vector<std::string> hosts, fps, uds_paths;
+  std::vector<std::string> hosts, fps, uds_paths, fo_ports;
   std::vector<int> ports;
   all_first_ranks_.clear();
   size_t pos = 0;
@@ -419,12 +497,23 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     all_first_ranks_.push_back(std::stoi(fields[2]));
     fps.push_back(fields[3]);
     uds_paths.push_back(fields[4]);
+    fo_ports.push_back(fields.size() >= 6 ? fields[5] : std::string());
     if (nl == std::string::npos) break;
     pos = nl + 1;
   }
   if (int(hosts.size()) != process_count_) {
     cleanup();
     return false;
+  }
+
+  // Harvest the failover rendezvous address book (elastic 6th field) —
+  // every process keeps the full table so any survivor can both elect the
+  // lowest-indexed successor and dial it without a round trip.
+  failover_addrs_.assign(size_t(process_count_), std::string());
+  for (int i = 0; i < process_count_; ++i) {
+    if (!fo_ports[size_t(i)].empty()) {
+      failover_addrs_[size_t(i)] = hosts[size_t(i)] + ":" + fo_ports[size_t(i)];
+    }
   }
 
   // Persist the topology book for hierarchical leader election
@@ -497,6 +586,7 @@ ControlPlane::~ControlPlane() {
   for (const auto& sb : standby_fds_) CloseFd(sb.first);
   CloseFd(coord_fd_);
   CloseFd(listen_fd_);
+  CloseFd(failover_listen_fd_);
   CloseFd(ring_next_fd_);
   CloseFd(ring_prev_fd_);
   CloseFd(leader_fd_);
@@ -842,6 +932,11 @@ bool ControlPlane::ApplyResponseFrame(const ResponseList& parsed,
       // the generation check already ran on the enclosing frame.
       clean.has_elastic_ext = false;
       clean.generation = 0;
+      clean.has_digest = false;
+      clean.coord_epoch = 0;
+      clean.digest_cache_epoch = 0;
+      clean.digest_members.clear();
+      clean.digest_standbys.clear();
       std::string cb;
       SerializeResponseList(clean, &cb);
       if (cache_set_.size() >= 16) cache_set_.clear();  // bounded, rebuilt fast
@@ -890,14 +985,26 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     auto w0 = std::chrono::steady_clock::now();
     FlightRecorder::Get().Record("tick.send", "", int64_t(frame.size()),
                                  0, coord_fd_);
+    // Elastic workers watch the coordinator link with its own (tighter)
+    // deadline so a dead coordinator is detected within
+    // HOROVOD_TPU_COORD_TIMEOUT_S instead of the full control timeout.
+    int coord_deadline = elastic_ ? coord_timeout_ms_ : timeout_ms_;
     if (!SendFrame(coord_fd_, frame) ||
-        !RecvFrame(coord_fd_, response_list_blob, timeout_ms_)) {
-      // Coordinator link gone: synthesize a local abort naming process 0
-      // so waiters get an attributed error, not a generic tick failure.
-      int32_t coord_rank =
-          all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+        !RecvFrame(coord_fd_, response_list_blob, coord_deadline)) {
       FlightRecorder::Get().Record("tick.fail", "coordinator link lost",
                                    0, coord_fd_, errno);
+      // Elastic: try to survive the loss — elect the lowest surviving
+      // process as the new coordinator and rendezvous with it (serving
+      // ourselves when it is our turn).  On success the blob is final: a
+      // fully applied RECONFIGURE frame (membership adopted, data plane
+      // rebuilt) or an attributed abort — either way it goes straight up
+      // to the Python controller, which quiesces in-flight collectives
+      // and re-reads the membership.
+      if (FailoverOnCoordLoss(response_list_blob)) return true;
+      // Classic path: synthesize a local abort naming process 0 so
+      // waiters get an attributed error, not a generic tick failure.
+      int32_t coord_rank =
+          all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
       LatchAbort(coord_rank,
                  "lost connection to the coordinator (rank " +
                      std::to_string(coord_rank) + ", process 0)");
@@ -922,6 +1029,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     if (ParseResponseList(
             reinterpret_cast<const uint8_t*>(response_list_blob->data()),
             response_list_blob->size(), &parsed)) {
+      if (elastic_) AdoptDigest(parsed);
       if (parsed.abort_rank >= 0) {
         LatchAbort(parsed.abort_rank, parsed.abort_reason);
       } else if (elastic_ && parsed.has_elastic_ext && parsed.reconfigure) {
@@ -1155,6 +1263,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   // traffic from before a reconfigure can never be misapplied.
   out.has_elastic_ext = elastic_;
   out.generation = generation_;
+  if (elastic_) AttachDigest(&out);
   // One acquire-load per tick: a concurrent detach (teardown without
   // shutdown, cpp_core.CppTimeline.__del__) must not tear the pointer
   // mid-loop.  A stale non-null value is safe — the writer is closed,
@@ -1210,6 +1319,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
         mini.cache_flags = kCacheServed;
         mini.has_elastic_ext = elastic_;
         mini.generation = generation_;
+        if (elastic_) AttachDigest(&mini);
         SerializeResponseList(mini, response_list_blob);
         // Clock gather-done -> response-blob-ready: the pre-gather span
         // is waiting on peers and the post-serialize span is the
@@ -1552,8 +1662,10 @@ bool ControlPlane::CoordinateReconfigure(const std::vector<int>& dead_procs,
   const auto t0 = std::chrono::steady_clock::now();
   AcceptStandbys();   // a relaunched child may already be waiting
   std::vector<char> dead(size_t(process_count_), 0);
+  // Index 0 is legal here only on a failover takeover (the successor marks
+  // the lost coordinator dead); steady-state callers never pass it.
   for (int p : dead_procs) {
-    if (p > 0 && p < process_count_) dead[size_t(p)] = 1;
+    if (p >= 0 && p < process_count_) dead[size_t(p)] = 1;
   }
 
   // Dense re-rank: survivors keep their relative order (the coordinator
@@ -1713,6 +1825,309 @@ bool ControlPlane::ApplyReconfigure(const ResponseList& parsed,
   return true;
 }
 
+// -------------------------------------------- coordinator failover
+
+void ControlPlane::AttachDigest(ResponseList* out) const {
+  // Piggybacked on the steady-state and cached-mini frames only — the
+  // RECONFIGURE frame is serialized before the data plane is rebuilt, so
+  // any addresses in it could be stale.  A consequence: failover needs at
+  // least one completed tick after (re-)bootstrap; a coordinator lost
+  // before that aborts classically (docs/elasticity.md).
+  out->has_digest = true;
+  out->coord_epoch = coord_epoch_;
+  out->digest_cache_epoch = cache_ ? cache_->epoch() : 0;
+  out->digest_members.clear();
+  out->digest_standbys.clear();
+  for (int p = 0; p < process_count_; ++p) {
+    int32_t frank = p < int(worker_first_rank_.size())
+                        ? worker_first_rank_[size_t(p)]
+                        : int32_t(p * ranks_per_process_);
+    std::string addr = p < int(failover_addrs_.size())
+                           ? failover_addrs_[size_t(p)]
+                           : std::string();
+    out->digest_members.emplace_back(frank, std::move(addr));
+  }
+  for (const auto& sb : standby_fds_) out->digest_standbys.push_back(sb.second);
+}
+
+void ControlPlane::AdoptDigest(const ResponseList& parsed) {
+  if (!parsed.has_elastic_ext || !parsed.has_digest) return;
+  if (parsed.coord_epoch != coord_epoch_) {
+    Metrics::Get().SetGauge("coord.epoch", double(parsed.coord_epoch));
+  }
+  coord_epoch_ = parsed.coord_epoch;
+  digest_cache_epoch_ = parsed.digest_cache_epoch;
+  digest_standby_count_ = int32_t(parsed.digest_standbys.size());
+  digest_first_ranks_.clear();
+  for (const auto& m : parsed.digest_members)
+    digest_first_ranks_.push_back(m.first);
+  // The digest's addresses are the coordinator's current view of the book;
+  // prefer them where present (they heal a worker whose own book read
+  // predates a standby admission).
+  if (parsed.digest_members.size() == failover_addrs_.size()) {
+    for (size_t i = 0; i < failover_addrs_.size(); ++i) {
+      if (!parsed.digest_members[i].second.empty())
+        failover_addrs_[i] = parsed.digest_members[i].second;
+    }
+  }
+  have_digest_ = true;
+}
+
+bool ControlPlane::FailoverOnCoordLoss(std::string* response_list_blob) {
+  // Preconditions for an election: elastic mode, a real multi-process
+  // membership, our own pre-announced listener, and at least one adopted
+  // digest (the replicated coordinator state a successor reconstructs
+  // from).  Anything else falls back to the classic attributed abort.
+  if (!elastic_ || process_count_ <= 1 || failover_listen_fd_ < 0 ||
+      !have_digest_ || int(failover_addrs_.size()) != process_count_) {
+    return false;
+  }
+  const auto t0 = std::chrono::steady_clock::now();
+  const auto deadline = t0 + std::chrono::milliseconds(rendezvous_ms_);
+  CloseFd(coord_fd_);
+  coord_fd_ = -1;
+  const int32_t lost_rank =
+      all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+  const std::string lost =
+      "lost connection to the coordinator (rank " +
+      std::to_string(lost_rank) + ", process 0)";
+  FlightRecorder::Get().Record("elastic.failover_start", lost.c_str(), 0,
+                               process_index_, generation_);
+  fprintf(stderr,
+          "htpu elastic: process %d lost the coordinator at generation %d; "
+          "electing a successor (rendezvous budget %ds)\n",
+          process_index_, generation_, rendezvous_ms_ / 1000);
+  // Deterministic successor order: ascending surviving process index.
+  // Every survivor walks the same list, so the first candidate that is
+  // actually alive serves and everyone else converges on it.  A candidate
+  // that cannot be reached (crashed before/during its own takeover)
+  // cascades to the next; a candidate that accepted us but died
+  // mid-rendezvous (EOF) cascades too.  A candidate that HANGS holds us
+  // until the deadline — stall-then-abort, never hang.
+  int backoff_ms = 50;
+  const int backoff_cap_ms =
+      std::max(1, int(connect_backoff_max_s_ * 1000.0));
+  for (int c = 1; c < process_count_; ++c) {
+    auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) break;
+    if (c == process_index_) {
+      // Every lower-indexed candidate was unreachable or died: our turn.
+      return FailoverServe(response_list_blob);
+    }
+    std::string host;
+    int port = 0;
+    if (!SplitHostPort(failover_addrs_[size_t(c)], &host, &port)) continue;
+    int remaining = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - now)
+                            .count());
+    // Short dial budget per candidate: the listener exists from bootstrap,
+    // so a live candidate accepts the TCP connect instantly even before it
+    // has noticed the failure itself — a slow connect means a dead host.
+    int fd = DialRetry(host, port, std::min(remaining, 2000));
+    if (fd < 0 ||
+        !SendFrame(fd, FailoverHello(int32_t(process_index_), first_rank_,
+                                     generation_))) {
+      if (fd >= 0) CloseFd(fd);
+      FlightRecorder::Get().Record("elastic.failover_cascade",
+                                   "candidate unreachable", 0, c, errno);
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+      backoff_ms = std::min(backoff_ms * 2, backoff_cap_ms);
+      continue;
+    }
+    remaining = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                        deadline - std::chrono::steady_clock::now())
+                        .count());
+    std::string frame;
+    if (remaining <= 0 || !RecvFrame(fd, &frame, remaining)) {
+      CloseFd(fd);   // successor died mid-rendezvous: cascade
+      FlightRecorder::Get().Record("elastic.failover_cascade",
+                                   "successor died mid-rendezvous", 0, c,
+                                   errno);
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+      backoff_ms = std::min(backoff_ms * 2, backoff_cap_ms);
+      continue;
+    }
+    ResponseList parsed;
+    if (!ParseResponseList(reinterpret_cast<const uint8_t*>(frame.data()),
+                           frame.size(), &parsed)) {
+      CloseFd(fd);
+      continue;
+    }
+    if (parsed.abort_rank >= 0) {
+      // The successor refused quorum (or failed its own rebuild) and
+      // broadcast one attributed abort — adopt it so every rank raises
+      // the identical error.
+      CloseFd(fd);
+      LatchAbort(parsed.abort_rank, parsed.abort_reason);
+      *response_list_blob = std::move(frame);
+      return true;
+    }
+    if (!parsed.has_elastic_ext || !parsed.reconfigure) {
+      CloseFd(fd);
+      continue;
+    }
+    // Adopt the successor as the new coordinator BEFORE applying the
+    // reconfigure — the data-plane rebuild advertises the local address
+    // of coord_fd_ in the new ring book.
+    coord_fd_ = fd;
+    coord_epoch_ += 1;   // matches the successor's bump; confirmed by its
+                         // next digest
+    *response_list_blob = std::move(frame);
+    bool applied = ApplyReconfigure(parsed, response_list_blob);
+    const double elect =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+    Metrics::Get().Counter("elastic.failovers")
+        ->fetch_add(1, std::memory_order_relaxed);
+    Metrics::Get().Observe("elastic.election_seconds", elect);
+    Metrics::Get().SetGauge("coord.epoch", double(coord_epoch_));
+    if (applied) {
+      fprintf(stderr,
+              "htpu elastic: rejoined under successor coordinator "
+              "(old process %d, epoch %d) in %.3fs\n",
+              c, coord_epoch_, elect);
+    }
+    return true;
+  }
+  // Rendezvous budget exhausted with no successor: degrade to the classic
+  // attributed abort (the acceptance bar — stall-then-abort, never hang).
+  LatchAbort(lost_rank,
+             lost + "; successor rendezvous did not complete within "
+                    "HOROVOD_TPU_RENDEZVOUS_S=" +
+                 std::to_string(rendezvous_ms_ / 1000) + "s");
+  SerializeAbort(response_list_blob);
+  return true;
+}
+
+bool ControlPlane::FailoverServe(std::string* response_list_blob) {
+  const auto t0 = std::chrono::steady_clock::now();
+  const auto deadline = t0 + std::chrono::milliseconds(rendezvous_ms_);
+  const int32_t lost_rank =
+      all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+  const std::string lost =
+      "lost connection to the coordinator (rank " +
+      std::to_string(lost_rank) + ", process 0)";
+  FlightRecorder::Get().Record("elastic.failover_serve", lost.c_str(), 0,
+                               process_index_, generation_);
+  // Collect the other survivors on the pre-announced listener.  Expected =
+  // everyone but the dead coordinator and ourselves; an accept timeout
+  // before that just means more processes died — quorum decides below.
+  const int expected = process_count_ - 2;
+  std::vector<std::pair<int32_t, int>> joined;       // old pidx -> fd
+  std::vector<int32_t> joined_frank;
+  while (int(joined.size()) < expected) {
+    int remaining = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count());
+    if (remaining <= 0) break;
+    int fd = AcceptOne(failover_listen_fd_, remaining);
+    if (fd < 0) break;
+    std::string hello;
+    int32_t pidx = -1, frank = -1, gen = -1;
+    if (!RecvFrame(fd, &hello, 2000) ||
+        !ParseFailoverHello(hello, &pidx, &frank, &gen) ||
+        gen != generation_ || pidx <= 0 || pidx >= process_count_ ||
+        pidx == process_index_) {
+      CloseFd(fd);   // stray, stale-generation, or malformed rendezvous
+      continue;
+    }
+    bool dup = false;
+    for (const auto& j : joined) dup = dup || j.first == pidx;
+    if (dup) {
+      CloseFd(fd);
+      continue;
+    }
+    joined.emplace_back(pidx, fd);
+    joined_frank.push_back(frank);
+    FlightRecorder::Get().Record("elastic.failover_join", "", 0, pidx, fd);
+  }
+
+  const int survivors = 1 + int(joined.size());
+  if (survivors * ranks_per_process_ < elastic_min_ranks_) {
+    // Quorum refusal: one attributed abort everywhere — latched locally
+    // and pushed to every survivor that made rendezvous.
+    fprintf(stderr,
+            "htpu elastic: %d surviving rank(s) after coordinator loss "
+            "fall below HOROVOD_TPU_ELASTIC_MIN_RANKS=%d; aborting\n",
+            survivors * ranks_per_process_, elastic_min_ranks_);
+    LatchAbort(lost_rank,
+               lost + "; " + std::to_string(survivors * ranks_per_process_) +
+                   " surviving rank(s) fall below "
+                   "HOROVOD_TPU_ELASTIC_MIN_RANKS=" +
+                   std::to_string(elastic_min_ranks_));
+    SerializeAbort(response_list_blob);
+    for (const auto& j : joined) {
+      SendFrame(j.second, *response_list_blob);
+      CloseFd(j.second);
+    }
+    return true;
+  }
+
+  // Takeover: reconstruct the coordinator's seating from the replicated
+  // digest + the rendezvous, then drive the standard reconfigure path —
+  // which bumps the generation, re-ranks densely (we become process 0),
+  // broadcasts RECONFIGURE to the joined survivors, creates the message
+  // table and response cache this ex-worker never had, and rebuilds the
+  // data plane.
+  const int old_count = process_count_;
+  const int my_old_pidx = process_index_;
+  std::vector<int> fds(size_t(old_count), -1);
+  std::vector<int> franks(size_t(old_count), -1);
+  for (int p = 0; p < old_count; ++p) {
+    if (p < int(digest_first_ranks_.size())) {
+      franks[size_t(p)] = digest_first_ranks_[size_t(p)];
+    } else if (p < int(all_first_ranks_.size())) {
+      franks[size_t(p)] = all_first_ranks_[size_t(p)];
+    } else {
+      franks[size_t(p)] = p * ranks_per_process_;
+    }
+  }
+  for (size_t i = 0; i < joined.size(); ++i) {
+    fds[size_t(joined[i].first)] = joined[i].second;
+    franks[size_t(joined[i].first)] = joined_frank[i];
+  }
+  std::vector<int> dead_procs{0};
+  for (int p = 1; p < old_count; ++p) {
+    if (p != my_old_pidx && fds[size_t(p)] < 0) dead_procs.push_back(p);
+  }
+  worker_fds_ = std::move(fds);
+  worker_first_rank_ = std::move(franks);
+  // The pre-announced listener becomes the coordinator listen socket
+  // (standby admissions ride it from now on; a late survivor's 12-byte
+  // hello fails the 8-byte standby handshake and is closed — it cascades
+  // and aborts at its own rendezvous deadline).
+  listen_fd_ = failover_listen_fd_;
+  failover_listen_fd_ = -1;
+  coord_host_ = adv_host_;
+  {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    process_index_ = 0;
+    first_rank_ = 0;
+  }
+  coord_epoch_ += 1;
+  FlightRecorder::Get().SetRank(0);
+  FlightRecorder::Get().Record("elastic.failover_takeover", lost.c_str(),
+                               survivors, my_old_pidx, generation_);
+  const std::string reason =
+      lost + "; elected process " + std::to_string(my_old_pidx) +
+      " (lowest surviving index) as successor";
+  CoordinateReconfigure(dead_procs, lost_rank, reason, response_list_blob);
+  const double elect =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  Metrics::Get().Counter("elastic.failovers")
+      ->fetch_add(1, std::memory_order_relaxed);
+  Metrics::Get().Observe("elastic.election_seconds", elect);
+  Metrics::Get().SetGauge("coord.epoch", double(coord_epoch_));
+  fprintf(stderr,
+          "htpu elastic: process %d took over as coordinator "
+          "(epoch %d) in %.3fs\n",
+          my_old_pidx, coord_epoch_, elect);
+  // On a rebuild failure CoordinateReconfigure latched + serialized the
+  // abort; either way the blob is final.
+  return true;
+}
+
 bool ControlPlane::RebuildDataPlane() {
   // Torn-socket teardown: the old ring / hierarchy connections may hold
   // half-written frames from the failed generation; nothing on them is
@@ -1754,6 +2169,11 @@ void ControlPlane::FlushMembershipState() {
   offset_names_.clear();
   last_resp_recv_us_ = 0;
   last_bcast_us_ = 0;
+  // The replicated coordinator digest was keyed by the old membership;
+  // a worker re-arms failover from the first post-reconfigure digest
+  // (one completed tick — the same bootstrap requirement as launch).
+  have_digest_ = false;
+  digest_first_ranks_.clear();
 }
 
 // ------------------------------------------------- clock sync / skew

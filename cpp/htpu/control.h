@@ -182,6 +182,27 @@ class ControlPlane {
   // and rebuild the data plane.  Mirrors the tail of CoordinateReconfigure.
   bool ApplyReconfigure(const ResponseList& parsed,
                         std::string* response_list_blob);
+  // ---- coordinator failover (elastic only) ----
+  // Attach the coordinator-state digest (member table, cache epoch,
+  // standby roster, coordinator epoch) to an outbound steady-state frame.
+  void AttachDigest(ResponseList* out) const;
+  // Worker: remember the latest digest + failover address book from a
+  // parsed response so a coordinator loss can be survived.
+  void AdoptDigest(const ResponseList& parsed);
+  // Worker: the coordinator link died (torn socket or
+  // HOROVOD_TPU_COORD_TIMEOUT_S of silence).  Walk the deterministic
+  // successor order (lowest surviving process index first): serve as the
+  // new coordinator when it is this process's turn, otherwise rendezvous
+  // with the elected successor's pre-announced failover port.  True =>
+  // *response_list_blob holds the resulting RECONFIGURE (or attributed
+  // abort) frame; false => not in a position to fail over (non-elastic,
+  // no digest yet) and the caller falls through to the classic abort.
+  bool FailoverOnCoordLoss(std::string* response_list_blob);
+  // Successor half: accept surviving workers on the failover listener,
+  // validate quorum against HOROVOD_TPU_ELASTIC_MIN_RANKS, adopt the
+  // coordinator role and drive CoordinateReconfigure.  True on takeover
+  // (blob = RECONFIGURE frame) or an orderly quorum-refusal abort.
+  bool FailoverServe(std::string* response_list_blob);
   // Shared teardown + re-bootstrap: close ring/hierarchy sockets, reset
   // clock/skew state, and re-run SetupRing under the new membership.
   bool RebuildDataPlane();
@@ -421,6 +442,35 @@ class ControlPlane {
   // This process joined as a standby (HOROVOD_TPU_STANDBY=1) and parks in
   // Create until a RECONFIGURE frame admits it.
   bool is_standby_ = false;
+
+  // ---- coordinator failover (elastic only) ----
+  // Every process opens this listener at bootstrap and advertises its port
+  // through the SetupRing address book, so survivors can rendezvous with a
+  // successor without any post-failure negotiation.  Persists across
+  // reconfigurations; on takeover it becomes the successor's listen_fd_.
+  int failover_listen_fd_ = -1;
+  int failover_port_ = 0;
+  // host:port failover rendezvous address per process index, harvested
+  // from the address book on every (re-)bootstrap.
+  std::vector<std::string> failover_addrs_;
+  // Worker-side deadline on the coordinator link (HOROVOD_TPU_COORD_TIMEOUT_S,
+  // clamped to timeout_ms_): silence for this long triggers failover.
+  int coord_timeout_ms_ = 30000;
+  // Rendezvous budget for the whole election walk
+  // (HOROVOD_TPU_RENDEZVOUS_S): exhaustion degrades to the classic abort.
+  int rendezvous_ms_ = 30000;
+  // Backoff cap for rendezvous redials (HOROVOD_TPU_CONNECT_BACKOFF_MAX_S).
+  double connect_backoff_max_s_ = 1.0;
+  // Coordinator-incarnation epoch: 0 for the launch coordinator, bumped by
+  // every successful takeover.  Replicated through the digest.
+  int32_t coord_epoch_ = 0;
+  // Worker: the latest adopted digest — first_rank per live process index
+  // (position-indexed; the successor's seed for worker_first_rank_) plus
+  // the replicated epochs and standby roster.
+  std::vector<int32_t> digest_first_ranks_;
+  int32_t digest_cache_epoch_ = 0;
+  int32_t digest_standby_count_ = 0;
+  bool have_digest_ = false;
 };
 
 }  // namespace htpu

@@ -7,7 +7,9 @@
 // negotiation ticks, the ring data plane in every wire format (raw fp32,
 // bf16, int8), allgather, broadcast, and finally the abort path (process
 // 1 exits without shutdown; the survivors must latch an abort attributed
-// to rank 1 and fail data-plane calls fast).
+// to rank 1 and fail data-plane calls fast).  Two further elastic rounds
+// follow: a worker death that must RECONFIGURE (standby admission), and a
+// coordinator death that must fail over to an elected successor.
 //
 // NOT part of the shared library (it has a main()); keep it out of SRCS.
 #include <arpa/inet.h>
@@ -476,6 +478,103 @@ int RunElasticProcess(int pidx, int port) {
   return 0;
 }
 
+// Round 3 (coordinator failover): the COORDINATOR itself dies mid-run.
+// The survivors must detect the torn tick stream, elect the lowest
+// surviving process (old pidx 1) over the failover ports pre-announced at
+// bootstrap, rebuild a two-process world at generation 1 with the
+// successor seated at process index 0, and reduce exactly across it —
+// no aborts anywhere, under the sanitizers.
+int RunFailoverProcess(int pidx, int port) {
+  setenv("HOROVOD_TPU_ELASTIC", "1", 1);
+  setenv("HOROVOD_TPU_ELASTIC_MIN_RANKS", "1", 1);
+  setenv("HOROVOD_TPU_HOST_FINGERPRINT", "smokeF", 1);
+  setenv("HOROVOD_TPU_COORD_TIMEOUT_S", "5", 1);
+  setenv("HOROVOD_TPU_RENDEZVOUS_S", "10", 1);
+  auto cp = htpu::ControlPlane::Create(pidx, kProcs, "127.0.0.1", port,
+                                       /*first_rank=*/pidx,
+                                       /*nranks_total=*/kProcs,
+                                       /*timeout_ms=*/20000);
+  if (!cp) return Fail(pidx, "failover Create");
+
+  htpu::RequestList idle;
+  std::string tick_blob, resp;
+  htpu::SerializeRequestList(idle, &tick_blob);
+
+  // Healthy ticks first: the coordinator-state digest rides the
+  // steady-state broadcasts, and failover only arms once a worker has
+  // adopted one.
+  for (int i = 0; i < 3; ++i) {
+    if (!cp->Tick(tick_blob, 0, &resp)) return Fail(pidx, "failover tick");
+  }
+  std::vector<float> pre(512, float(pidx + 1));
+  if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(pre.data()),
+                        int64_t(pre.size() * sizeof(float)), "")) {
+    return Fail(pidx, "pre-failover allreduce");
+  }
+  for (float v : pre) {
+    if (std::fabs(v - 6.0f) > 0.01f) return Fail(pidx, "pre-failover value");
+  }
+
+  if (pidx == 0) {   // the coordinator dies without shutdown
+    fflush(nullptr);
+    _exit(0);
+  }
+  int32_t mp = -1, pc = -1, fr = -1, gen = -1;
+  for (int i = 0; i < 2000; ++i) {
+    cp->Membership(&mp, &pc, &fr, &gen);
+    if (gen >= 1) break;
+    if (cp->aborted()) return Fail(pidx, "aborted instead of failing over");
+    if (!cp->Tick(tick_blob, 0, &resp)) {
+      return Fail(pidx, "failover-wait tick");
+    }
+  }
+  cp->Membership(&mp, &pc, &fr, &gen);
+  if (gen != 1 || pc != kProcs - 1) return Fail(pidx, "post-failover world");
+  // Dense re-rank: old pidx 1 takes seat 0 (the successor), old 2 slides
+  // to 1.
+  if (mp != pidx - 1 || fr != pidx - 1) {
+    return Fail(pidx, "post-failover seat");
+  }
+  if (cp->aborted()) return Fail(pidx, "abort latched after failover");
+
+  // The successor-led plane must negotiate and reduce exactly:
+  // contributions keyed by the NEW process index sum to 1 + 2 = 3.
+  for (int i = 0; i < 2; ++i) {
+    if (!cp->Tick(tick_blob, 0, &resp)) {
+      return Fail(pidx, "post-failover tick");
+    }
+  }
+  std::vector<float> buf(512, float(mp + 1));
+  if (!cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                        int64_t(buf.size() * sizeof(float)), "")) {
+    return Fail(pidx, "post-failover allreduce");
+  }
+  for (float v : buf) {
+    if (std::fabs(v - 3.0f) > 0.01f) return Fail(pidx, "post-failover value");
+  }
+
+  // Failover metrics: the successor and the rejoined survivor each count
+  // their own failover and carry the bumped coordinator epoch.
+  {
+    void* mbuf = nullptr;
+    int len = htpu_metrics_snapshot(&mbuf);
+    if (len <= 0 || !mbuf) return Fail(pidx, "failover metrics snapshot");
+    std::string js(static_cast<const char*>(mbuf), size_t(len));
+    htpu_free(mbuf);
+    for (const char* key : {"\"elastic.failovers\":", "\"coord.epoch\":"}) {
+      size_t at = js.find(key);
+      if (at == std::string::npos ||
+          atoll(js.c_str() + at + strlen(key)) < 1) {
+        return Fail(pidx, "failover metric missing or zero");
+      }
+    }
+  }
+  fprintf(stderr,
+          "smoke proc %d: coordinator failover OK (gen %d, pidx %d)\n", pidx,
+          gen, mp);
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -529,6 +628,37 @@ int main() {
     if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
       fprintf(stderr, "smoke: elastic proc %d exited abnormally (status %d)\n",
               p, st);
+      rc = 1;
+    }
+  }
+  if (rc != 0) return rc;
+
+  // Round 3: kill the COORDINATOR under HOROVOD_TPU_ELASTIC=1 — the
+  // survivors must elect a successor and keep reducing instead of
+  // aborting.  Every child (the deliberately dying proc 0 included) must
+  // exit 0.
+  int fport = FreePort();
+  if (fport < 0) {
+    fprintf(stderr, "smoke: no free port for failover round\n");
+    return 1;
+  }
+  pid_t fpids[kProcs];
+  for (int p = 0; p < kProcs; ++p) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (pid == 0) _exit(RunFailoverProcess(p, fport));
+    fpids[p] = pid;
+  }
+  for (int p = 0; p < kProcs; ++p) {
+    int st = 0;
+    waitpid(fpids[p], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr,
+              "smoke: failover proc %d exited abnormally (status %d)\n", p,
+              st);
       rc = 1;
     }
   }

@@ -234,6 +234,18 @@ void SerializeResponseList(const ResponseList& l, std::string* out) {
         PutI32(out, m.first_rank);
       }
     }
+    PutI8(out, l.has_digest ? 1 : 0);
+    if (l.has_digest) {
+      PutI32(out, l.coord_epoch);
+      PutI32(out, l.digest_cache_epoch);
+      PutI32(out, int32_t(l.digest_members.size()));
+      for (const auto& m : l.digest_members) {
+        PutI32(out, m.first);
+        PutStr(out, m.second);
+      }
+      PutI32(out, int32_t(l.digest_standbys.size()));
+      for (int32_t s : l.digest_standbys) PutI32(out, s);
+    }
   }
 }
 
@@ -279,6 +291,11 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   out->lost_rank = -1;
   out->lost_reason.clear();
   out->members.clear();
+  out->has_digest = false;
+  out->coord_epoch = 0;
+  out->digest_cache_epoch = 0;
+  out->digest_members.clear();
+  out->digest_standbys.clear();
   if (out->has_elastic_ext) {
     uint8_t reconf;
     if (!GetI32(data, len, &pos, &out->generation)) return false;
@@ -295,6 +312,25 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
         if (!GetI32(data, len, &pos, &m.new_pidx)) return false;
         if (!GetI32(data, len, &pos, &m.first_rank)) return false;
       }
+    }
+    uint8_t digest;
+    if (!GetI8(data, len, &pos, &digest)) return false;
+    out->has_digest = digest != 0;
+    if (out->has_digest) {
+      if (!GetI32(data, len, &pos, &out->coord_epoch)) return false;
+      if (!GetI32(data, len, &pos, &out->digest_cache_epoch)) return false;
+      if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+      out->digest_members.resize(size_t(n));
+      for (int32_t i = 0; i < n; ++i) {
+        auto& m = out->digest_members[size_t(i)];
+        if (!GetI32(data, len, &pos, &m.first)) return false;
+        if (!GetStr(data, len, &pos, &m.second)) return false;
+      }
+      if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+      out->digest_standbys.resize(size_t(n));
+      for (int32_t i = 0; i < n; ++i)
+        if (!GetI32(data, len, &pos, &out->digest_standbys[size_t(i)]))
+          return false;
     }
   }
   return pos == len;

@@ -23,7 +23,11 @@
 //                  assignments:vec<slot:i32 name:str> evictions:vec<i32>]
 //                 [generation:i32 reconfigure:i8
 //                  (lost_rank:i32 lost_reason:str
-//                   members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)]
+//                   members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)
+//                  digest:i8
+//                  (coord_epoch:i32 cache_epoch:i32
+//                   members:vec<first_rank:i32 addr:str>
+//                   standbys:vec<i32>)]
 //
 // flags was historically the shutdown bool, so legacy frames (including
 // abort frames) decode unchanged: bit 0 = shutdown, bit 1 = the trailing
@@ -162,6 +166,19 @@ struct ResponseList {
   int32_t lost_rank = -1;
   std::string lost_reason;
   std::vector<ElasticMember> members;
+  // Coordinator-state digest (serialized inside the elastic extension,
+  // after the reconfigure payload, when has_digest): everything a survivor
+  // needs to take over as coordinator without new steady-state round
+  // trips — the coordinator-incarnation epoch, the response-cache epoch,
+  // the live member table (first_rank + pre-announced failover address
+  // per process index, ascending), and the parked-standby ids.  Piggybacks
+  // on frames the workers already receive, so steady-state tick count is
+  // unchanged; elastic-off traffic never carries it (golden-frame guard).
+  bool has_digest = false;
+  int32_t coord_epoch = 0;
+  int32_t digest_cache_epoch = 0;
+  std::vector<std::pair<int32_t, std::string>> digest_members;
+  std::vector<int32_t> digest_standbys;
 };
 
 // Serialization. Append to / read from a byte buffer.  `with_algo`

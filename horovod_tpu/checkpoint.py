@@ -24,6 +24,7 @@ import importlib
 import json
 import os
 import re
+import shutil
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -44,19 +45,70 @@ def save(directory: str, state: Any, epoch: int) -> Optional[str]:
     """Write a checkpoint on rank 0 only; other ranks no-op (convention 1).
 
     ``state`` is any pytree (e.g. ``{"params": ..., "opt_state": ...}``).
+
+    The commit is atomic: orbax writes into a dot-prefixed staging
+    directory that :func:`latest_epoch` can never match, and a single
+    ``os.replace`` publishes it as ``checkpoint-{epoch}``.  A crash
+    mid-save therefore leaves debris (cleaned up by the next save), never
+    a half-written directory a resume would restore from.
     """
     if basics.rank() != 0:
         return None
+    directory = os.path.abspath(directory)
     path = checkpoint_path(directory, epoch)
+    os.makedirs(directory, exist_ok=True)
+    _clean_stale(directory)
     # World-size sidecar lands BEFORE the checkpoint commits (same
     # ordering argument as the optimizer spec): an elastic resume that
-    # sees checkpoint-N can always tell what world wrote it.
-    os.makedirs(os.path.abspath(directory), exist_ok=True)
-    with open(_world_meta_path(directory, epoch), "w") as f:
-        json.dump({"world_size": basics.size(),
-                   "process_count": basics.process_count()}, f)
-    _checkpointer().save(path, state, force=True)
+    # sees checkpoint-N can always tell what world wrote it.  An orphan
+    # sidecar from a crash before the commit below is harmless —
+    # latest_epoch only matches committed checkpoint dirs — and is
+    # removed by the next save's _clean_stale.
+    _write_atomic(_world_meta_path(directory, epoch),
+                  json.dumps({"world_size": basics.size(),
+                              "process_count": basics.process_count()}))
+    staging = os.path.join(directory, f".tmp-checkpoint-{epoch}-{os.getpid()}")
+    _checkpointer().save(staging, state, force=True)
+    if os.path.isdir(path):
+        shutil.rmtree(path)   # force=True re-save of the same epoch
+    os.replace(staging, path)
     return path
+
+
+def _write_atomic(path: str, text: str) -> None:
+    """Publish ``text`` at ``path`` via a same-directory temp file and
+    ``os.replace``, so no reader ever sees a partially-written file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def _clean_stale(directory: str) -> None:
+    """Remove debris a mid-save crash can leave behind: uncommitted
+    staging directories, half-written sidecar temp files, and orphan
+    sidecars whose checkpoint never committed.  Runs in the single
+    writer (rank 0) at save time — outside a save there is no
+    in-flight staging, so everything matched is guaranteed stale."""
+    entries = set(os.listdir(directory))
+    for entry in entries:
+        p = os.path.join(directory, entry)
+        if re.fullmatch(r"\.tmp-checkpoint-\d+-\d+", entry):
+            shutil.rmtree(p, ignore_errors=True)
+        elif re.fullmatch(
+                r"checkpoint-\d+\.(world|optimizer)\.json\.tmp", entry):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        else:
+            m = re.fullmatch(r"(checkpoint-\d+)\.(world|optimizer)\.json",
+                             entry)
+            if m and m.group(1) not in entries:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
 
 def _world_meta_path(directory: str, epoch: int) -> str:
@@ -87,18 +139,21 @@ def _sharded_leaf_path(tree) -> Optional[str]:
 
 
 def latest_epoch(directory: str) -> int:
-    """Highest epoch with a checkpoint in ``directory``, or -1.
+    """Highest epoch with a COMMITTED checkpoint in ``directory``, or -1.
 
     Mirrors the reference's resume-epoch scan
     (``examples/keras_imagenet_resnet50.py:64-70``: try epochs descending,
-    first existing file wins).
+    first existing file wins).  Only committed checkpoint directories
+    count: :func:`save` stages under a dot-prefixed name the pattern
+    can never match and publishes atomically, so an entry seen here is
+    complete — sidecars and stray files are skipped.
     """
     if not os.path.isdir(directory):
         return -1
     best = -1
     for entry in os.listdir(directory):
         m = re.fullmatch(r"checkpoint-(\d+)", entry)
-        if m:
+        if m and os.path.isdir(os.path.join(directory, entry)):
             best = max(best, int(m.group(1)))
     return best
 
@@ -288,8 +343,8 @@ def save_model(directory: str, params: Any, opt_state: Any,
     # latest_epoch only matches checkpoint dirs).
     if basics.rank() == 0 and spec is not None:
         os.makedirs(os.path.abspath(directory), exist_ok=True)
-        with open(_optimizer_spec_path(directory, epoch), "w") as f:
-            f.write(spec.to_json())
+        _write_atomic(_optimizer_spec_path(directory, epoch),
+                      spec.to_json())
     return save(directory, {"params": params, "opt_state": opt_state},
                 epoch)
 

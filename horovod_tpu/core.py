@@ -1481,6 +1481,7 @@ class Controller:
             self._pending_report = None
             self._last_reported = None
             self._stall_warned.clear()
+        old_pidx = self.topology.process_index
         pidx, pcount, first_rank, generation = self._control.membership()
         lsize = self.topology.local_size
         new_size = pcount * lsize
@@ -1517,12 +1518,21 @@ class Controller:
         _metrics.registry.set_gauge("membership.generation", generation)
         cpp_core.flight_record(
             "elastic.adopted", f"gen={generation}", first_rank, new_size)
+        if pidx == 0 and old_pidx != 0:
+            # Coordinator failover seated THIS process as the successor
+            # (docs/elasticity.md): the native plane already swapped its
+            # worker tick loop for the coordinator role, and the stall
+            # scanner above keys off process_index, so coordinator-side
+            # duties start here automatically.  Note the promotion so an
+            # operator can tell a takeover from a plain shrink.
+            print(f"horovod_tpu elastic: this process (was process "
+                  f"{old_pidx}) took over as coordinator", file=sys.stderr)
         print(f"horovod_tpu elastic: continuing at generation {generation} "
               f"as rank {first_rank} of {new_size} "
               f"(process {pidx} of {pcount})", file=sys.stderr)
 
     def _maybe_check_stalls_distributed(self):
-        if self.stall_check_disabled or self.topology.process_index != 0:
+        if self.stall_check_disabled or not self.topology.is_coordinator:
             return
         now = time.monotonic()
         if now - self._last_stall_check < self.stall_warning_time_s:

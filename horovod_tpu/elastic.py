@@ -64,6 +64,36 @@ def generation() -> int:
     return ctl.membership()[3]
 
 
+def successor_candidates(process_count: int) -> list:
+    """Deterministic coordinator-successor order after process 0 is lost:
+    the surviving process indices, ascending.  Every survivor walks the
+    same list, so the first live candidate serves and the rest converge on
+    it.  Mirrors the C++ election walk (``FailoverOnCoordLoss``) — the two
+    are tested against each other."""
+    return list(range(1, process_count))
+
+
+def elect_successor(candidates: Sequence[int],
+                    failed: Sequence[int] = ()) -> Optional[int]:
+    """The elected successor: the lowest-indexed candidate not known to
+    have failed (``failed`` = candidates that were unreachable or died
+    mid-rendezvous, i.e. the cascade set).  None when every candidate is
+    exhausted — the caller degrades to the classic attributed abort."""
+    down = set(failed)
+    for c in candidates:
+        if c not in down:
+            return c
+    return None
+
+
+def quorum_ok(survivors: int, ranks_per_process: int,
+              min_ranks_floor: int) -> bool:
+    """True when a successor may take over: the surviving world must stay
+    at or above ``HOROVOD_TPU_ELASTIC_MIN_RANKS``.  Mirrors the C++ quorum
+    gate (``FailoverServe``)."""
+    return survivors * ranks_per_process >= min_ranks_floor
+
+
 def init(ranks: Optional[Sequence[int]] = None) -> None:
     """``hvd.init()`` for elastic jobs.
 

@@ -22,11 +22,42 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import signal
 import socket
 import subprocess
 import sys
 import time
+
+
+class Backoff:
+    """Bounded exponential backoff with jitter for reconnect/poll loops.
+
+    Sleeps start at ``base`` seconds and double per call up to
+    ``HOROVOD_TPU_CONNECT_BACKOFF_MAX_S`` (default 1.0); ±25% jitter
+    keeps a fleet of survivors from hammering a recovering endpoint in
+    lockstep.  Call :meth:`reset` after observed activity so the next
+    wait starts short again.  The native control plane applies the same
+    schedule between failed successor-rendezvous dials."""
+
+    def __init__(self, base: float = 0.05, cap: float = None):
+        if cap is None:
+            cap = float(os.environ.get(
+                "HOROVOD_TPU_CONNECT_BACKOFF_MAX_S", "1.0"))
+        self.base = base
+        self.cap = max(cap, base)
+        self._delay = base
+
+    def reset(self) -> None:
+        self._delay = self.base
+
+    def next_delay(self) -> float:
+        d = self._delay
+        self._delay = min(self._delay * 2.0, self.cap)
+        return d * (0.75 + 0.5 * random.random())
+
+    def sleep(self) -> None:
+        time.sleep(self.next_delay())
 
 
 def free_port() -> int:
@@ -174,6 +205,7 @@ def main(argv=None):
 def _supervise(procs, grace_s: float) -> int:
     first_rc = 0
     failed_at = None
+    bo = Backoff(cap=0.25)
     while True:
         running = False
         for i, proc in enumerate(procs):
@@ -183,6 +215,7 @@ def _supervise(procs, grace_s: float) -> int:
             elif rc != 0 and first_rc == 0:
                 first_rc = rc
                 failed_at = time.monotonic()
+                bo.reset()
                 print(f"horovod_tpu.run: process {i} (pid {proc.pid}) "
                       f"exited with code {rc}; waiting up to {grace_s:.0f}s "
                       "for the remaining processes before terminating them",
@@ -198,29 +231,53 @@ def _supervise(procs, grace_s: float) -> int:
                       file=sys.stderr)
             _reap(procs, sig=signal.SIGTERM, grace_s=5.0)
             return first_rc
-        time.sleep(0.1)
+        bo.sleep()
 
 
 def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
                        grace_s: float) -> int:
-    """Elastic supervision: a non-coordinator crash is survivable (the job
-    reconfigures around it), so instead of the fast-fail grace window the
-    crashed child is relaunched as a parked standby — ready to be admitted
-    back at the next membership change.  The job's outcome is the
-    coordinator's exit code (process 0 cannot be lost elastically), and
-    standby exits never fail the job: an unused spare exiting 0 is
-    success, a reaped one is teardown."""
+    """Elastic supervision with coordinator-failover awareness.
+
+    The *lead* is the worker expected to own the coordinator seat:
+    process 0 at launch, shifting to the lowest-indexed surviving worker
+    whenever the lead itself crashes — the survivors elect exactly that
+    process natively (docs/elasticity.md), so the launcher mirrors the
+    election rather than second-guessing it.  A non-lead crash is
+    survivable and the child is relaunched as a parked standby; a dead
+    lead is NOT replaced, because a relaunched spare would dial the
+    stale coordinator address and park out uselessly.  The job's outcome
+    is the FINAL lead's exit code, and standby exits never fail the job:
+    an unused spare exiting 0 is success, a reaped one is teardown."""
     restarts = 0
     handled = set()
-    coord_done_at = None
+    lead = 0
+    lead_done_at = None
+    bo = Backoff()
     while True:
+        rcs = [p.poll() for p in procs]
+        # Lead lineage: a crashed lead with live workers means the
+        # survivors are electing (or already serving under) a successor
+        # coordinator — follow them to the lowest-indexed survivor and
+        # judge the job by the new lead, not the corpse.
+        while (rcs[lead] is not None and rcs[lead] != 0
+               and any(rc is None for rc in rcs)):
+            new_lead = min(i for i, rc in enumerate(rcs) if rc is None)
+            print(f"horovod_tpu.run: lead process {lead} "
+                  f"(pid {procs[lead].pid}) exited with code {rcs[lead]}; "
+                  f"elastic failover — process {new_lead} is the new lead",
+                  file=sys.stderr)
+            handled.add(lead)   # never respawned: its seat moved, and a
+            lead = new_lead     # spare would dial the stale address
+            lead_done_at = None
+            bo.reset()
         workers_running = False
         for i, proc in enumerate(procs):
-            rc = proc.poll()
+            rc = rcs[i]
             if rc is None:
                 workers_running = True
-            elif i > 0 and rc != 0 and i not in handled:
+            elif i != lead and rc != 0 and i not in handled:
                 handled.add(i)
+                bo.reset()
                 if restarts < max_restarts:
                     restarts += 1
                     sb = spawn_standby()
@@ -234,22 +291,23 @@ def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
                           f"exited with code {rc}; restart budget "
                           f"({max_restarts}) exhausted — not replaced",
                           file=sys.stderr)
-        rc0 = procs[0].poll()
-        if rc0 is not None:
-            if coord_done_at is None:
-                coord_done_at = time.monotonic()
-            stragglers = time.monotonic() - coord_done_at > grace_s
+        rc_lead = rcs[lead]
+        if rc_lead is not None:
+            if lead_done_at is None:
+                lead_done_at = time.monotonic()
+            stragglers = time.monotonic() - lead_done_at > grace_s
             if not workers_running or stragglers:
                 # Admitted standbys exit through the same shutdown
                 # broadcast as the workers — give them a moment before
                 # reaping the parked (or wedged) remainder.
+                drain = Backoff()
                 deadline = time.monotonic() + 5.0
                 while (time.monotonic() < deadline
                        and any(p.poll() is None for p in standbys)):
-                    time.sleep(0.1)
+                    drain.sleep()
                 _reap(procs + standbys, sig=signal.SIGTERM, grace_s=5.0)
-                return rc0
-        time.sleep(0.1)
+                return rc_lead
+        bo.sleep()
 
 
 def _reap(procs, sig, grace_s: float):

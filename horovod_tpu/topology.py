@@ -92,6 +92,16 @@ class Topology:
         return 0
 
     @property
+    def is_coordinator(self) -> bool:
+        """True when this process currently holds the coordinator seat
+        (process index 0).  The seat is positional, not a fixed process:
+        after an elastic coordinator failover the successor is densely
+        re-ranked INTO index 0 (docs/elasticity.md), so this stays
+        correct across takeovers — consult the live topology rather than
+        caching the launch-time answer."""
+        return self.process_index == 0
+
+    @property
     def local_rank_device_ids(self) -> Tuple[int, ...]:
         return tuple(d.id for d in self.local_devices)
 

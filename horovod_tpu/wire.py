@@ -81,16 +81,29 @@ class RequestElasticExt:
 class ResponseElasticExt:
     """Trailing ResponseList elastic extension:
     ``generation:i32 reconfigure:i8 (lost_rank:i32 lost_reason:str
-    members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)``.
+    members:vec<old_pidx:i32 new_pidx:i32 first_rank:i32>)
+    digest:i8 (coord_epoch:i32 cache_epoch:i32
+    members:vec<first_rank:i32 addr:str> standbys:vec<i32>)``.
 
     ``members`` is the survivor/standby re-ranking table of a RECONFIGURE
-    frame; a receiver absent from it has been evicted."""
+    frame; a receiver absent from it has been evicted.  The trailing
+    coordinator-state digest (``has_digest``) replicates everything a
+    survivor needs to take over as coordinator: the coordinator-incarnation
+    epoch, the response-cache epoch, the member table (first rank +
+    pre-announced failover address per process index) and the
+    parked-standby roster — see docs/elasticity.md#coordinator-failover."""
     generation: int = 0
     reconfigure: bool = False
     lost_rank: int = -1
     lost_reason: str = ""
     members: List[Tuple[int, int, int]] = dataclasses.field(
         default_factory=list)
+    has_digest: bool = False
+    coord_epoch: int = 0
+    digest_cache_epoch: int = 0
+    digest_members: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+    digest_standbys: List[int] = dataclasses.field(default_factory=list)
 
 
 def _put_str(out: bytearray, s: str) -> None:
@@ -325,6 +338,17 @@ def serialize_response_list(responses: List[Response],
             out += struct.pack("<i", len(elastic_ext.members))
             for old_pidx, new_pidx, first_rank in elastic_ext.members:
                 out += struct.pack("<iii", old_pidx, new_pidx, first_rank)
+        out += struct.pack("<B", 1 if elastic_ext.has_digest else 0)
+        if elastic_ext.has_digest:
+            out += struct.pack("<i", elastic_ext.coord_epoch)
+            out += struct.pack("<i", elastic_ext.digest_cache_epoch)
+            out += struct.pack("<i", len(elastic_ext.digest_members))
+            for first_rank, addr in elastic_ext.digest_members:
+                out += struct.pack("<i", first_rank)
+                _put_str(out, addr)
+            out += struct.pack("<i", len(elastic_ext.digest_standbys))
+            for sid in elastic_ext.digest_standbys:
+                out += struct.pack("<i", sid)
     return bytes(out)
 
 
@@ -361,9 +385,21 @@ def parse_response_list_elastic(data: bytes) -> Tuple[
             lost_reason = rd.str_()
             members = [(rd.i32(), rd.i32(), rd.i32())
                        for _ in range(rd.i32())]
+        has_digest = bool(rd.i8())
+        coord_epoch, digest_cache_epoch = 0, 0
+        digest_members, digest_standbys = [], []
+        if has_digest:
+            coord_epoch = rd.i32()
+            digest_cache_epoch = rd.i32()
+            digest_members = [(rd.i32(), rd.str_())
+                              for _ in range(rd.i32())]
+            digest_standbys = [rd.i32() for _ in range(rd.i32())]
         elastic = ResponseElasticExt(
             generation=generation, reconfigure=reconfigure,
-            lost_rank=lost_rank, lost_reason=lost_reason, members=members)
+            lost_rank=lost_rank, lost_reason=lost_reason, members=members,
+            has_digest=has_digest, coord_epoch=coord_epoch,
+            digest_cache_epoch=digest_cache_epoch,
+            digest_members=digest_members, digest_standbys=digest_standbys)
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in response list: parsed {rd.pos} of "

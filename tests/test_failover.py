@@ -1,0 +1,444 @@
+"""Coordinator failover (PR: replicated control state + successor election).
+
+Fast tests cover the pure pieces: deterministic successor election and its
+quorum gate (the Python mirrors of the native walk), the coordinator-state
+digest wire codec (including the golden-frame guarantee that elastic-OFF
+frames are untouched), the bounded reconnect backoff, the launcher's
+lead-lineage supervision, and the atomic checkpoint commit.  Slow tests
+launch real 3-process elastic groups over the native control plane and
+kill the COORDINATOR mid-training:
+
+* rank 0 dies — the survivors elect process 1, rebuild a 2-process world
+  at generation 1, and resume from the latest checkpoint with
+  bit-identical params, never seeing :class:`HorovodAbortedError`;
+* rank 0 dies while the elected successor is wedged — the rendezvous
+  deadline expires and every reachable rank latches ONE attributed abort
+  (stall-then-abort, never hang);
+* rank 0 and rank 1 die together under ``HOROVOD_TPU_ELASTIC_MIN_RANKS=2``
+  — the last survivor refuses quorum and aborts with the attributed
+  cause.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cpp_core, elastic, wire
+from horovod_tpu.run import Backoff
+
+from test_elastic import finish, start_elastic_procs
+
+# ------------------------------------------------------------------ fast unit
+
+
+class TestElection:
+    def test_candidates_ascending(self):
+        assert elastic.successor_candidates(4) == [1, 2, 3]
+        assert elastic.successor_candidates(2) == [1]
+        assert elastic.successor_candidates(1) == []
+
+    def test_lowest_survivor_wins(self):
+        c = elastic.successor_candidates(4)
+        assert elastic.elect_successor(c) == 1
+
+    def test_cascade_on_successor_death(self):
+        c = elastic.successor_candidates(4)
+        assert elastic.elect_successor(c, failed=[1]) == 2
+        assert elastic.elect_successor(c, failed=[1, 2]) == 3
+
+    def test_exhaustion_returns_none(self):
+        c = elastic.successor_candidates(3)
+        assert elastic.elect_successor(c, failed=[1, 2]) is None
+        assert elastic.elect_successor([]) is None
+
+    def test_deterministic_across_survivors(self):
+        """Every survivor must converge on the same successor no matter
+        which subset of the cascade it has personally observed fail —
+        the failed set only ever grows toward the same fixed point."""
+        c = elastic.successor_candidates(5)
+        assert (elastic.elect_successor(c, failed=[1])
+                == elastic.elect_successor(c, failed=[1]) == 2)
+
+    def test_quorum_gate(self):
+        assert elastic.quorum_ok(2, 1, 2)
+        assert not elastic.quorum_ok(1, 1, 2)
+        assert elastic.quorum_ok(1, 4, 3)       # ranks-per-process counts
+        assert elastic.quorum_ok(1, 1, 1)
+
+
+class TestDigestWire:
+    def test_digest_roundtrip(self):
+        ext = wire.ResponseElasticExt(
+            generation=2, has_digest=True, coord_epoch=1,
+            digest_cache_epoch=7,
+            digest_members=[(0, "10.0.0.1:4001"), (2, "10.0.0.2:4002")],
+            digest_standbys=[-2, -3])
+        blob = wire.serialize_response_list([], elastic_ext=ext)
+        _, _, _, _, out = wire.parse_response_list_elastic(blob)
+        assert out.has_digest
+        assert out.coord_epoch == 1 and out.digest_cache_epoch == 7
+        assert out.digest_members == [(0, "10.0.0.1:4001"),
+                                      (2, "10.0.0.2:4002")]
+        assert out.digest_standbys == [-2, -3]
+
+    def test_ext_without_digest_roundtrip(self):
+        """RECONFIGURE frames carry the ext but no digest (their address
+        book predates the rebuild) — the mandatory flag byte must say so."""
+        blob = wire.serialize_response_list(
+            [], elastic_ext=wire.ResponseElasticExt(generation=3,
+                                                    reconfigure=True,
+                                                    members=[(0, 0, 0)]))
+        _, _, _, _, out = wire.parse_response_list_elastic(blob)
+        assert not out.has_digest
+        assert out.digest_members == [] and out.digest_standbys == []
+        assert out.coord_epoch == 0
+
+    def test_elastic_off_frames_byte_identical(self):
+        """Golden-frame acceptance: with elastic off there is no ext and
+        therefore no digest byte — the wire format is exactly the
+        pre-failover (and pre-elastic) one."""
+        plain = wire.serialize_response_list([], shutdown=True)
+        assert not plain[0] & wire.FLAG_ELASTIC_EXT
+        assert wire.serialize_response_list([], shutdown=True,
+                                            elastic_ext=None) == plain
+
+    def test_digest_changes_bytes(self):
+        base = wire.serialize_response_list(
+            [], elastic_ext=wire.ResponseElasticExt(generation=1))
+        with_digest = wire.serialize_response_list(
+            [], elastic_ext=wire.ResponseElasticExt(
+                generation=1, has_digest=True, coord_epoch=0,
+                digest_members=[(0, "h:1")]))
+        assert base != with_digest
+
+    def test_pre_elastic_parser_skips_digest(self):
+        """The elastic-agnostic parse entry point must still skip the
+        whole trailer, digest included."""
+        blob = wire.serialize_response_list(
+            [], elastic_ext=wire.ResponseElasticExt(
+                generation=1, has_digest=True, coord_epoch=2,
+                digest_members=[(0, "host:9"), (1, "host:10")],
+                digest_standbys=[-2]))
+        resps, shutdown, abort = wire.parse_response_list(blob)
+        assert resps == [] and not shutdown and abort is None
+
+
+class TestBackoff:
+    def test_bounded_and_doubling(self):
+        bo = Backoff(base=0.05, cap=0.4)
+        raw = []
+        for _ in range(8):
+            d = bo.next_delay()
+            raw.append(d)
+            assert 0.05 * 0.75 <= d <= 0.4 * 1.25
+        # Jitter is ±25%, so consecutive raw delays can overlap, but the
+        # schedule must reach (and then stay at) the cap region.
+        assert raw[-1] >= 0.4 * 0.75
+
+    def test_reset_returns_to_base(self):
+        bo = Backoff(base=0.05, cap=1.0)
+        for _ in range(6):
+            bo.next_delay()
+        bo.reset()
+        assert bo.next_delay() <= 0.05 * 1.25
+
+    def test_cap_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_CONNECT_BACKOFF_MAX_S", "0.2")
+        bo = Backoff(base=0.05)
+        assert bo.cap == 0.2
+        for _ in range(10):
+            assert bo.next_delay() <= 0.2 * 1.25
+
+    def test_cap_never_below_base(self):
+        bo = Backoff(base=0.5, cap=0.1)
+        assert bo.cap == 0.5
+
+
+class _FakeProc:
+    """poll() walks a schedule (None = still running); the last entry
+    repeats.  Stands in for subprocess.Popen in supervision tests."""
+
+    _next_pid = [1000]
+
+    def __init__(self, schedule):
+        self._schedule = list(schedule)
+        self.pid = self._next_pid[0]
+        self._next_pid[0] += 1
+
+    def poll(self):
+        if len(self._schedule) > 1:
+            return self._schedule.pop(0)
+        return self._schedule[0]
+
+    def send_signal(self, sig):
+        pass
+
+    def wait(self, timeout=None):
+        return self._schedule[-1]
+
+
+class TestLeadLineage:
+    def _supervise(self, procs, standbys=None, max_restarts=3):
+        from horovod_tpu import run as run_mod
+        spawned = []
+
+        def spawn_standby():
+            sb = _FakeProc([0])
+            spawned.append(sb)
+            return sb
+        # Keep the poll backoff tiny so these scripted runs finish fast.
+        old = os.environ.get("HOROVOD_TPU_CONNECT_BACKOFF_MAX_S")
+        os.environ["HOROVOD_TPU_CONNECT_BACKOFF_MAX_S"] = "0.02"
+        try:
+            rc = run_mod._supervise_elastic(procs, standbys or [],
+                                            spawn_standby, max_restarts,
+                                            grace_s=0.5)
+        finally:
+            if old is None:
+                del os.environ["HOROVOD_TPU_CONNECT_BACKOFF_MAX_S"]
+            else:
+                os.environ["HOROVOD_TPU_CONNECT_BACKOFF_MAX_S"] = old
+        return rc, spawned
+
+    def test_outcome_is_final_leads_exit_code(self, capsys):
+        # Lead (0) crashes; survivors keep running then exit 0 — the job
+        # is judged by the new lead (1), and the dead lead is NOT
+        # replaced with a standby.
+        procs = [_FakeProc([-9]),
+                 _FakeProc([None, None, None, 0]),
+                 _FakeProc([None, None, None, 0])]
+        rc, spawned = self._supervise(procs)
+        assert rc == 0
+        assert spawned == []
+        err = capsys.readouterr().err
+        assert "process 1 is the new lead" in err
+
+    def test_cascaded_lead_crash(self, capsys):
+        # Lead 0 dies, then the successor lead 1 dies too: the lineage
+        # walks to 2 and the job returns ITS exit code.
+        procs = [_FakeProc([-9]),
+                 _FakeProc([None, -9]),
+                 _FakeProc([None, None, None, 7])]
+        rc, spawned = self._supervise(procs)
+        assert rc == 7
+        assert spawned == []
+        err = capsys.readouterr().err
+        assert "process 1 is the new lead" in err
+        assert "process 2 is the new lead" in err
+
+    def test_all_dead_returns_first_lead_rc(self):
+        # No survivors: nothing to fail over to — classic outcome, the
+        # lead's own exit code.
+        procs = [_FakeProc([5]), _FakeProc([1]), _FakeProc([1])]
+        rc, _ = self._supervise(procs)
+        assert rc == 5
+
+    def test_non_lead_crash_still_respawns(self, capsys):
+        procs = [_FakeProc([None] * 6 + [0]),
+                 _FakeProc([None] * 6 + [0]),
+                 _FakeProc([1])]
+        rc, spawned = self._supervise(procs)
+        assert rc == 0
+        assert len(spawned) == 1
+        assert "relaunched as standby" in capsys.readouterr().err
+
+    def test_clean_lead_exit_does_not_shift(self, capsys):
+        # A lead exiting 0 means the job FINISHED — the lineage must not
+        # reinterpret success as a failover.
+        procs = [_FakeProc([0]), _FakeProc([None, None, 0])]
+        rc, spawned = self._supervise(procs)
+        assert rc == 0
+        assert "new lead" not in capsys.readouterr().err
+
+
+class TestAtomicCheckpoint:
+    def test_mid_save_crash_leaves_no_visible_checkpoint(self, hvd,
+                                                         tmp_path,
+                                                         monkeypatch):
+        """A crash inside the orbax write must leave latest_epoch at the
+        previous committed checkpoint, never a half-written dir."""
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        checkpoint.save(d, {"w": np.arange(4, dtype=np.float32)}, 0)
+        assert checkpoint.latest_epoch(d) == 0
+
+        class _Boom(RuntimeError):
+            pass
+
+        real = checkpoint._checkpointer
+
+        class _Crashing:
+            def save(self, path, state, force=False):
+                real().save(path, state, force=force)  # staging written...
+                raise _Boom("killed mid-commit")       # ...but never published
+        monkeypatch.setattr(checkpoint, "_checkpointer", lambda: _Crashing())
+        with pytest.raises(_Boom):
+            checkpoint.save(d, {"w": np.zeros(4, np.float32)}, 1)
+        assert checkpoint.latest_epoch(d) == 0
+        assert any(e.startswith(".tmp-checkpoint-1-")
+                   for e in os.listdir(d))
+
+    def test_next_save_cleans_crash_debris(self, hvd, tmp_path):
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        # Simulated debris: a stale staging dir, an orphan world sidecar,
+        # an orphan optimizer sidecar, and a half-written sidecar temp.
+        os.makedirs(os.path.join(d, ".tmp-checkpoint-3-12345"))
+        for name in ("checkpoint-3.world.json", "checkpoint-3.optimizer.json",
+                     "checkpoint-4.world.json.tmp"):
+            with open(os.path.join(d, name), "w") as f:
+                f.write("{}")
+        checkpoint.save(d, {"w": np.arange(4, dtype=np.float32)}, 5)
+        left = set(os.listdir(d))
+        assert "checkpoint-5" in left
+        assert not any(e.startswith(".tmp-checkpoint-") for e in left)
+        assert "checkpoint-3.world.json" not in left
+        assert "checkpoint-3.optimizer.json" not in left
+        assert "checkpoint-4.world.json.tmp" not in left
+        # The live epoch's sidecar survives, naturally.
+        assert "checkpoint-5.world.json" in left
+
+    def test_latest_epoch_ignores_non_dirs_and_sidecars(self, tmp_path):
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        with open(os.path.join(d, "checkpoint-9"), "w") as f:
+            f.write("not a checkpoint dir")
+        with open(os.path.join(d, "checkpoint-8.world.json"), "w") as f:
+            f.write("{}")
+        os.makedirs(os.path.join(d, ".tmp-checkpoint-7-1"))
+        assert checkpoint.latest_epoch(d) == -1
+        os.makedirs(os.path.join(d, "checkpoint-2"))
+        assert checkpoint.latest_epoch(d) == 2
+
+    def test_resave_same_epoch_replaces(self, hvd, tmp_path):
+        from horovod_tpu import checkpoint
+        d = str(tmp_path)
+        checkpoint.save(d, {"w": np.zeros(4, np.float32)}, 0)
+        w = np.arange(4, dtype=np.float32)
+        checkpoint.save(d, {"w": w}, 0)
+        out = checkpoint.restore(d, 0, {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+
+
+class TestFailoverKnobDefaults:
+    def test_backoff_default(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_CONNECT_BACKOFF_MAX_S",
+                           raising=False)
+        assert Backoff().cap == 1.0
+
+
+# ------------------------------------------------------- slow multi-process
+
+pytestmark_native = pytest.mark.skipif(
+    not cpp_core.available(), reason="native core not built")
+
+# Worker for the wedged-successor scenario: rank 1 SIGSTOPs itself after a
+# few healthy steps (digest replicated, listener open, process frozen);
+# rank 0 dies on a wall-clock timer shortly after, while the job is
+# stalled on the wedge.  Rank 2 is left to run the doomed rendezvous.
+WEDGED_SUCCESSOR_WORKER = """
+import os, signal, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+elastic.init()
+rank = hvd.rank()
+if rank == 0:
+    threading.Timer(3.0, lambda: os._exit(42)).start()
+try:
+    for i in range(100000):
+        if rank == 1 and i == 5:
+            os.kill(os.getpid(), signal.SIGSTOP)
+        hvd.allreduce(np.ones(8, np.float32), name=f"fo.{i}")
+        time.sleep(0.01)
+except hvd.HorovodAbortedError as e:
+    print(f"ABORTED rank={rank} msg={e}", flush=True)
+    sys.exit(3)
+print("FINISHED", flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytestmark_native
+class TestCoordinatorFailover:
+    def test_kill_rank0_elects_successor_and_resumes(self, tmp_path):
+        """ISSUE acceptance: kill the coordinator mid-training.  The
+        survivors must elect process 1, rebuild a 2-process world at
+        generation 1, and resume from the latest checkpoint with
+        bit-identical restored params — no HorovodAbortedError anywhere."""
+        procs = start_elastic_procs(
+            3, tmp_path,
+            {"HOROVOD_TPU_FAULT": "crash:rank=0:tick=60",
+             "HOROVOD_TPU_RENDEZVOUS_S": "20",
+             "TEST_EXPECT_SIZE": "2"})
+        results = [finish(p) for p in procs]
+        rc0, out0 = results[0]
+        assert rc0 == 42, out0   # _exit(42) from the injected crash
+        assert "crashing rank 0" in out0, out0
+        rc1, out1 = results[1]
+        assert rc1 == 0, out1
+        assert "ABORTED" not in out1, out1
+        assert "took over as coordinator" in out1, out1
+        assert "RESUMED rank=0 size=2 gen=1" in out1, out1
+        assert "state_ok=True" in out1 and "DONE" in out1, out1
+        rc2, out2 = results[2]
+        assert rc2 == 0, out2
+        assert "ABORTED" not in out2, out2
+        assert "rejoined under successor" in out2, out2
+        assert "RESUMED rank=1 size=2 gen=1" in out2, out2
+        assert "state_ok=True" in out2 and "DONE" in out2, out2
+
+    def test_wedged_successor_exhausts_rendezvous_then_aborts(self,
+                                                              tmp_path):
+        """Rank 1 (the would-be successor) is wedged (SIGSTOP — process
+        alive, listener socket open, nobody home) when rank 0 dies: the
+        last survivor dials it, gets silence, and must degrade to ONE
+        attributed abort when HOROVOD_TPU_RENDEZVOUS_S expires — never
+        hang.  A tick-scheduled hang fault cannot produce this shape (a
+        wedged worker freezes the coordinator's tick counter, so a
+        tick-armed coordinator crash never fires); the wedge and the
+        wall-clock kill below are the only way into the window."""
+        procs = start_elastic_procs(
+            3, tmp_path,
+            {"HOROVOD_TPU_RENDEZVOUS_S": "5"},
+            script=WEDGED_SUCCESSOR_WORKER)
+        t0 = time.monotonic()
+        rc0, out0 = finish(procs[0])
+        rc2, out2 = finish(procs[2])
+        assert rc0 == 42, out0
+        assert rc2 == 3, out2
+        assert "ABORTED" in out2, out2
+        assert "rendezvous did not complete" in out2, out2
+        assert "HOROVOD_TPU_RENDEZVOUS_S" in out2, out2
+        assert time.monotonic() - t0 < 90
+        # The wedged rank never finishes on its own; reap it.
+        rc1, out1 = finish(procs[1], timeout=5)
+        assert rc1 is None, out1
+
+    def test_quorum_refusal_aborts_with_attributed_cause(self, tmp_path):
+        """Both rank 0 and rank 1 die under ELASTIC_MIN_RANKS=2: the last
+        survivor cascades past the dead successor, serves the rendezvous
+        itself, finds quorum impossible, and aborts with the attributed
+        cause instead of taking over a sub-quorum world."""
+        procs = start_elastic_procs(
+            3, tmp_path,
+            {"HOROVOD_TPU_FAULT": "crash:rank=0:tick=60;crash:rank=1:tick=60",
+             "HOROVOD_TPU_ELASTIC_MIN_RANKS": "2",
+             "HOROVOD_TPU_RENDEZVOUS_S": "5",
+             "TEST_EXPECT_SIZE": "3"})
+        results = [finish(p) for p in procs]
+        assert results[0][0] == 42, results[0][1]
+        assert results[1][0] == 42, results[1][1]
+        rc2, out2 = results[2]
+        assert rc2 == 3, out2
+        assert "ABORTED" in out2, out2
+        assert "HOROVOD_TPU_ELASTIC_MIN_RANKS" in out2, out2
+        assert "RESUMED" not in out2, out2

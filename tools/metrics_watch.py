@@ -155,8 +155,9 @@ def render_skew_summary(snap: dict, name_filter: str) -> list[str]:
 
 def render_elastic_summary(snap: dict, name_filter: str) -> list:
     """One-line elastic digest: membership generation, reconfiguration
-    count, and the last reconfiguration's downtime — present only on jobs
-    that exported the elastic series (docs/elasticity.md)."""
+    and coordinator-failover counts, coordinator epoch, and the last
+    reconfiguration's downtime — present only on jobs that exported the
+    elastic series (docs/elasticity.md)."""
     gauges = snap.get("gauges", {})
     counters = snap.get("counters", {})
     gen = gauges.get("membership.generation")
@@ -165,9 +166,14 @@ def render_elastic_summary(snap: dict, name_filter: str) -> list:
         return []
     if name_filter and all(name_filter not in n for n in (
             "membership.generation", "elastic.reconfigs",
-            "elastic.last_downtime_s")):
+            "elastic.failovers", "elastic.last_downtime_s",
+            "coord.epoch")):
         return []
     text = f"generation={int(gen or 0)} reconfigs={reconfigs}"
+    failovers = counters.get("elastic.failovers", 0)
+    if failovers:
+        text += (f" failovers={failovers}"
+                 f" coord_epoch={int(gauges.get('coord.epoch', 0))}")
     last = gauges.get("elastic.last_downtime_s")
     if last is not None:
         text += f" last_downtime={last:.3g}s"
