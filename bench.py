@@ -461,6 +461,65 @@ def bench_transformer(jax, hvd, mesh, nchips):
                            "identity, so the gradient wire never "
                            "engages — run the multi-chip leg for the "
                            "fp32/bf16/int8 comparison"}
+    # In-jit overlap A/B: identical program except reduce_gradients
+    # emits per-bucket collectives in the scheduler's overlap order
+    # (tail bucket first — ready while earlier layers still
+    # differentiate) instead of one fused tail collective.  Bucket
+    # contents are issue-order independent, so any step-time delta is
+    # XLA's latency hiding, not different math.
+    overlap_ab = None
+    if os.environ.get("BENCH_TLM_OVERLAP_AB", "1") == "1" and nchips > 1:
+        ol_iters = max(2, timed_batches // 2)
+
+        def _overlap_leg(ov):
+            ostep = make_train_step(loss_fn, tx, mesh,
+                                    sync_aux_state=False,
+                                    steps_per_call=spc, donate=False,
+                                    overlap=ov)
+            st = (params, {}, tx.init(params))
+            ostep, _, _ = aot_compile(ostep, (*st, tokens))
+            p, aux, o, loss = ostep(*st, tokens)   # warmup binds loss
+            np.asarray(loss)
+
+            def one(s, data):
+                p, aux, o, _ = s
+                return ostep(p, aux, o, data)
+
+            state = (p, aux, o, loss)
+            _, d = _timed(one, state, tokens, ol_iters, 2, np)
+
+            def target():
+                np.asarray(one(state, tokens)[-1])
+
+            return d / (ol_iters * spc), target
+
+        overlap_ab = {}
+        for mode, ov in (("off", False), ("on", True)):
+            try:
+                sec, target = _overlap_leg(ov)
+            except Exception as exc:   # noqa: BLE001 — per-leg, not fatal
+                overlap_ab[mode] = {"error": f"{type(exc).__name__}: "
+                                             f"{exc}"[:300]}
+                continue
+            overlap_ab[mode] = {
+                "step_time_ms": round(sec * 1e3, 2),
+                "comm_fraction": _comm_fraction(jax, target),
+            }
+        if ("step_time_ms" in overlap_ab.get("on", {})
+                and "step_time_ms" in overlap_ab.get("off", {})):
+            overlap_ab["on_faster_than_off"] = (
+                overlap_ab["on"]["step_time_ms"]
+                < overlap_ab["off"]["step_time_ms"])
+            if overlap_ab["on"]["comm_fraction"] is None:
+                overlap_ab["note"] = (
+                    "hidden/exposed comm seconds live inside XLA's "
+                    "schedule on the in-jit plane (no host-side "
+                    "measurement point); the eager counterpart in "
+                    "scaling_tcp_2proc.overlap_ab reports the measured "
+                    "hidden/exposed split")
+    elif os.environ.get("BENCH_TLM_OVERLAP_AB", "1") == "1":
+        overlap_ab = {"note": "single chip: no collectives to "
+                              "overlap — run the multi-chip leg"}
     return {
         "transformer_lm": {
             "tokens_per_sec_per_chip": round(tok_per_sec / nchips, 1),
@@ -473,6 +532,7 @@ def bench_transformer(jax, hvd, mesh, nchips):
             "dim": dim, "depth": depth, "seq_len": seq,
             "batch_per_chip": batch_per_chip, "attn": attn,
             **({"injit_wire_ab": wire_ab} if wire_ab else {}),
+            **({"overlap_ab": overlap_ab} if overlap_ab else {}),
         }
     }
 
@@ -722,6 +782,56 @@ def tcp_worker():
             stats["faster_than_fp32"] = dt < dt_raw
         wire_stats[wire] = stats
 
+    # Overlap A/B: the same loop with the bucketed-overlap scheduler off
+    # (per-leaf allreduce after backward fully materializes) and on
+    # (bucketed allreduces issued the moment each bucket's last gradient
+    # lands, docs/concepts.md "Scheduler and overlap").  The ON leg's
+    # comm_fraction counts only *exposed* communication — comm hidden
+    # under backward is not time the step waited for — with the
+    # hidden/exposed split read off the overlap.* histograms so the
+    # bench and the live telemetry can never disagree.
+    def _overlap_ab(p, s):
+        results = {}
+        for mode, ov in (("off", False), ("on", True)):
+            # Warm outside the window: bucket planning + first-use
+            # negotiation of the leg's tensor names.
+            loss, grads = grads_fn(p)
+            grads = hvd_jax.allreduce_gradients(
+                grads, overlap=ov, name_prefix=f"olab.{mode}")
+            p, s = apply_fn(p, s, grads)
+            h0 = hvd_metrics.snapshot().get("histograms", {})
+            t_comm = 0.0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, grads = grads_fn(p)
+                if not ov:
+                    jax.block_until_ready(grads)
+                c0 = time.perf_counter()
+                grads = hvd_jax.allreduce_gradients(
+                    grads, overlap=ov, name_prefix=f"olab.{mode}")
+                jax.block_until_ready(grads)
+                t_comm += time.perf_counter() - c0
+                p, s = apply_fn(p, s, grads)
+            np.asarray(loss)
+            dt = time.perf_counter() - t0
+            h1 = hvd_metrics.snapshot().get("histograms", {})
+
+            def _dsum(nm):
+                return ((h1.get(nm) or {}).get("sum", 0.0)
+                        - (h0.get(nm) or {}).get("sum", 0.0))
+
+            exposed = _dsum("overlap.exposed_seconds")
+            results[mode] = {
+                "step_time_ms": round(dt / iters * 1e3, 2),
+                "comm_fraction": round((exposed if ov else t_comm) / dt, 4),
+                "hidden_comm_seconds": round(
+                    _dsum("overlap.hidden_seconds"), 6),
+                "exposed_comm_seconds": round(exposed, 6),
+            }
+        return results
+
+    overlap_ab = _overlap_ab(params, opt_state)
+
     # Accuracy: one fixed per-process payload through each wire vs the
     # fp32 ring (max abs error over the payload scale — the ring-level
     # analogue of the codec unit tests).  A synthetic normal vector, not
@@ -931,6 +1041,10 @@ def tcp_worker():
             "ring_transport": transport,
             "pinned": pinned,
             "wire_compression": wire_stats,
+            # Bucketed-overlap A/B on this leg: step time, comm fraction
+            # (exposed-only when overlap is on), hidden/exposed comm
+            # seconds from the overlap.* histograms.
+            "overlap_ab": overlap_ab,
             # Per-size p50 latency for ring/small/hier plus the measured
             # small↔ring crossover (docs/benchmarks.md).
             "algo_sweep": algo_sweep,
@@ -1215,6 +1329,11 @@ def bench_scaling_tcp():
         # comm_fraction, compressed bytes-on-wire (bf16 ~0.5x, int8 ~0.25x
         # of the fp32 ring), and allreduce max error vs the fp32 ring.
         "wire_compression": two.get("wire_compression"),
+        # Backward-overlap A/B on the real wire: step time and
+        # comm_fraction with the bucketed scheduler off vs on (the ON
+        # fraction counts only exposed communication, with the
+        # hidden/exposed split read off the overlap.* histograms).
+        "overlap_ab": two.get("overlap_ab"),
         # Response-cache effect on the control plane: per-burst
         # negotiation bytes (uncached vs cached) and cached/uncached tick
         # latency, measured by the worker's probe on the coordinator.

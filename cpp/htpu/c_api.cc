@@ -16,7 +16,7 @@
 
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
-#include "htpu/fusion.h"
+#include "htpu/scheduler.h"
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
@@ -557,6 +557,99 @@ HTPU_API int htpu_flight_snapshot(const char* why, void** out) {
   return CopyOut(
       htpu::FlightRecorder::Get().SnapshotJson(why ? why : "snapshot"),
       out);
+}
+
+// ---------------------------------------------------------------- scheduler
+
+// Full per-tick policy (fusion + first-ready issue order); same wire
+// contract as htpu_plan_fusion, which remains for compatibility.
+HTPU_API int htpu_plan_tick(const void* responses_bytes, int len,
+                            const char** names, const int64_t* nbytes,
+                            const char** dtypes, int n_entries,
+                            int64_t threshold, void** out) {
+  htpu::ResponseList in;
+  if (!htpu::ParseResponseList(static_cast<const uint8_t*>(responses_bytes),
+                               size_t(len), &in)) {
+    return -1;
+  }
+  std::unordered_map<std::string, int64_t> size_map;
+  std::unordered_map<std::string, std::string> dtype_map;
+  for (int i = 0; i < n_entries; ++i) {
+    size_map[names[i]] = nbytes[i];
+    dtype_map[names[i]] = dtypes[i];
+  }
+  htpu::ResponseList result;
+  result.shutdown = in.shutdown;
+  result.responses = htpu::PlanTick(
+      in.responses,
+      [&](const std::string& n) {
+        auto it = size_map.find(n);
+        return it == size_map.end() ? int64_t{0} : it->second;
+      },
+      [&](const std::string& n) {
+        auto it = dtype_map.find(n);
+        return it == dtype_map.end() ? std::string() : it->second;
+      },
+      threshold);
+  std::string buf;
+  htpu::SerializeResponseList(result, &buf);
+  return CopyOut(buf, out);
+}
+
+// Algorithm selection for a payload; writes the resolved algo name into
+// *out (htpu_free it) and returns its length ("" = flat ring).
+HTPU_API int htpu_resolve_algo(const char* pref, int64_t nbytes,
+                               int num_hosts, int num_procs,
+                               int64_t crossover_bytes, void** out) {
+  return CopyOut(htpu::ResolveAlgo(pref ? pref : "", nbytes, num_hosts,
+                                   num_procs, crossover_bytes),
+                 out);
+}
+
+HTPU_API void* htpu_sched_create(int64_t bucket_bytes) {
+  return new htpu::BucketPlanner(bucket_bytes);
+}
+
+HTPU_API void htpu_sched_destroy(void* sched) {
+  delete static_cast<htpu::BucketPlanner*>(sched);
+}
+
+HTPU_API int htpu_sched_register(void* sched, const char* name,
+                                 int64_t nbytes, const char* dtype) {
+  return static_cast<htpu::BucketPlanner*>(sched)->RegisterLeaf(
+      name ? name : "", nbytes, dtype ? dtype : "");
+}
+
+HTPU_API int htpu_sched_seal(void* sched) {
+  return static_cast<htpu::BucketPlanner*>(sched)->Seal();
+}
+
+HTPU_API int htpu_sched_bucket_of(void* sched, int leaf) {
+  return static_cast<htpu::BucketPlanner*>(sched)->BucketOf(leaf);
+}
+
+HTPU_API int64_t htpu_sched_bucket_bytes(void* sched, int bucket) {
+  return static_cast<htpu::BucketPlanner*>(sched)->BucketBytes(bucket);
+}
+
+HTPU_API int htpu_sched_note_ready(void* sched, int leaf) {
+  return static_cast<htpu::BucketPlanner*>(sched)->NoteReady(leaf);
+}
+
+HTPU_API int htpu_sched_next_issue(void* sched) {
+  return static_cast<htpu::BucketPlanner*>(sched)->NextIssue();
+}
+
+HTPU_API void htpu_sched_note_complete(void* sched, int bucket) {
+  static_cast<htpu::BucketPlanner*>(sched)->NoteComplete(bucket);
+}
+
+HTPU_API int htpu_sched_all_complete(void* sched) {
+  return static_cast<htpu::BucketPlanner*>(sched)->AllComplete() ? 1 : 0;
+}
+
+HTPU_API void htpu_sched_reset(void* sched) {
+  static_cast<htpu::BucketPlanner*>(sched)->Reset();
 }
 
 }  // extern "C"

@@ -16,7 +16,7 @@
 #include <thread>
 
 #include "htpu/flight_recorder.h"
-#include "htpu/fusion.h"
+#include "htpu/scheduler.h"
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
 #include "htpu/reduce.h"
@@ -1470,7 +1470,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
                                      : it->second.tensor_type;
   };
   out.responses =
-      PlanFusion(out.responses, entry_bytes, entry_dtype, fusion_threshold);
+      PlanTick(out.responses, entry_bytes, entry_dtype, fusion_threshold);
   Metrics::Get().SetGauge("control.pending_tensors",
                           static_cast<double>(table_->NumPending()));
 

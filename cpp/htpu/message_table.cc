@@ -5,6 +5,7 @@
 
 #include "htpu/metrics.h"
 #include "htpu/reduce.h"
+#include "htpu/scheduler.h"
 
 namespace htpu {
 
@@ -235,14 +236,10 @@ Response MessageTable::ConstructResponse(const std::string& name) {
 
 std::string MessageTable::ResolveAlgo(const std::string& pref,
                                       int64_t nbytes) const {
-  if (pref.empty() || pref == "ring") return "";
-  if (pref != "auto") return pref;  // explicit "hier" / "small"
-  // auto: latency-optimal gather/broadcast chain under the crossover,
-  // hierarchical when there are multiple hosts with co-located processes
-  // to exploit, flat ring otherwise.
-  if (nbytes <= algo_crossover_bytes_) return "small";
-  if (algo_num_hosts_ > 1 && algo_num_hosts_ < algo_num_procs_) return "hier";
-  return "";
+  // Policy lives in the plane-agnostic scheduler; the table only
+  // contributes the topology it was configured with.
+  return htpu::ResolveAlgo(pref, nbytes, algo_num_hosts_, algo_num_procs_,
+                           algo_crossover_bytes_);
 }
 
 std::vector<StallInfo> MessageTable::Stalled(double age_s) const {

@@ -1,0 +1,213 @@
+#include "htpu/scheduler.h"
+
+#include "htpu/flight_recorder.h"
+#include "htpu/metrics.h"
+
+namespace htpu {
+
+std::vector<Response> PlanFusion(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold) {
+  std::vector<Response> fused;
+  size_t i = 0;
+  while (i < responses.size()) {
+    const Response& r = responses[i];
+    if (r.response_type != ResponseType::ALLREDUCE || threshold <= 0 ||
+        r.tensor_names.empty()) {
+      fused.push_back(r);
+      ++i;
+      continue;
+    }
+    Response merged;
+    merged.response_type = ResponseType::ALLREDUCE;
+    merged.tensor_names = r.tensor_names;
+    merged.devices = r.devices;
+    merged.wire_dtype = r.wire_dtype;
+    merged.algo = r.algo;
+    int64_t total = 0;
+    for (const auto& n : merged.tensor_names) total += entry_bytes(n);
+    std::string dtype = entry_dtype(merged.tensor_names[0]);
+    size_t j = i + 1;
+    while (j < responses.size()) {
+      const Response& nxt = responses[j];
+      if (nxt.response_type != ResponseType::ALLREDUCE) break;
+      if (nxt.tensor_names.empty()) break;
+      if (entry_dtype(nxt.tensor_names[0]) != dtype) break;
+      // A fused buffer rides the ring as one payload with one wire
+      // format — only merge entries that negotiated the same one.
+      if (nxt.wire_dtype != merged.wire_dtype) break;
+      // Likewise one collective algorithm per fused payload: the data
+      // plane walks a single hop schedule for the whole buffer.
+      if (nxt.algo != merged.algo) break;
+      int64_t nbytes = 0;
+      for (const auto& n : nxt.tensor_names) nbytes += entry_bytes(n);
+      if (total + nbytes > threshold) break;
+      for (const auto& n : nxt.tensor_names) merged.tensor_names.push_back(n);
+      total += nbytes;
+      ++j;
+    }
+    fused.push_back(std::move(merged));
+    i = j;
+  }
+  return fused;
+}
+
+std::vector<Response> PlanTick(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold) {
+  // Fusion first; issue order is first-ready-first-issued, and the input
+  // already arrives in negotiation-readiness order, so fusion's stable
+  // left-to-right merge preserves the schedule.  Keeping this a separate
+  // entry point (rather than callers using PlanFusion directly) is the
+  // seam: both planes and the response cache consume one policy.
+  return PlanFusion(responses, entry_bytes, entry_dtype, threshold);
+}
+
+std::string ResolveAlgo(const std::string& pref, int64_t nbytes,
+                        int num_hosts, int num_procs,
+                        int64_t crossover_bytes) {
+  if (pref.empty() || pref == "ring") return "";
+  if (pref != "auto") return pref;  // explicit "hier" / "small"
+  // auto: latency-optimal gather/broadcast chain under the crossover,
+  // hierarchical when there are multiple hosts with co-located processes
+  // to exploit, flat ring otherwise.
+  if (nbytes <= crossover_bytes) return "small";
+  if (num_hosts > 1 && num_hosts < num_procs) return "hier";
+  return "";
+}
+
+BucketPlanner::BucketPlanner(int64_t bucket_bytes)
+    : bucket_bytes_(bucket_bytes > 0 ? bucket_bytes : kDefaultBucketBytes) {}
+
+int BucketPlanner::RegisterLeaf(const std::string& name, int64_t nbytes,
+                                const std::string& dtype) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (sealed_) return -1;
+  names_.push_back(name);
+  sizes_.push_back(nbytes);
+  dtypes_.push_back(dtype);
+  return int(names_.size()) - 1;
+}
+
+int BucketPlanner::Seal() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (sealed_) return int(buckets_.size());
+  sealed_ = true;
+  bucket_of_.assign(names_.size(), -1);
+  leaf_ready_.assign(names_.size(), false);
+  int64_t open_bytes = 0;
+  std::string open_dtype;
+  int open = -1;
+  for (size_t i = 0; i < names_.size(); ++i) {
+    const int64_t nbytes = sizes_[i];
+    const bool oversized = nbytes > bucket_bytes_;
+    const bool joins = open >= 0 && !oversized && dtypes_[i] == open_dtype &&
+                       open_bytes + nbytes <= bucket_bytes_;
+    if (!joins) {
+      buckets_.push_back(Bucket{});
+      open = int(buckets_.size()) - 1;
+      open_bytes = 0;
+      open_dtype = dtypes_[i];
+    }
+    bucket_of_[i] = open;
+    buckets_[open].nbytes += nbytes;
+    buckets_[open].leaves += 1;
+    open_bytes += nbytes;
+    // An oversized leaf rides alone: close its bucket so later leaves
+    // cannot join past the byte bound.
+    if (oversized) open = -1;
+  }
+  Metrics::Get().Counter("overlap.buckets")
+      ->fetch_add(static_cast<long long>(buckets_.size()));
+  return int(buckets_.size());
+}
+
+int BucketPlanner::num_buckets() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return int(buckets_.size());
+}
+
+int BucketPlanner::num_leaves() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return int(names_.size());
+}
+
+int BucketPlanner::BucketOf(int leaf) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (leaf < 0 || size_t(leaf) >= bucket_of_.size()) return -1;
+  return bucket_of_[leaf];
+}
+
+int64_t BucketPlanner::BucketBytes(int bucket) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (bucket < 0 || size_t(bucket) >= buckets_.size()) return -1;
+  return buckets_[bucket].nbytes;
+}
+
+int BucketPlanner::BucketLeaves(int bucket) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (bucket < 0 || size_t(bucket) >= buckets_.size()) return -1;
+  return buckets_[bucket].leaves;
+}
+
+int BucketPlanner::NoteReady(int leaf) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!sealed_ || leaf < 0 || size_t(leaf) >= bucket_of_.size()) return -1;
+  if (leaf_ready_[leaf]) return -1;
+  leaf_ready_[leaf] = true;
+  const int b = bucket_of_[leaf];
+  Bucket& bk = buckets_[b];
+  bk.ready += 1;
+  if (bk.ready < bk.leaves) return -1;
+  issue_queue_.push_back(b);
+  return b;
+}
+
+int BucketPlanner::NextIssue() {
+  std::lock_guard<std::mutex> lk(mu_);
+  while (issue_head_ < issue_queue_.size()) {
+    const int b = issue_queue_[issue_head_++];
+    if (buckets_[b].issued) continue;
+    buckets_[b].issued = true;
+    FlightRecorder::Get().Record("bucket.issue", "", buckets_[b].nbytes, b,
+                                 buckets_[b].leaves);
+    return b;
+  }
+  return -1;
+}
+
+void BucketPlanner::NoteComplete(int bucket) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (bucket < 0 || size_t(bucket) >= buckets_.size()) return;
+  if (buckets_[bucket].complete) return;
+  buckets_[bucket].complete = true;
+  FlightRecorder::Get().Record("bucket.complete", "", buckets_[bucket].nbytes,
+                               bucket, buckets_[bucket].leaves);
+}
+
+bool BucketPlanner::AllComplete() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!sealed_) return false;
+  for (const auto& b : buckets_) {
+    if (!b.complete) return false;
+  }
+  return true;
+}
+
+void BucketPlanner::Reset() {
+  std::lock_guard<std::mutex> lk(mu_);
+  leaf_ready_.assign(names_.size(), false);
+  for (auto& b : buckets_) {
+    b.ready = 0;
+    b.issued = false;
+    b.complete = false;
+  }
+  issue_queue_.clear();
+  issue_head_ = 0;
+}
+
+}  // namespace htpu

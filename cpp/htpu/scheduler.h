@@ -1,0 +1,139 @@
+// Plane-agnostic collective scheduler.
+//
+// One policy object decides three things for BOTH control planes — the
+// eager TCP ring and the in-jit shard_map path (through the C API and
+// horovod_tpu/scheduler.py):
+//
+//   1. Fusion: which consecutive negotiated ALLREDUCE responses ride the
+//      ring as one payload (moved here from the old fusion.cc; reference
+//      horovod/common/operations.cc:1807-1842).
+//   2. Issue order: the order fused buckets are executed.  The policy is
+//      first-ready-first-issued — buckets launch in the order their last
+//      gradient materialized, which is what lets backward-overlap hide
+//      communication under the remaining backprop.  PlanTick serializes
+//      that order into the ResponseList itself, so the response cache
+//      replays it verbatim on bitvector-identical ticks.
+//   3. Algorithm / wire-dtype choice: ResolveAlgo maps an "auto"
+//      preference to small/hier/ring from payload size and topology
+//      (moved here from MessageTable, which now delegates).
+//
+// BucketPlanner is the per-step overlap driver: leaves are registered in
+// declaration order, sealed into byte-bounded buckets (an oversized leaf
+// always rides alone), then NoteReady/NextIssue track which bucket's
+// collective can launch as gradients materialize.
+#ifndef HTPU_SCHEDULER_H_
+#define HTPU_SCHEDULER_H_
+
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "htpu/wire.h"
+
+namespace htpu {
+
+constexpr int64_t kDefaultFusionThreshold = 64 * 1024 * 1024;
+constexpr int64_t kFusionBufferAtomicUnit = 64;  // operations.h:48-50
+constexpr int64_t kDefaultBucketBytes = 64 * 1024 * 1024;
+
+// entry_bytes/entry_dtype look up the payload size / dtype for a tensor name.
+// Greedily merge consecutive ALLREDUCE responses with the same
+// dtype/wire_dtype/algo while the combined payload stays within the
+// threshold.  On TPU the "fusion buffer" is a traced concat executed by
+// XLA, so the planner only decides grouping.
+std::vector<Response> PlanFusion(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold);
+
+// The full per-tick policy: fusion plus issue order.  Responses arrive in
+// negotiation-readiness order (MessageTable pops names as the last rank
+// reports) and the first-ready-first-issued policy keeps that order, so
+// the returned list IS the wire-serialized issue schedule.
+std::vector<Response> PlanTick(
+    const std::vector<Response>& responses,
+    const std::function<int64_t(const std::string&)>& entry_bytes,
+    const std::function<std::string(const std::string&)>& entry_dtype,
+    int64_t threshold);
+
+// Map an algorithm preference to the concrete data-plane algorithm.
+// ""/"ring" -> "" (flat ring); explicit "hier"/"small" pass through;
+// "auto" picks the latency-optimal small-tensor path under the crossover,
+// hierarchical when multiple hosts hold co-located processes, ring
+// otherwise.
+std::string ResolveAlgo(const std::string& pref, int64_t nbytes,
+                        int num_hosts, int num_procs,
+                        int64_t crossover_bytes);
+
+// Backward-overlap bucket planner for one training step.
+//
+// Lifecycle: RegisterLeaf() each gradient in declaration (forward) order,
+// Seal() once, then per step: NoteReady(leaf) as gradients materialize,
+// drain NextIssue() to launch each bucket's collective the moment its
+// last leaf is ready, NoteComplete(bucket) when the collective lands,
+// Reset() before the next step.  Thread-safe: the eager plane may poll
+// readiness and drain issues from different threads.
+class BucketPlanner {
+ public:
+  explicit BucketPlanner(int64_t bucket_bytes);
+
+  // Returns the leaf index.  Must be called before Seal().
+  int RegisterLeaf(const std::string& name, int64_t nbytes,
+                   const std::string& dtype);
+
+  // Pack registered leaves into buckets; returns the bucket count.
+  // Consecutive leaves with the same dtype share a bucket while the
+  // total stays within bucket_bytes; a leaf larger than bucket_bytes
+  // rides alone (never joined by later leaves).
+  int Seal();
+
+  int num_buckets() const;
+  int num_leaves() const;
+  int BucketOf(int leaf) const;        // -1 when out of range / unsealed
+  int64_t BucketBytes(int bucket) const;
+  int BucketLeaves(int bucket) const;  // leaf count in a bucket
+
+  // Mark a leaf's gradient as materialized.  Returns the bucket index
+  // that just became fully ready (issuable), or -1.
+  int NoteReady(int leaf);
+
+  // Pop the next issuable bucket in first-ready-first-issued order, or
+  // -1 when none is pending.  Records a "bucket.issue" flight event.
+  int NextIssue();
+
+  // Mark a bucket's collective as landed ("bucket.complete" flight event).
+  void NoteComplete(int bucket);
+
+  bool AllComplete() const;
+
+  // Clear per-step readiness/issue/completion state, keep the packing.
+  void Reset();
+
+ private:
+  struct Bucket {
+    int64_t nbytes = 0;
+    int leaves = 0;
+    int ready = 0;
+    bool issued = false;
+    bool complete = false;
+  };
+
+  mutable std::mutex mu_;
+  int64_t bucket_bytes_;
+  bool sealed_ = false;
+  std::vector<std::string> names_;
+  std::vector<int64_t> sizes_;
+  std::vector<std::string> dtypes_;
+  std::vector<int> bucket_of_;     // leaf -> bucket
+  std::vector<Bucket> buckets_;
+  std::vector<bool> leaf_ready_;
+  std::vector<int> issue_queue_;   // buckets that became ready, FIFO
+  size_t issue_head_ = 0;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_SCHEDULER_H_

@@ -363,15 +363,10 @@ class MessageTable:
         explicit preferences pass through; "auto" picks the small path at or
         below the crossover, the hierarchical path when the job spans
         multiple hosts with co-located processes, else the flat ring."""
-        if pref in ("", "ring"):
-            return ""
-        if pref != "auto":
-            return pref
-        if nbytes <= self._algo_crossover:
-            return "small"
-        if 1 < self._algo_num_hosts < self._algo_num_procs:
-            return "hier"
-        return ""
+        from . import scheduler as _scheduler
+        return _scheduler.resolve_algo(
+            pref, nbytes, self._algo_num_hosts, self._algo_num_procs,
+            self._algo_crossover)
 
     def clear(self):
         self._table.clear()
@@ -1108,13 +1103,17 @@ class Controller:
             atexit.register(self._close_timeline)
 
         self.handle_manager = HandleManager()
+        # Both planners route through the plane-agnostic scheduler's
+        # per-tick policy (fusion + first-ready issue order); the native
+        # cpp_plan_tick degrades to cpp_plan_fusion on a stale library.
         if self._use_cpp:
             self._message_table = cpp_core.CppMessageTable(
                 self.size, self.timeline)
-            self._plan_fusion = cpp_core.cpp_plan_fusion
+            self._plan_fusion = cpp_core.cpp_plan_tick
         else:
             self._message_table = MessageTable(self.size, self.timeline)
-            self._plan_fusion = plan_fusion
+            from . import scheduler as _scheduler
+            self._plan_fusion = _scheduler.plan_tick
         # Topology + crossover for "auto" algorithm resolution.  The native
         # control plane configures its own internal table the same way
         # (control.cc Create); this covers the local negotiation loop.

@@ -194,6 +194,41 @@ def _configure(lib) -> None:
         lib.htpu_flight_snapshot.restype = ctypes.c_int
         lib.htpu_flight_snapshot.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    # Scheduler API (guarded: a prebuilt .so predating the plane-agnostic
+    # scheduler still loads for the rest of the surface).
+    if hasattr(lib, "htpu_sched_create"):
+        lib.htpu_plan_tick.restype = ctypes.c_int
+        lib.htpu_plan_tick.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_resolve_algo.restype = ctypes.c_int
+        lib.htpu_resolve_algo.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_sched_create.restype = ctypes.c_void_p
+        lib.htpu_sched_create.argtypes = [ctypes.c_int64]
+        lib.htpu_sched_destroy.restype = None
+        lib.htpu_sched_destroy.argtypes = [ctypes.c_void_p]
+        lib.htpu_sched_register.restype = ctypes.c_int
+        lib.htpu_sched_register.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        lib.htpu_sched_seal.restype = ctypes.c_int
+        lib.htpu_sched_seal.argtypes = [ctypes.c_void_p]
+        lib.htpu_sched_bucket_of.restype = ctypes.c_int
+        lib.htpu_sched_bucket_of.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_sched_bucket_bytes.restype = ctypes.c_int64
+        lib.htpu_sched_bucket_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_sched_note_ready.restype = ctypes.c_int
+        lib.htpu_sched_note_ready.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_sched_next_issue.restype = ctypes.c_int
+        lib.htpu_sched_next_issue.argtypes = [ctypes.c_void_p]
+        lib.htpu_sched_note_complete.restype = None
+        lib.htpu_sched_note_complete.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_sched_all_complete.restype = ctypes.c_int
+        lib.htpu_sched_all_complete.argtypes = [ctypes.c_void_p]
+        lib.htpu_sched_reset.restype = None
+        lib.htpu_sched_reset.argtypes = [ctypes.c_void_p]
 
 
 def load():
@@ -401,6 +436,100 @@ def cpp_plan_fusion(responses: List[Response], entry_bytes, entry_dtype,
                               n, threshold, ctypes.byref(out))
     fused, _, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
     return fused
+
+
+def _sched_lib():
+    """The loaded library iff it exports the plane-agnostic scheduler API,
+    else None (pure-Python run or stale prebuilt .so)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_sched_create"):
+        return None
+    return lib
+
+
+def cpp_plan_tick(responses: List[Response], entry_bytes, entry_dtype,
+                  threshold: int) -> List[Response]:
+    """Native per-tick policy (fusion + first-ready issue order) with the
+    signature of :func:`horovod_tpu.scheduler.plan_tick`."""
+    lib = _sched_lib()
+    if lib is None:
+        return cpp_plan_fusion(responses, entry_bytes, entry_dtype, threshold)
+    blob = wire.serialize_response_list(responses)
+    names = sorted({n for r in responses for n in r.tensor_names})
+    n = len(names)
+    name_arr = (ctypes.c_char_p * n)(*[s.encode("utf-8") for s in names])
+    bytes_arr = (ctypes.c_int64 * n)(*[entry_bytes(s) for s in names])
+    dtype_arr = (ctypes.c_char_p * n)(
+        *[entry_dtype(s).encode("utf-8") for s in names])
+    out = ctypes.c_void_p()
+    rc = lib.htpu_plan_tick(blob, len(blob), name_arr, bytes_arr, dtype_arr,
+                            n, threshold, ctypes.byref(out))
+    fused, _, _ = wire.parse_response_list(_take_buffer(lib, out, rc))
+    return fused
+
+
+def cpp_resolve_algo(pref: str, nbytes: int, num_hosts: int, num_procs: int,
+                     crossover_bytes: int) -> str:
+    """Native allreduce-algorithm selection ("" = flat ring)."""
+    lib = _sched_lib()
+    if lib is None:
+        raise RuntimeError("native scheduler not available")
+    out = ctypes.c_void_p()
+    rc = lib.htpu_resolve_algo(pref.encode("utf-8"), nbytes, num_hosts,
+                               num_procs, crossover_bytes, ctypes.byref(out))
+    return _take_buffer(lib, out, rc).decode("utf-8")
+
+
+class NativeBucketPlanner:
+    """ctypes wrapper over the C++ backward-overlap bucket planner.  Same
+    surface as the pure-Python fallback in horovod_tpu/scheduler.py."""
+
+    def __init__(self, bucket_bytes: int):
+        lib = _sched_lib()
+        if lib is None:
+            raise RuntimeError("native scheduler not available")
+        self._lib = lib
+        self._ptr = lib.htpu_sched_create(int(bucket_bytes))
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.htpu_sched_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def register_leaf(self, name: str, nbytes: int, dtype: str) -> int:
+        return self._lib.htpu_sched_register(
+            self._ptr, name.encode("utf-8"), int(nbytes),
+            dtype.encode("utf-8"))
+
+    def seal(self) -> int:
+        return self._lib.htpu_sched_seal(self._ptr)
+
+    def bucket_of(self, leaf: int) -> int:
+        return self._lib.htpu_sched_bucket_of(self._ptr, int(leaf))
+
+    def bucket_bytes(self, bucket: int) -> int:
+        return self._lib.htpu_sched_bucket_bytes(self._ptr, int(bucket))
+
+    def note_ready(self, leaf: int) -> int:
+        return self._lib.htpu_sched_note_ready(self._ptr, int(leaf))
+
+    def next_issue(self) -> int:
+        return self._lib.htpu_sched_next_issue(self._ptr)
+
+    def note_complete(self, bucket: int) -> None:
+        self._lib.htpu_sched_note_complete(self._ptr, int(bucket))
+
+    def all_complete(self) -> bool:
+        return bool(self._lib.htpu_sched_all_complete(self._ptr))
+
+    def reset(self) -> None:
+        self._lib.htpu_sched_reset(self._ptr)
 
 
 def wire_roundtrip(wire_dtype: str, values):

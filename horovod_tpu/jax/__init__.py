@@ -16,6 +16,7 @@ Also here, mirroring the reference's startup-sync utilities:
 
 from __future__ import annotations
 
+import time
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -25,7 +26,9 @@ import optax
 from jax import lax
 
 from horovod_tpu import basics
+from horovod_tpu import scheduler as _sched
 from horovod_tpu.compression import Compression, Compressor, NoneCompressor
+from horovod_tpu.metrics import registry as _metrics
 from horovod_tpu.ops import eager as _eager
 from horovod_tpu.ops import quantized_collectives as _qc
 from horovod_tpu.parallel.mesh import RANKS_AXIS
@@ -70,6 +73,7 @@ def DistributedOptimizer(
     compression: Compressor = NoneCompressor,
     sparse_as_dense: bool = False,
     error_feedback: bool = False,
+    overlap: Optional[bool] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates consume rank-averaged gradients.
 
@@ -97,6 +101,10 @@ def DistributedOptimizer(
     optax transform sees a dense gradient — the comm stays sparse, the
     scatter to dense happens locally after the gather (optax has no
     IndexedSlices apply the way TF optimizers do).
+
+    ``overlap`` (default: the ``HOROVOD_TPU_OVERLAP`` knob) enables
+    backward-overlap on the eager path: see
+    :func:`allreduce_gradients`.
     """
 
     def _residual_leaf(p):
@@ -130,7 +138,8 @@ def DistributedOptimizer(
                                  is_leaf=_is_sparse)
         red = allreduce_gradients(grads, axis_name=axis_name,
                                   average=average, compression=compression,
-                                  sparse_as_dense=sparse_as_dense)
+                                  sparse_as_dense=sparse_as_dense,
+                                  overlap=overlap)
         if error_feedback:
             # Local-error formulation: what this rank contributed minus
             # what survived its own quantizer.  Q is deterministic and
@@ -160,7 +169,8 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
                         compression: Compressor = NoneCompressor,
                         name_prefix: str = "DistributedOptimizer.grads",
                         grads_hint: bool = True,
-                        sparse_as_dense: bool = False):
+                        sparse_as_dense: bool = False,
+                        overlap: Optional[bool] = None):
     """Average a gradient pytree across ranks (the allreduce-before-step
     core of every reference DistributedOptimizer).
 
@@ -174,6 +184,14 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
     allgather path and come back as gathered ``IndexedSlices`` (reference
     ``horovod/tensorflow/__init__.py:67-78``) — unless ``sparse_as_dense``
     densifies them up front.
+
+    ``overlap`` (default: the ``HOROVOD_TPU_OVERLAP`` knob) switches the
+    eager path to backward-overlap: float32 leaves are packed into
+    scheduler buckets (``HOROVOD_TPU_BUCKET_BYTES``) and each bucket's
+    fused allreduce is enqueued the moment its last gradient
+    materializes on device, instead of after the whole tree is reduced
+    leaf-by-leaf.  Payload packing is identical whether the bucket is
+    issued early or late, so overlap changes timing, never math.
     """
     from horovod_tpu import sparse as _sparse
     if sparse_as_dense:
@@ -231,6 +249,10 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
             f"horovod_tpu.jax.spmd.make_train_step (or your own "
             f"jax.shard_map over hvd.ranks_mesh()), or use the in-jit "
             f"collectives in horovod_tpu.ops.injit inside a plain jit.")
+    if _sched.overlap_enabled(overlap):
+        return _overlapped_allreduce(leaves, treedef, average=average,
+                                     compression=compression,
+                                     name_prefix=name_prefix)
     handles, ctxs = [], []
     for i, leaf in enumerate(leaves):
         if _is_sparse(leaf):
@@ -270,6 +292,138 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
         else:
             outs.append(compression.decompress(
                 jnp.asarray(_eager.synchronize(h)), ctx))
+    return jax.tree.unflatten(treedef, outs)
+
+
+def _leaf_is_ready(arr) -> bool:
+    """Device-readiness probe: True once the array's producing computation
+    has finished (host numpy is always ready)."""
+    probe = getattr(arr, "is_ready", None)
+    if callable(probe):
+        try:
+            return bool(probe())
+        except Exception:
+            return True
+    return True
+
+
+def _overlapped_allreduce(leaves, treedef, *, average, compression,
+                          name_prefix):
+    """Backward-overlap eager reduction (HOROVOD_TPU_OVERLAP).
+
+    float32 leaves are packed into scheduler buckets and each bucket's
+    fused allreduce is enqueued as soon as its last gradient is ready on
+    device — communication of early buckets hides under the backprop
+    still producing later ones.  Sparse and non-float32 leaves keep the
+    per-leaf submission of the non-overlapped path (same payloads, same
+    math).  The bucket payload (concat of the bucket's leaves) does not
+    depend on WHEN the bucket is issued, so results are bit-identical to
+    ``overlap=False`` on the planes the test matrix covers (the fused
+    negotiation path concatenates leaves the same way).
+
+    Emits the ``overlap.hidden_seconds`` / ``overlap.exposed_seconds``
+    pair per step: hidden = the part of the communication span that ran
+    while gradients were still materializing, exposed = the tail the step
+    actually waited on after backward finished.
+    """
+    from horovod_tpu import sparse as _sparse
+    arrs = [None if _is_sparse(l) else _as_leaf(l) for l in leaves]
+    fp32 = [i for i, a in enumerate(arrs)
+            if a is not None and jnp.result_type(a) == jnp.float32]
+    outs: list = [None] * len(leaves)
+    handles: dict = {}
+    ctxs: dict = {}
+    # Sparse and non-float32 leaves: submit up front, exactly like the
+    # non-overlapped path.
+    for i, leaf in enumerate(leaves):
+        if _is_sparse(leaf):
+            vh = _eager.allgather_async(_as_leaf(leaf.values),
+                                        name=f"{name_prefix}.{i}.values")
+            ih = _eager.allgather_async(_as_leaf(leaf.indices),
+                                        name=f"{name_prefix}.{i}.indices")
+            handles[i] = (vh, ih, leaf.dense_shape)
+        elif i not in fp32:
+            c, ctx = compression.compress(arrs[i])
+            ctxs[i] = ctx
+            handles[i] = _eager.allreduce_async(
+                c, average=average, name=f"{name_prefix}.{i}")
+    # Bucket the float32 leaves (declaration order; oversized leaves ride
+    # alone) and drive readiness through the plane-agnostic scheduler.
+    planner = _sched.make_bucket_planner(_sched.bucket_bytes_from_env())
+    for j, i in enumerate(fp32):
+        a = arrs[i]
+        planner.register_leaf(f"{name_prefix}.{i}", a.size * a.dtype.itemsize,
+                              "float32")
+    n_buckets = planner.seal()
+    bucket_leaves: list = [[] for _ in range(n_buckets)]
+    for j, i in enumerate(fp32):
+        bucket_leaves[planner.bucket_of(j)].append(i)
+    bucket_handles: dict = {}
+    issue_seq: list = []
+    t_first_issue = None
+
+    def _drain_issues():
+        nonlocal t_first_issue
+        while True:
+            b = planner.next_issue()
+            if b < 0:
+                return
+            if t_first_issue is None:
+                t_first_issue = time.perf_counter()
+            flat = np.concatenate(
+                [np.asarray(arrs[i]).ravel() for i in bucket_leaves[b]]
+            ) if len(bucket_leaves[b]) > 1 else np.asarray(
+                arrs[bucket_leaves[b][0]]).ravel()
+            bucket_handles[b] = _eager.allreduce_async(
+                flat, average=average, name=f"{name_prefix}.bucket{b}",
+                compression=compression)
+            issue_seq.append(b)
+
+    pending = set(range(len(fp32)))
+    while pending:
+        progressed = False
+        for j in sorted(pending):
+            if _leaf_is_ready(arrs[fp32[j]]):
+                pending.discard(j)
+                planner.note_ready(j)
+                progressed = True
+        _drain_issues()
+        if pending and not progressed:
+            time.sleep(50e-6)
+    t_backward_done = time.perf_counter()
+    # Synchronize buckets in issue order and scatter slices back.
+    for b in issue_seq:
+        red = np.asarray(_eager.synchronize(bucket_handles[b]))
+        planner.note_complete(b)
+        off = 0
+        for i in bucket_leaves[b]:
+            n = arrs[i].size
+            piece = jnp.asarray(red[off:off + n]).reshape(arrs[i].shape)
+            outs[i] = compression.decompress(piece, None)
+            off += n
+    t_comm_done = time.perf_counter()
+    planner.close()
+    if issue_seq and t_first_issue is not None:
+        comm_span = max(0.0, t_comm_done - t_first_issue)
+        exposed = max(0.0, t_comm_done - t_backward_done)
+        hidden = max(0.0, comm_span - exposed)
+        _metrics.inc("overlap.steps")
+        _metrics.observe("overlap.hidden_seconds", hidden)
+        _metrics.observe("overlap.exposed_seconds", exposed)
+        if comm_span > 0:
+            _metrics.observe("overlap.hidden_fraction", hidden / comm_span)
+    # Drain the up-front (sparse / non-f32) handles.
+    for i, h in handles.items():
+        if isinstance(h, tuple):
+            vh, ih, dense_shape = h
+            values = jnp.asarray(_eager.synchronize(vh))
+            if average:
+                values = values / basics.size()
+            outs[i] = _sparse.IndexedSlices(
+                values, jnp.asarray(_eager.synchronize(ih)), dense_shape)
+        else:
+            outs[i] = compression.decompress(
+                jnp.asarray(_eager.synchronize(h)), ctxs[i])
     return jax.tree.unflatten(treedef, outs)
 
 

@@ -29,7 +29,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from horovod_tpu import scheduler as _sched
 from horovod_tpu.compression import Compressor, NoneCompressor
+from horovod_tpu.ops import injit as _injit
 from horovod_tpu.ops import quantized_collectives as _qc
 from horovod_tpu.parallel._vma import ensure_varying_tree
 from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
@@ -40,7 +42,8 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
                      average: bool = True,
                      compression: Compressor = NoneCompressor,
                      fuse: bool = True,
-                     bucket_bytes: int = 64 << 20):
+                     bucket_bytes=None,
+                     overlap=None):
     """Cross-rank gradient reduction inside a shard_map body.
 
     Uses the hierarchical two-tier path when the mesh is ('dcn', 'ici'),
@@ -64,13 +67,25 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
     while 1-D / under-floor leaves stay on the raw psum path.  The
     ``HOROVOD_TPU_INJIT_WIRE_DTYPE`` env knob fills in the wire dtype
     where the caller left the default.
+
+    Bucketing on the staged paths goes through the plane-agnostic
+    scheduler (:mod:`horovod_tpu.scheduler`): ``bucket_bytes`` defaults
+    to the ``HOROVOD_TPU_BUCKET_BYTES`` knob and ``overlap`` (default:
+    ``HOROVOD_TPU_OVERLAP``) stages bucket collectives in reverse
+    registration order — the backward pass materializes the tail
+    buckets' gradients first, so XLA can run their collectives while
+    earlier layers are still differentiating.  Bucket contents are
+    issue-order independent: overlap on/off is bit-identical.
     """
     compression = _qc.resolve_injit_compression(compression)
+    bucket_bytes = _sched.bucket_bytes_from_env(bucket_bytes)
+    overlap = _sched.overlap_enabled(overlap)
     hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
     if (_qc.is_int8(compression) and not hierarchical
             and len(axis_names) == 1):
         return _reduce_flat_int8(grads, axis_names[0], average=average,
-                                 fuse=fuse, bucket_bytes=bucket_bytes)
+                                 fuse=fuse, bucket_bytes=bucket_bytes,
+                                 overlap=overlap)
 
     def leaf_comp(g):
         # Bucket policy holds on every path: under int8, leaves below
@@ -100,31 +115,22 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
     if hierarchical:
         # Bucketed like the reference's bounded fusion buffer
         # (HOROVOD_FUSION_THRESHOLD, 64 MB default): the concat staging
-        # copy peaks at one bucket, not the full model.
+        # copy peaks at one bucket, not the full model.  Per wire dtype,
+        # the scheduler's shared packer decides the buckets (oversized
+        # leaves ride alone) and the staged helper orders their
+        # three-tier collectives.
         groups: dict = {}
         for i, (c, _) in enumerate(compressed):
-            key = jnp.dtype(c.dtype)
-            if (groups.get(key)
-                    and groups[key][-1][1] + c.nbytes <= bucket_bytes):
-                bucket = groups[key][-1]
-                bucket[0].append(i)
-                bucket[1] += c.nbytes
-            else:
-                groups.setdefault(key, []).append([[i], c.nbytes])
+            groups.setdefault(jnp.dtype(c.dtype), []).append(i)
         out = [None] * len(leaves)
-        for buckets in groups.values():
-            for idxs, _ in buckets:
-                flat = (compressed[idxs[0]][0].ravel() if len(idxs) == 1
-                        else jnp.concatenate(
-                            [compressed[i][0].ravel() for i in idxs]))
-                red = hierarchical_allreduce(flat, average=average)
-                offset = 0
-                for i in idxs:
-                    c, ctx = compressed[i]
-                    n = c.size
-                    out[i] = compression.decompress(
-                        red[offset:offset + n].reshape(c.shape), ctx)
-                    offset += n
+        for idx_list in groups.values():
+            reduced = _injit.staged_bucket_allreduce(
+                [compressed[i][0] for i in idx_list],
+                lambda flat: hierarchical_allreduce(flat, average=average),
+                bucket_bytes=bucket_bytes, overlap=overlap)
+            for i, r in zip(idx_list, reduced):
+                c, ctx = compressed[i]
+                out[i] = compression.decompress(r.reshape(c.shape), ctx)
         return jax.tree.unflatten(treedef, out)
     # Flat mesh: per-leaf collectives; XLA's AllReduce combiner batches
     # them (an explicit concat here measured as a wash on v5e and would
@@ -138,17 +144,19 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
 
 
 def _reduce_flat_int8(grads, axis: str, *, average: bool, fuse: bool,
-                      bucket_bytes: int):
+                      bucket_bytes: int, overlap: bool = False):
     """Flat-mesh gradient reduction over the in-jit int8 ring.
 
     Eligible bulk leaves (>= 2-D, at or above the size floor —
     :func:`~horovod_tpu.ops.quantized_collectives.int8_eligible`) are
-    concatenated into bounded fp32 buckets and each bucket rides one
+    concatenated into bounded fp32 buckets by the scheduler's shared
+    packer and each bucket rides one
     :func:`~horovod_tpu.ops.quantized_collectives
-    .quantized_ring_allreduce`; the rest take one multi-operand raw
-    pmean/psum.  Fusing here matters more than on the raw path: XLA's
-    AllReduce combiner cannot batch the explicit ppermute schedule, so
-    per-leaf rings would serialize their hops.
+    .quantized_ring_allreduce`, staged in scheduler issue order; the
+    rest take one multi-operand raw pmean/psum.  Fusing here matters
+    more than on the raw path: XLA's AllReduce combiner cannot batch
+    the explicit ppermute schedule, so per-leaf rings would serialize
+    their hops.
     """
     leaves, treedef = jax.tree.flatten(grads)
     ring_idx = [i for i, g in enumerate(leaves)
@@ -161,30 +169,17 @@ def _reduce_flat_int8(grads, axis: str, *, average: bool, fuse: bool,
         for i, r in zip(rest_idx, red):
             out[i] = r
     if ring_idx:
-        if fuse:
-            buckets, cur, cur_bytes = [], [], 0
-            for i in ring_idx:
-                nbytes = leaves[i].size * 4
-                if cur and cur_bytes + nbytes > bucket_bytes:
-                    buckets.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(i)
-                cur_bytes += nbytes
-            buckets.append(cur)
-        else:
-            buckets = [[i] for i in ring_idx]
-        for idxs in buckets:
-            flat = (leaves[idxs[0]].ravel().astype(jnp.float32)
-                    if len(idxs) == 1 else jnp.concatenate(
-                        [leaves[i].ravel().astype(jnp.float32)
-                         for i in idxs]))
-            red = _qc.quantized_ring_allreduce(flat, axis, average=average)
-            offset = 0
-            for i in idxs:
-                g = leaves[i]
-                out[i] = red[offset:offset + g.size].reshape(
-                    g.shape).astype(g.dtype)
-                offset += g.size
+        ring_leaves = [leaves[i].ravel().astype(jnp.float32)
+                       for i in ring_idx]
+        reduced = _injit.staged_bucket_allreduce(
+            ring_leaves,
+            lambda flat: _qc.quantized_ring_allreduce(flat, axis,
+                                                      average=average),
+            bucket_bytes=bucket_bytes if fuse else 0,
+            overlap=overlap)
+        for i, r in zip(ring_idx, reduced):
+            g = leaves[i]
+            out[i] = r.reshape(g.shape).astype(g.dtype)
     return jax.tree.unflatten(treedef, out)
 
 
@@ -458,6 +453,7 @@ def make_train_step(
     batch_spec=None,
     steps_per_call: int = 1,
     fuse: bool = True,
+    overlap=None,
 ):
     """Build a jitted data-parallel training step over ``mesh``.
 
@@ -487,9 +483,13 @@ def make_train_step(
     ``fuse`` forwards to :func:`reduce_gradients` (fused collectives);
     ``fuse=False`` reduces per leaf, e.g. to avoid the hierarchical
     path's bucket staging copies under extreme memory pressure.
+    ``overlap`` (default: the ``HOROVOD_TPU_OVERLAP`` knob) stages
+    bucket collectives in backward order so they interleave with the
+    remaining backprop — see :func:`reduce_gradients`.
     """
     axes = tuple(mesh.axis_names)
     compression = _qc.resolve_injit_compression(compression)
+    overlap = _sched.overlap_enabled(overlap)
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got "
                          f"{steps_per_call}")
@@ -517,7 +517,8 @@ def make_train_step(
         (loss, new_aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params_v, aux_state, batch)
         grads = reduce_gradients(grads, axes, average=average,
-                                 compression=compression, fuse=fuse)
+                                 compression=compression, fuse=fuse,
+                                 overlap=overlap)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         new_aux = _sync_or_check_aux(new_aux, axes, sync_aux_state)

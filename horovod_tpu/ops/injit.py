@@ -176,3 +176,43 @@ def alltoall(x, *, axis_name: AxisName = RANKS_AXIS,
     block; beyond the reference's three ops but first-class here)."""
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
+
+
+def staged_bucket_allreduce(leaves, reduce_flat, *, bucket_bytes=None,
+                            overlap: bool = False):
+    """Bucketed, staged collective over a list of flat (1-D) arrays.
+
+    The in-jit half of the plane-agnostic scheduler: leaves are packed
+    into byte-bounded buckets by :func:`horovod_tpu.scheduler
+    .pack_buckets` (same packer as the eager overlap path — oversized
+    leaves ride alone) and ``reduce_flat`` runs once per bucket on the
+    concatenated payload, staged in the scheduler's issue order.  Under
+    ``overlap`` that order is reversed registration order: backward
+    materializes the LAST layer's gradients first, so emitting the tail
+    bucket's collective first gives XLA's latency-hiding scheduler a
+    collective whose inputs are ready while earlier layers are still
+    differentiating.  Bucket contents do not depend on the issue order,
+    so overlap changes scheduling, never math.
+
+    Returns the reduced payload re-split per leaf (flat; caller
+    reshapes).  ``reduce_flat`` must be shape-polymorphic over 1-D
+    arrays (e.g. a quantized ring or a hierarchical allreduce).
+    """
+    from horovod_tpu import scheduler as _sched
+    if bucket_bytes is None:
+        bucket_bytes = _sched.bucket_bytes_from_env()
+    sizes = [int(l.size) * int(l.dtype.itemsize) for l in leaves]
+    dtypes = [str(l.dtype) for l in leaves]
+    buckets = _sched.pack_buckets(sizes, dtypes, bucket_bytes)
+    out = [None] * len(leaves)
+    for b in _sched.issue_order(len(buckets), overlap):
+        idxs = buckets[b]
+        flat = (leaves[idxs[0]].ravel() if len(idxs) == 1
+                else jnp.concatenate([leaves[i].ravel() for i in idxs]))
+        red = reduce_flat(flat)
+        offset = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = red[offset:offset + n]
+            offset += n
+    return out

@@ -46,6 +46,50 @@ from horovod_tpu.parallel._vma import per_shard_init as _per_shard_init
 TP_AXIS = "tp"
 
 
+def matmul_reducescatter(x, kernel, axis: str = TP_AXIS):
+    """Fused matmul + reduce-scatter: ``x @ kernel`` summed over ``axis``
+    with row-block ``idx`` of the result left on shard ``idx``.
+
+    The first fused computation-collective op (PAPERS.md #3): instead of
+    a full partial matmul followed by one opaque ``psum_scatter``, the
+    product is computed block-by-block on an n-step ring — at each step
+    the accumulator for one output row-block hops to the neighbor
+    (``ppermute``) while the NEXT block's local partial matmul runs, so
+    the communication of block k hides under the compute of block k+1.
+    XLA schedules the hop and the dot in parallel because neither
+    depends on the other's output.
+
+    ``x``: ``(..., rows, k_local)`` — feature-sharded activations (a
+    ColumnParallelDense output).  ``kernel``: ``(k_local, features)``.
+    Returns ``(..., rows // n, features)``: shard ``idx`` holds row
+    block ``idx`` of the fully-reduced product (sequence-parallel
+    layout).  ``rows`` must divide by the axis size.  Accumulation
+    order differs per element from ``psum``'s, so results match the
+    unfused formulation to float tolerance, not bitwise.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    rows = x.shape[-2]
+    if rows % n:
+        raise ValueError(
+            f"matmul_reducescatter: rows={rows} not divisible by "
+            f"axis size {n}")
+    blk = rows // n
+
+    def block_partial(step):
+        j = lax.rem(idx + 1 + step, n)
+        xb = lax.dynamic_slice_in_dim(x, j * blk, blk, axis=-2)
+        return jnp.dot(xb, kernel)
+
+    acc = block_partial(0)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    for s in range(1, n):
+        # The hop and the next partial product are data-independent —
+        # this is where the overlap comes from.
+        acc = lax.ppermute(acc, axis, perm) + block_partial(s)
+    return acc
+
+
 class ColumnParallelDense(nn.Module):
     """Dense with output features sharded over ``axis``.
 
@@ -90,6 +134,14 @@ class RowParallelDense(nn.Module):
     kernel; the partial products are reduced with one ``psum``.  The input
     must already be feature-sharded (a ColumnParallelDense output); the
     result is replicated across ``axis``.
+
+    ``scatter_output=True`` swaps the psum for the fused
+    :func:`matmul_reducescatter` ring: the result comes back with the
+    second-to-last (token) dimension scattered over ``axis`` — the
+    sequence-parallel layout — and each ring hop overlaps the next
+    row-block's partial matmul instead of exposing one big AllReduce
+    after the full product.  Bias is still added once, on the local
+    row block.
     """
 
     features: int
@@ -99,14 +151,20 @@ class RowParallelDense(nn.Module):
     param_dtype: Any = jnp.float32
     kernel_init: Any = nn.initializers.lecun_normal()
     bias_init: Any = nn.initializers.zeros_init()
+    scatter_output: bool = False
 
     @nn.compact
     def __call__(self, x):
         kernel = self.param(
             "kernel", _per_shard_init(self.kernel_init, self.axis),
             (x.shape[-1], self.features), self.param_dtype)
-        partial = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
-        y = lax.psum(partial, self.axis)
+        if self.scatter_output:
+            y = matmul_reducescatter(x.astype(self.dtype),
+                                     kernel.astype(self.dtype), self.axis)
+        else:
+            partial = jnp.dot(x.astype(self.dtype),
+                              kernel.astype(self.dtype))
+            y = lax.psum(partial, self.axis)
         if self.use_bias:
             # Replicated bias, added once — after the reduction.
             bias = self.param("bias", self.bias_init,

@@ -1,0 +1,232 @@
+"""Plane-agnostic scheduler: bucket packing, issue order, algo policy,
+and native/Python parity (PR: one scheduler, two planes)."""
+
+import os
+
+import pytest
+
+from horovod_tpu import cpp_core
+from horovod_tpu import scheduler
+from horovod_tpu.metrics import registry as metrics_registry
+
+MB = 1 << 20
+
+
+class TestPackBuckets:
+    def test_consecutive_same_dtype_share_bucket(self):
+        assert scheduler.pack_buckets([4, 4, 4], ["f32"] * 3, 16) == [[0, 1, 2]]
+
+    def test_byte_bound_splits(self):
+        assert scheduler.pack_buckets([8, 8, 8], ["f32"] * 3, 16) == [
+            [0, 1], [2]]
+
+    def test_dtype_change_splits(self):
+        assert scheduler.pack_buckets([4, 4, 4], ["f32", "bf16", "bf16"],
+                                      64) == [[0], [1, 2]]
+
+    def test_oversized_leaf_rides_alone(self):
+        # The clamp: a leaf past the bound gets its own bucket AND that
+        # bucket is closed — later same-dtype leaves must not join it
+        # (the bucket is already past the byte bound).
+        assert scheduler.pack_buckets([4, 100, 4, 4], ["f32"] * 4, 16) == [
+            [0], [1], [2, 3]]
+
+    def test_oversized_first_leaf(self):
+        assert scheduler.pack_buckets([100, 4], ["f32"] * 2, 16) == [
+            [0], [1]]
+
+    def test_zero_bound_means_per_leaf(self):
+        # bucket_bytes=0 makes every leaf oversized: per-leaf buckets,
+        # the degenerate mode the in-jit fuse=False path rides.
+        assert scheduler.pack_buckets([4, 4], ["f32"] * 2, 0) == [[0], [1]]
+
+    def test_exact_fit_joins(self):
+        assert scheduler.pack_buckets([8, 8], ["f32"] * 2, 16) == [[0, 1]]
+
+    def test_empty(self):
+        assert scheduler.pack_buckets([], [], 16) == []
+
+
+class TestIssueOrder:
+    def test_declaration_order_without_overlap(self):
+        assert scheduler.issue_order(3, overlap=False) == [0, 1, 2]
+
+    def test_reversed_under_overlap(self):
+        # Backward materializes the LAST bucket's gradients first.
+        assert scheduler.issue_order(3, overlap=True) == [2, 1, 0]
+
+
+class TestKnobs:
+    def test_overlap_default_off(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_OVERLAP", raising=False)
+        assert scheduler.overlap_enabled() is False
+
+    @pytest.mark.parametrize("raw", ["1", "true", "YES", "on"])
+    def test_overlap_env_truthy(self, monkeypatch, raw):
+        monkeypatch.setenv("HOROVOD_TPU_OVERLAP", raw)
+        assert scheduler.overlap_enabled() is True
+
+    def test_override_beats_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_OVERLAP", "1")
+        assert scheduler.overlap_enabled(False) is False
+        monkeypatch.delenv("HOROVOD_TPU_OVERLAP")
+        assert scheduler.overlap_enabled(True) is True
+
+    def test_bucket_bytes_default_and_env(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_BUCKET_BYTES", raising=False)
+        assert scheduler.bucket_bytes_from_env() == 64 * MB
+        monkeypatch.setenv("HOROVOD_TPU_BUCKET_BYTES", str(4 * MB))
+        assert scheduler.bucket_bytes_from_env() == 4 * MB
+        assert scheduler.bucket_bytes_from_env(1024) == 1024
+        monkeypatch.setenv("HOROVOD_TPU_BUCKET_BYTES", "junk")
+        assert scheduler.bucket_bytes_from_env() == 64 * MB
+        monkeypatch.setenv("HOROVOD_TPU_BUCKET_BYTES", "-1")
+        assert scheduler.bucket_bytes_from_env() == 64 * MB
+
+
+class TestResolveAlgo:
+    def test_ring_and_empty_map_to_flat_ring(self):
+        assert scheduler.resolve_algo("", 10, 1, 2) == ""
+        assert scheduler.resolve_algo("ring", 10, 1, 2) == ""
+
+    def test_explicit_pref_passes_through(self):
+        assert scheduler.resolve_algo("small", 10 * MB, 1, 2) == "small"
+        assert scheduler.resolve_algo("hier", 8, 1, 2) == "hier"
+
+    def test_auto_small_below_crossover(self):
+        assert scheduler.resolve_algo("auto", 8, 4, 16,
+                                      crossover_bytes=1024) == "small"
+
+    def test_auto_hier_on_multi_host(self):
+        assert scheduler.resolve_algo("auto", 1 * MB, 4, 16,
+                                      crossover_bytes=1024) == "hier"
+
+    def test_auto_ring_single_host(self):
+        assert scheduler.resolve_algo("auto", 1 * MB, 1, 8,
+                                      crossover_bytes=1024) == ""
+
+
+def drive_planner(planner):
+    """Drive a 5-leaf / 3-bucket plan through the full lifecycle and
+    return the observable trace — shared by the Python and native runs
+    so parity is asserted on behavior, not implementation."""
+    for j, (nbytes, dtype) in enumerate(
+            [(8, "f32"), (8, "f32"), (100, "f32"), (8, "f32"), (8, "f32")]):
+        assert planner.register_leaf(f"leaf{j}", nbytes, dtype) == j
+    n = planner.seal()
+    trace = {"n_buckets": n,
+             "bucket_of": [planner.bucket_of(j) for j in range(5)],
+             "bucket_bytes": [planner.bucket_bytes(b) for b in range(n)]}
+    # Readiness arrives tail-first (backward order): leaves 4,3 complete
+    # bucket 2 first; the oversized leaf 2 completes bucket 1; 1,0 last.
+    issued = []
+    for leaf in (4, 3, 2, 1, 0):
+        b = planner.note_ready(leaf)
+        if b >= 0:
+            got = planner.next_issue()
+            assert got == b
+            issued.append(got)
+    trace["issue_seq"] = issued
+    assert planner.next_issue() == -1          # queue drained
+    assert not planner.all_complete()
+    for b in issued:
+        planner.note_complete(b)
+    trace["all_complete"] = planner.all_complete()
+    # reset() rearms the same plan for the next step.
+    planner.reset()
+    assert not planner.all_complete()
+    assert planner.next_issue() == -1
+    for leaf in range(5):
+        planner.note_ready(leaf)
+    trace["issue_seq_after_reset"] = [planner.next_issue()
+                                      for _ in range(trace["n_buckets"])]
+    return trace
+
+
+EXPECTED_TRACE = {
+    "n_buckets": 3,
+    "bucket_of": [0, 0, 1, 2, 2],
+    "bucket_bytes": [16, 100, 16],
+    "issue_seq": [2, 1, 0],                    # first-ready-first-issued
+    "all_complete": True,
+    "issue_seq_after_reset": [0, 1, 2],        # in-order readiness replays
+}
+
+
+class TestPyBucketPlanner:
+    def test_lifecycle(self):
+        assert drive_planner(scheduler.PyBucketPlanner(16)) == EXPECTED_TRACE
+
+    def test_seal_emits_bucket_counter(self):
+        before = metrics_registry.snapshot()["counters"].get(
+            "overlap.buckets", 0)
+        p = scheduler.PyBucketPlanner(16)
+        p.register_leaf("a", 8, "f32")
+        p.register_leaf("b", 100, "f32")
+        assert p.seal() == 2
+        after = metrics_registry.snapshot()["counters"].get(
+            "overlap.buckets", 0)
+        assert after - before == 2
+
+    def test_register_after_seal_rejected(self):
+        p = scheduler.PyBucketPlanner(16)
+        p.register_leaf("a", 8, "f32")
+        p.seal()
+        assert p.register_leaf("b", 8, "f32") == -1
+
+    def test_duplicate_ready_ignored(self):
+        p = scheduler.PyBucketPlanner(16)
+        p.register_leaf("a", 8, "f32")
+        p.register_leaf("b", 8, "f32")
+        p.seal()
+        assert p.note_ready(0) == -1           # bucket not yet full
+        assert p.note_ready(0) == -1           # duplicate: no double count
+        assert p.next_issue() == -1
+        assert p.note_ready(1) == 0
+        assert p.next_issue() == 0
+
+
+@pytest.mark.skipif(not cpp_core.available(),
+                    reason="native core not built")
+class TestNativeParity:
+    def test_native_planner_matches_python(self):
+        planner = cpp_core.NativeBucketPlanner(16)
+        try:
+            assert drive_planner(planner) == EXPECTED_TRACE
+        finally:
+            planner.close()
+
+    def test_make_bucket_planner_prefers_native(self):
+        p = scheduler.make_bucket_planner(16)
+        try:
+            assert isinstance(p, cpp_core.NativeBucketPlanner)
+        finally:
+            p.close()
+
+    def test_resolve_algo_parity(self):
+        cases = [("", 10, 1, 2), ("ring", 10, 1, 2), ("small", 8 * MB, 1, 2),
+                 ("hier", 8, 1, 2), ("auto", 8, 4, 16),
+                 ("auto", 1 * MB, 4, 16), ("auto", 1 * MB, 1, 8),
+                 ("auto", 1024, 2, 4)]
+        for pref, nbytes, hosts, procs in cases:
+            assert cpp_core.cpp_resolve_algo(
+                pref, nbytes, hosts, procs, 1024) == scheduler.resolve_algo(
+                pref, nbytes, hosts, procs, crossover_bytes=1024), (
+                pref, nbytes, hosts, procs)
+
+
+class TestPlanTick:
+    def test_plan_tick_is_fusion_in_readiness_order(self):
+        # The negotiated ResponseList arrives in readiness order; fusion's
+        # stable left-to-right merge preserves it, so plan_tick's output
+        # IS the issue schedule the response cache replays.
+        from horovod_tpu.core import Response, ResponseType, plan_fusion
+        resp = [Response(ResponseType.ALLREDUCE, [f"t{i}"], devices=[0],
+                         tensor_sizes=[8]) for i in (2, 0, 1)]
+        entry_bytes = lambda n: 32                 # noqa: E731
+        entry_dtype = lambda n: "float32"          # noqa: E731
+        out = scheduler.plan_tick(resp, entry_bytes, entry_dtype, 1 << 20)
+        want = plan_fusion(resp, entry_bytes, entry_dtype, 1 << 20)
+        assert [r.tensor_names for r in out] == [r.tensor_names
+                                                 for r in want]
+        assert [r.tensor_names for r in out] == [["t2", "t0", "t1"]]

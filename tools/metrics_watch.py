@@ -184,6 +184,32 @@ def render_elastic_summary(snap: dict, name_filter: str) -> list:
             f"  {'elastic':<52} {text}"]
 
 
+def render_overlap_summary(snap: dict, name_filter: str) -> list[str]:
+    """One-line overlap digest per rank: bucket count, p50 hidden
+    fraction (share of each step's comm span that hid under backward
+    compute), and the exposed tail — total comm seconds the steps
+    actually waited for (``overlap.*``, docs/concepts.md "Scheduler and
+    overlap").  Present only on jobs running with overlap enabled."""
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    steps = counters.get("overlap.steps", 0)
+    if not steps:
+        return []
+    if name_filter and all(name_filter not in n for n in (
+            "overlap.buckets", "overlap.steps", "overlap.hidden_fraction",
+            "overlap.hidden_seconds", "overlap.exposed_seconds")):
+        return []
+    text = f"steps={steps:g} buckets={counters.get('overlap.buckets', 0):g}"
+    med = hist_median(hists.get("overlap.hidden_fraction", {}))
+    if med is not None:
+        text += f" p50_hidden={med:.0%}"
+    exposed = hists.get("overlap.exposed_seconds", {})
+    if exposed.get("count"):
+        text += f" exposed_tail={exposed.get('sum', 0.0):.3g}s"
+    return ["  -- backward-overlap scheduler --",
+            f"  {'overlap':<52} {text}"]
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -232,6 +258,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
     lines.extend(render_elastic_summary(snap, name_filter))
+    lines.extend(render_overlap_summary(snap, name_filter))
     return "\n".join(lines)
 
 
