@@ -1125,6 +1125,219 @@ def solo_worker():
         {"images_per_sec": round(batch * iters / dt, 2)}), flush=True)
 
 
+def recovery_worker():
+    """One rank of the chaos recovery drill (BENCH_RECOVERY_* env).
+
+    Trains a deterministic law (``w = full(step)``; each step sleeps
+    BENCH_RECOVERY_STEP_MS to stand in for compute) under
+    ``run_elastic``; rank BENCH_RECOVERY_DIE_RANK SIGKILLs itself at
+    BENCH_RECOVERY_DIE_STEP.  Checkpoint mode is BENCH_RECOVERY_MODE:
+    ``sync`` saves a full checkpoint every BENCH_RECOVERY_SYNC_EVERY
+    steps on the step path; ``async`` snapshots every
+    BENCH_RECOVERY_CADENCE steps into the delta stream.  The survivor
+    replays to the pre-crash frontier and prints one ``RECLEG`` JSON
+    line: recovery wall-clock (last pre-crash step -> caught back up),
+    the native downtime gauge, replayed steps, checkpoint byte
+    counters, and whether the restored state matched the law
+    bit-exactly."""
+    import signal
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint, elastic
+    from horovod_tpu import metrics as hvd_metrics
+
+    mode = os.environ.get("BENCH_RECOVERY_MODE", "async")
+    die_rank = int(os.environ.get("BENCH_RECOVERY_DIE_RANK", "1"))
+    die_step = int(os.environ.get("BENCH_RECOVERY_DIE_STEP", "99"))
+    sync_every = int(os.environ.get("BENCH_RECOVERY_SYNC_EVERY", "50"))
+    cadence = int(os.environ.get("BENCH_RECOVERY_CADENCE", "2"))
+    step_s = float(os.environ.get("BENCH_RECOVERY_STEP_MS", "40")) / 1e3
+    ckpt_dir = os.environ["BENCH_RECOVERY_DIR"]
+    n_elem = int(os.environ.get("BENCH_RECOVERY_STATE_ELEMS", "65536"))
+
+    elastic.init()
+    like = {"w": np.zeros(n_elem, np.float32),
+            "step": np.zeros((), np.int64)}
+    progress = {"step": 0, "t": 0.0}
+
+    def law(step):
+        return {"w": np.full(n_elem, float(step), np.float32),
+                "step": np.asarray(step, np.int64)}
+
+    def train(state, resume_epoch):
+        gen = elastic.generation()
+        step = int(state["step"])
+        if gen == 0:
+            if mode == "sync":
+                checkpoint.save(ckpt_dir, dict(state), step)
+            t0 = time.monotonic()
+            while step < die_step + 10 and time.monotonic() - t0 < 120:
+                if elastic.generation() != gen:
+                    raise hvd.HorovodRetryableError(
+                        "membership changed between steps")
+                if hvd.rank() == die_rank and step == die_step:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                hvd.allreduce(np.ones(256, np.float32),
+                              name=f"rec.{gen}.{step}")
+                time.sleep(step_s)
+                step += 1
+                state = law(step)
+                progress["step"], progress["t"] = step, time.monotonic()
+                if mode == "sync":
+                    if step % sync_every == 0:
+                        checkpoint.save(ckpt_dir, state, step)
+                else:
+                    elastic.snapshot(state, step)
+            print(f"NO_RECONFIG rank={hvd.rank()}", flush=True)
+            sys.exit(5)
+        # Survivor after the reconfiguration: verify bit-identity of the
+        # restored state against the law, replay to the frontier, report.
+        ok = bool(np.array_equal(np.asarray(state["w"]), law(step)["w"]))
+        replayed = progress["step"] - step
+        while step < progress["step"]:
+            hvd.allreduce(np.ones(256, np.float32),
+                          name=f"rec.{gen}.{step}")
+            time.sleep(step_s)
+            step += 1
+            state = law(step)
+            if mode == "sync":
+                if step % sync_every == 0:
+                    checkpoint.save(ckpt_dir, state, step)
+            else:
+                elastic.snapshot(state, step)
+        recovery_s = time.monotonic() - progress["t"]
+        snap = hvd_metrics.snapshot()
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        dir_bytes = 0
+        for root, _dirs, files in os.walk(ckpt_dir):
+            dir_bytes += sum(
+                os.path.getsize(os.path.join(root, f)) for f in files)
+        if hvd.rank() == 0:
+            print("RECLEG " + json.dumps({
+                "mode": mode,
+                "resume_epoch": int(resume_epoch),
+                "replayed_steps": int(replayed),
+                "recovery_seconds": round(recovery_s, 4),
+                "native_downtime_s": round(
+                    gauges.get("elastic.last_downtime_s", -1.0), 4),
+                "state_ok": ok,
+                "step_seconds": step_s,
+                "ckpt_bytes": {
+                    "base": int(counters.get(
+                        "ckpt.bytes_written#kind=base", 0)),
+                    "delta": int(counters.get(
+                        "ckpt.bytes_written#kind=delta", 0)),
+                    "dir": int(dir_bytes),
+                },
+                "commits": {
+                    "base": int(counters.get("ckpt.commits#kind=base", 0)),
+                    "delta": int(counters.get(
+                        "ckpt.commits#kind=delta", 0)),
+                    "snapshots": int(counters.get("ckpt.snapshots", 0)),
+                },
+            }), flush=True)
+        return state
+
+    elastic.run_elastic(
+        train, directory=ckpt_dir, like=like,
+        snapshot_every_steps=cadence if mode == "async" else 0)
+    print("RECDONE", flush=True)
+
+
+def _recovery_drill():
+    """Kill-one-rank recovery drill, sync full checkpoints vs the async
+    delta stream, in the same run on the same machine.  Returns the
+    artifact block with both legs and the headline ratio
+    (``recovery_ratio_async_vs_sync`` — the acceptance bar is <= 0.25:
+    async recovery replays a snapshot interval, sync replays a full
+    checkpoint interval)."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def leg(mode):
+        tmpdir = tempfile.mkdtemp(prefix=f"bench-recovery-{mode}-")
+        port = free_port()
+        procs = []
+        for i in range(2):
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+                "HOROVOD_TPU_PROCESS_INDEX": str(i),
+                "HOROVOD_TPU_PROCESS_COUNT": "2",
+                "HOROVOD_TPU_SIZE": "2",
+                "HOROVOD_TPU_RANK": str(i),
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+                "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+                "HOROVOD_TPU_ELASTIC": "1",
+                "BENCH_RECOVERY_MODE": mode,
+                "BENCH_RECOVERY_DIR": tmpdir,
+            })
+            env.pop("HOROVOD_TPU_FAULT", None)
+            env.pop("HOROVOD_TPU_TIMELINE", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--recovery-worker"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__))))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append((p.returncode, out))
+        rc1, _out1 = outs[1]
+        if rc1 != -signal.SIGKILL:
+            raise RuntimeError(
+                f"{mode} leg: victim exited {rc1}, expected SIGKILL:\n"
+                f"{outs[1][1][-2000:]}")
+        rc0, out0 = outs[0]
+        for line in out0.splitlines():
+            if line.startswith("RECLEG "):
+                result = json.loads(line[len("RECLEG "):])
+                if rc0 != 0:
+                    result["survivor_exit"] = rc0
+                return result
+        raise RuntimeError(
+            f"{mode} leg produced no RECLEG line (survivor exit {rc0}):\n"
+            f"{out0[-2000:]}")
+
+    sync = leg("sync")
+    async_ = leg("async")
+    ratio = (round(async_["recovery_seconds"] / sync["recovery_seconds"], 4)
+             if sync.get("recovery_seconds") else None)
+    return {
+        "sync": sync,
+        "async": async_,
+        "recovery_ratio_async_vs_sync": ratio,
+        "note": ("one of two ranks SIGKILLed under load; recovery = wall "
+                 "time from the survivor's last pre-crash step until it "
+                 "replayed back to that step.  sync saves a full "
+                 "checkpoint every 50 steps on the step path; async "
+                 "snapshots every 2 steps into the base+delta stream"),
+    }
+
+
 def bench_scaling_tcp():
     """Disjoint-runtime scaling leg on localhost: the same worker loop at
     1 process (no communication) and at 2 processes under the
@@ -1298,6 +1511,13 @@ def bench_scaling_tcp():
             }
         except Exception as e:   # noqa: BLE001 — affinity-less platforms
             pinned = {"error": f"{type(e).__name__}: {e}"}
+    if os.environ.get("BENCH_RECOVERY", "1") == "1":
+        try:
+            recovery = _recovery_drill()
+        except Exception as e:   # noqa: BLE001 — the drill must not sink
+            recovery = {"error": f"{type(e).__name__}: {e}"}  # the leg
+    else:
+        recovery = {"skipped": "BENCH_RECOVERY=0"}
     transport = two.get("ring_transport", "tcp")
     eff = round(two["images_per_sec_per_proc"]
                 / one["images_per_sec_per_proc"], 4)
@@ -1338,6 +1558,10 @@ def bench_scaling_tcp():
         # negotiation bytes (uncached vs cached) and cached/uncached tick
         # latency, measured by the worker's probe on the coordinator.
         "response_cache": two.get("response_cache"),
+        # Kill-one-rank recovery drill (sync full checkpoints vs the
+        # async delta stream) — the trajectory tracks recovery, not just
+        # throughput.  BENCH_RECOVERY=0 skips it.
+        "recovery": recovery,
     }
 
 
@@ -1548,6 +1772,8 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--solo-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--recovery-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.tcp_worker:
@@ -1555,6 +1781,9 @@ def main():
         return
     if args.solo_worker:
         solo_worker()
+        return
+    if args.recovery_worker:
+        recovery_worker()
         return
     if args.n_virtual:
         print(json.dumps(bench_scaling(args.n_virtual)))

@@ -630,7 +630,11 @@ void ControlPlane::ParseFaultEnv() {
       }
       int m = mode == "crash" ? 1 : mode == "hang" ? 2
               : mode == "drop_conn" ? 3 : mode == "rejoin" ? 4 : 0;
-      if (m == 4 && rank >= 0 && tick > 0) {
+      if (mode == "crash_in_save") {
+        // Python-owned fault: the checkpoint writer thread
+        // (ckpt_stream.py) fires it mid-commit; not a tick fault and
+        // not malformed — nothing for the native plane to arm.
+      } else if (m == 4 && rank >= 0 && tick > 0) {
         if (int(rank) == first_rank_) rejoin_tick_ = tick;
       } else if (m && rank >= 0 && tick > 0) {
         FaultSpec fs;

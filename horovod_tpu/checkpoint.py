@@ -41,6 +41,227 @@ def checkpoint_path(directory: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(directory), f"checkpoint-{epoch}")
 
 
+# ------------------------------------------------------------ delta chains
+# The async snapshot stream (ckpt_stream.py) commits epochs as CHAIN
+# directories instead of orbax trees: a committed ``checkpoint-N`` holding
+# ``chain.json`` (manifest) and ``shards.npz`` (only the leaves whose bytes
+# changed since the previous committed epoch).  A chain epoch is readable
+# iff the manifest links ``prev`` hops back to a ``base`` epoch that still
+# exists — :func:`chain_links` walks that list, and :func:`latest_epoch`
+# only reports epochs whose full chain is intact, so a resume racing a
+# crashed or garbage-collected writer falls back to the previous committed
+# chain instead of picking a torn tip.
+
+CHAIN_MANIFEST = "chain.json"
+CHAIN_SHARDS = "shards.npz"
+
+# Staging paths owned by a LIVE async writer, keyed by epoch: a concurrent
+# synchronous save()'s _clean_stale must not reap an in-flight commit (the
+# pre-chain cleaner could assume "no save running" because the single
+# writer was the caller itself).
+_ACTIVE_STAGING: Dict[int, str] = {}
+
+
+class TornChainError(RuntimeError):
+    """A chain checkpoint exists but one of its links (its base or an
+    intermediate delta) is missing or unreadable, so the epoch cannot be
+    reconstructed.  Resume paths catch this and fall back to the previous
+    committed chain."""
+
+
+def flatten_state(state: Any) -> Dict[str, Any]:
+    """Flatten a pytree into ``{keystr(path): np.ndarray}`` — the on-host
+    snapshot form the delta writer diffs and stores.  ``np.asarray`` on a
+    ``jax.Array`` is the device→host copy; everything downstream of it is
+    host-side work.  Key strings come from ``jax.tree_util.keystr`` and are
+    stable for the dict/list/tuple trees training states are made of."""
+    import numpy as np
+    from jax.tree_util import keystr, tree_flatten_with_path
+    flat = {}
+    for path, leaf in tree_flatten_with_path(state)[0]:
+        flat[keystr(path)] = np.asarray(leaf)
+    return flat
+
+
+def unflatten_like(like: Any, flat: Dict[str, Any]) -> Any:
+    """Rebuild a pytree with ``like``'s structure from a flat snapshot.
+    The key sets must match exactly — a template drift (renamed or added
+    leaves) is a structural error, not something to paper over."""
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+    paths_leaves, treedef = tree_flatten_with_path(like)
+    keys = [keystr(p) for p, _ in paths_leaves]
+    missing = [k for k in keys if k not in flat]
+    extra = sorted(set(flat) - set(keys))
+    if missing or extra:
+        raise ValueError(
+            f"chain checkpoint does not match the restore template: "
+            f"missing leaves {missing[:4]!r}, unexpected leaves "
+            f"{extra[:4]!r}")
+    return tree_unflatten(treedef, [flat[k] for k in keys])
+
+
+def _chain_manifest(directory: str, epoch: int) -> Optional[dict]:
+    p = os.path.join(checkpoint_path(directory, epoch), CHAIN_MANIFEST)
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_chain(directory: str, epoch: int) -> bool:
+    """True when ``checkpoint-{epoch}`` is a committed chain directory
+    (vs a legacy orbax tree or nothing at all)."""
+    return _chain_manifest(directory, epoch) is not None
+
+
+def chain_links(directory: str, epoch: int) -> Optional[List[int]]:
+    """Epochs to replay, base first, to reconstruct chain ``epoch`` —
+    or None when the chain is torn (a link missing, unreadable, cyclic,
+    or not anchored to a base)."""
+    links: List[int] = []
+    e = epoch
+    while True:
+        m = _chain_manifest(directory, e)
+        if m is None:
+            return None
+        links.append(e)
+        if m.get("kind") == "base":
+            return list(reversed(links))
+        prev = m.get("prev", -1)
+        # prev must strictly decrease — anything else is corrupt/cyclic.
+        if not isinstance(prev, int) or not 0 <= prev < e:
+            return None
+        e = prev
+
+
+def _is_committed(directory: str, epoch: int) -> bool:
+    """True when ``checkpoint-{epoch}`` is restorable: a legacy orbax dir
+    (atomic-replace committed, hence complete) or a chain dir whose links
+    are all intact."""
+    if not os.path.isdir(checkpoint_path(directory, epoch)):
+        return False
+    if is_chain(directory, epoch):
+        return chain_links(directory, epoch) is not None
+    return True
+
+
+def save_chain(directory: str, flat: Dict[str, Any], epoch: int, *,
+               prev_epoch: int = -1,
+               prev_flat: Optional[Dict[str, Any]] = None,
+               fault_hook=None) -> Dict[str, Any]:
+    """Commit one chain epoch atomically: a full ``base`` when
+    ``prev_flat`` is None (or the leaf set changed), else a ``delta``
+    holding only the leaves whose bytes differ from ``prev_flat`` (the
+    last COMMITTED snapshot, anchored at ``prev_epoch``).
+
+    Same commit discipline as :func:`save`: world sidecar first, shards
+    staged under a dot-prefixed dir ``latest_epoch`` can never match, one
+    ``os.replace`` to publish.  ``fault_hook`` (chaos drills) runs after
+    the shards are staged but before the commit — the worst place to die.
+
+    Returns ``{"kind", "epoch", "nbytes", "shards", "total"}``.  The
+    single-writer convention is the caller's job (ckpt_stream runs this
+    on the owning rank's writer thread only).
+    """
+    import numpy as np
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = checkpoint_path(directory, epoch)
+    if prev_flat is not None and set(prev_flat) != set(flat):
+        prev_flat = None   # leaf set changed: a delta cannot express it
+    if prev_flat is None:
+        changed = sorted(flat)
+        kind = "base"
+    else:
+        changed = sorted(
+            k for k, v in flat.items()
+            if v.shape != prev_flat[k].shape
+            or v.dtype != prev_flat[k].dtype
+            or v.tobytes() != prev_flat[k].tobytes())
+        kind = "delta"
+    staging = os.path.join(directory,
+                           f".tmp-checkpoint-{epoch}-{os.getpid()}")
+    _ACTIVE_STAGING[epoch] = staging
+    try:
+        # Sidecar before the commit, same ordering argument as save().
+        try:
+            world = {"world_size": basics.size(),
+                     "process_count": basics.process_count()}
+        except Exception:
+            world = None   # usable before init (tests, offline tools)
+        if world is not None:
+            _write_atomic(_world_meta_path(directory, epoch),
+                          json.dumps(world))
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        np.savez(os.path.join(staging, CHAIN_SHARDS),
+                 **{k: np.asarray(flat[k]) for k in changed})
+        if fault_hook is not None:
+            fault_hook()
+        manifest = {"format": 1, "kind": kind, "epoch": epoch,
+                    "prev": prev_epoch if kind == "delta" else -1,
+                    "keys": sorted(flat), "shards": changed}
+        _write_atomic(os.path.join(staging, CHAIN_MANIFEST),
+                      json.dumps(manifest))
+        if os.path.isdir(path):
+            shutil.rmtree(path)   # re-commit of the same epoch
+        os.replace(staging, path)
+    finally:
+        _ACTIVE_STAGING.pop(epoch, None)
+    nbytes = int(sum(np.asarray(flat[k]).nbytes for k in changed))
+    return {"kind": kind, "epoch": epoch, "nbytes": nbytes,
+            "shards": len(changed), "total": len(flat)}
+
+
+def read_chain_state(directory: str, epoch: int) -> Dict[str, Any]:
+    """Replay the base+delta chain ending at ``epoch`` into a flat
+    snapshot.  Raises :class:`TornChainError` when the chain is torn."""
+    import numpy as np
+    links = chain_links(directory, epoch)
+    if links is None:
+        raise TornChainError(
+            f"checkpoint-{epoch} in {directory!r} is a torn chain (a "
+            f"base or delta link is missing); latest committed epoch "
+            f"is {latest_epoch(directory)}")
+    flat: Dict[str, Any] = {}
+    for e in links:
+        shard_path = os.path.join(checkpoint_path(directory, e),
+                                  CHAIN_SHARDS)
+        try:
+            with np.load(shard_path, allow_pickle=False) as z:
+                for k in z.files:
+                    flat[k] = z[k]
+        except (OSError, ValueError) as exc:
+            raise TornChainError(
+                f"checkpoint-{e} (link of chain {epoch}) in "
+                f"{directory!r} is unreadable: {exc}") from exc
+    keys = _chain_manifest(directory, epoch)["keys"]
+    missing = [k for k in keys if k not in flat]
+    if missing:
+        raise TornChainError(
+            f"chain {epoch} in {directory!r} replayed without leaves "
+            f"{missing[:4]!r} — base was overwritten by a narrower state")
+    return {k: flat[k] for k in keys}
+
+
+def resolve_committed_epoch(directory: str, epoch: int) -> int:
+    """``epoch`` if it is committed (legacy or intact chain), else the
+    highest committed epoch below it, else -1.  The torn-tip fallback:
+    rank 0 runs this before the restore broadcast so no rank ever starts
+    restoring an epoch that cannot be read."""
+    if epoch >= 0 and _is_committed(directory, epoch):
+        return epoch
+    best = -1
+    if os.path.isdir(directory):
+        for entry in os.listdir(directory):
+            m = re.fullmatch(r"checkpoint-(\d+)", entry)
+            if m and best < int(m.group(1)) < epoch and _is_committed(
+                    directory, int(m.group(1))):
+                best = int(m.group(1))
+    return best
+
+
 def save(directory: str, state: Any, epoch: int) -> Optional[str]:
     """Write a checkpoint on rank 0 only; other ranks no-op (convention 1).
 
@@ -88,13 +309,18 @@ def _clean_stale(directory: str) -> None:
     """Remove debris a mid-save crash can leave behind: uncommitted
     staging directories, half-written sidecar temp files, and orphan
     sidecars whose checkpoint never committed.  Runs in the single
-    writer (rank 0) at save time — outside a save there is no
-    in-flight staging, so everything matched is guaranteed stale."""
+    writer (rank 0) at save time.  Staging dirs registered by a live
+    async writer (``_ACTIVE_STAGING``) are in flight, not stale — the
+    background delta writer may be mid-commit while a synchronous
+    ``save()`` runs on the training thread."""
     entries = set(os.listdir(directory))
+    active = {os.path.basename(p) for p in _ACTIVE_STAGING.values()}
+    active_epochs = {f"checkpoint-{e}" for e in _ACTIVE_STAGING}
     for entry in entries:
         p = os.path.join(directory, entry)
         if re.fullmatch(r"\.tmp-checkpoint-\d+-\d+", entry):
-            shutil.rmtree(p, ignore_errors=True)
+            if entry not in active:
+                shutil.rmtree(p, ignore_errors=True)
         elif re.fullmatch(
                 r"checkpoint-\d+\.(world|optimizer)\.json\.tmp", entry):
             try:
@@ -104,7 +330,8 @@ def _clean_stale(directory: str) -> None:
         else:
             m = re.fullmatch(r"(checkpoint-\d+)\.(world|optimizer)\.json",
                              entry)
-            if m and m.group(1) not in entries:
+            if (m and m.group(1) not in entries
+                    and m.group(1) not in active_epochs):
                 try:
                     os.remove(p)
                 except OSError:
@@ -146,30 +373,45 @@ def latest_epoch(directory: str) -> int:
     first existing file wins).  Only committed checkpoint directories
     count: :func:`save` stages under a dot-prefixed name the pattern
     can never match and publishes atomically, so an entry seen here is
-    complete — sidecars and stray files are skipped.
+    complete — sidecars, stray files, and dot-prefixed staging debris
+    from a crashed save are skipped.  A chain epoch additionally counts
+    only when every link back to its base is intact, so a resume racing
+    a crashed delta writer falls back past the torn tip.
     """
     if not os.path.isdir(directory):
         return -1
     best = -1
     for entry in os.listdir(directory):
         m = re.fullmatch(r"checkpoint-(\d+)", entry)
-        if m and os.path.isdir(os.path.join(directory, entry)):
-            best = max(best, int(m.group(1)))
+        if m and int(m.group(1)) > best and _is_committed(
+                directory, int(m.group(1))):
+            best = int(m.group(1))
     return best
 
 
 def restore(directory: str, epoch: int, like: Any) -> Any:
     """Restore the checkpoint for ``epoch`` with the structure of ``like``.
 
-    Passing ``item=like`` makes orbax rebuild the original pytree structure
-    (optax states are NamedTuples/tuples, which the stored metadata alone
-    round-trips as lists).
+    A chain epoch (async incremental stream) replays its base+delta links;
+    raises :class:`TornChainError` if a link is missing.  A legacy orbax
+    epoch restores with ``item=like`` so orbax rebuilds the original
+    pytree structure (optax states are NamedTuples/tuples, which the
+    stored metadata alone round-trips as lists).
     """
-    import orbax.checkpoint as ocp
-    path = checkpoint_path(directory, epoch)
-    return _checkpointer().restore(
-        path, item=like,
-        restore_args=ocp.checkpoint_utils.construct_restore_args(like))
+    import time
+    t0 = time.perf_counter()
+    if is_chain(directory, epoch):
+        out = unflatten_like(like, read_chain_state(directory, epoch))
+    else:
+        import orbax.checkpoint as ocp
+        path = checkpoint_path(directory, epoch)
+        out = _checkpointer().restore(
+            path, item=like,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(like))
+    from horovod_tpu import metrics
+    metrics.registry.observe("ckpt.restore_seconds",
+                             time.perf_counter() - t0)
+    return out
 
 
 @dataclasses.dataclass
@@ -482,6 +724,26 @@ def restore_and_broadcast(directory: str, like: Any,
             np.asarray(epoch, np.int64), root_rank,
             name="ckpt.resume_epoch")))
     if epoch >= 0:
+        # Torn-tip fallback, agreed BEFORE any value broadcast: rank 0
+        # validates the chosen epoch is committed (an explicitly passed
+        # epoch may be a chain whose base was lost, or debris from a
+        # writer that died mid-commit) and every rank pivots to the same
+        # fallback — rank 0 must never discover a torn chain after the
+        # other ranks have entered the restore broadcast.
+        tip = (resolve_committed_epoch(directory, epoch)
+               if basics.rank() == root_rank else -1)
+        tip = int(np.asarray(eager.broadcast(
+            np.asarray(tip, np.int64), root_rank,
+            name="ckpt.chain_tip")))
+        if tip != epoch:
+            print(
+                f"horovod_tpu checkpoint: checkpoint-{epoch} in "
+                f"{directory!r} is torn or missing; falling back to "
+                + (f"committed checkpoint-{tip}" if tip >= 0
+                   else "fresh state (no committed checkpoint)"),
+                file=sys.stderr)
+        epoch = tip
+    if epoch >= 0:
         # Elastic resume: the world that wrote the checkpoint may be gone
         # (a rank was lost and the job reconfigured).  Replicated state
         # re-broadcasts from root at ANY world size; state laid out across
@@ -517,10 +779,16 @@ def restore_and_broadcast(directory: str, like: Any,
     if optional_keys and epoch >= 0:
         present = 0
         if basics.rank() == root_rank:
-            tree = _checkpointer().metadata(
-                checkpoint_path(directory, epoch)).item_metadata.tree
-            present = sum(1 << i for i, k in enumerate(optional_keys)
-                          if k in tree)
+            if is_chain(directory, epoch):
+                leaf_keys = _chain_manifest(directory, epoch)["keys"]
+                present = sum(
+                    1 << i for i, k in enumerate(optional_keys)
+                    if any(s.startswith(f"['{k}']") for s in leaf_keys))
+            else:
+                tree = _checkpointer().metadata(
+                    checkpoint_path(directory, epoch)).item_metadata.tree
+                present = sum(1 << i for i, k in enumerate(optional_keys)
+                              if k in tree)
         present = int(np.asarray(eager.broadcast(
             np.asarray(present, np.int64), root_rank,
             name="ckpt.optional_keys")))

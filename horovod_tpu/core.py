@@ -106,25 +106,37 @@ SHUT_DOWN_ERROR = Status.aborted(
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """Parsed HOROVOD_TPU_FAULT=<mode>:rank=<R>:tick=<T> spec.
+    """Parsed HOROVOD_TPU_FAULT=<mode>:rank=<R>:tick=<T> spec (or
+    ``crash_in_save:rank=<R>:epoch=<E>``, the checkpoint-writer fault).
 
     The native core parses the same env var itself (control.cc) and fires
-    the fault on the tick thread; this Python-side parse exists to reject
-    malformed specs loudly at init() instead of silently never firing.
+    the tick-based faults on the tick thread; ``crash_in_save`` is
+    Python-owned (ckpt_stream.py fires it mid-commit) and the native
+    parser skips it.  This Python-side parse exists to reject malformed
+    specs loudly at init() instead of silently never firing.
     """
-    mode: str      # "crash" | "hang" | "drop_conn" | "rejoin"
+    mode: str      # "crash" | "hang" | "drop_conn" | "rejoin" | "crash_in_save"
     rank: int      # first global rank of the target process
-    tick: int      # 1-based negotiation tick on which the fault fires
+    tick: int      # 1-based negotiation tick on which the fault fires;
+                   # for crash_in_save, the 0-based snapshot epoch
+
+    @property
+    def epoch(self) -> int:
+        """crash_in_save's trigger: first committed snapshot epoch >= this
+        value kills the writer mid-commit."""
+        return self.tick
 
 
-_FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin")
+_FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin", "crash_in_save")
 
 
 def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
     """Strictly parse ONE fault spec; None for empty, ValueError on
     malformed.  ``rejoin`` arms the coordinator to admit parked standby
     workers at the first tick >= T (elastic mode's deterministic readmit
-    trigger)."""
+    trigger); ``crash_in_save`` takes ``epoch=`` instead of ``tick=``
+    (epochs are step numbers, counted from 0) and kills the async
+    checkpoint writer between staging its shards and committing them."""
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -132,32 +144,37 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
     if len(parts) != 3 or parts[0] not in _FAULT_MODES:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
-            "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>'.")
+            "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>' or "
+            "'crash_in_save:rank=<R>:epoch=<E>'.")
+    when_key = "epoch" if parts[0] == "crash_in_save" else "tick"
     kv = {}
     for part in parts[1:]:
         key, sep, val = part.partition("=")
-        if not sep or key not in ("rank", "tick") or key in kv:
+        if not sep or key not in ("rank", when_key) or key in kv:
             raise ValueError(
                 f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
-                "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>'.")
+                f"'{parts[0]}:rank=<R>:{when_key}=<N>'.")
         try:
             kv[key] = int(val)
         except ValueError:
             raise ValueError(
                 f"Malformed HOROVOD_TPU_FAULT {spec!r}: {key!r} must be an "
                 f"integer, got {val!r}.") from None
-    if "rank" not in kv or "tick" not in kv:
+    if "rank" not in kv or when_key not in kv:
         raise ValueError(
-            f"Malformed HOROVOD_TPU_FAULT {spec!r}: both rank= and tick= "
-            "are required.")
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: both rank= and "
+            f"{when_key}= are required.")
     if kv["rank"] < 0:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: rank must be >= 0.")
-    if kv["tick"] <= 0:
+    if when_key == "tick" and kv["tick"] <= 0:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
             "(ticks are counted from 1).")
-    return FaultSpec(parts[0], kv["rank"], kv["tick"])
+    if when_key == "epoch" and kv["epoch"] < 0:
+        raise ValueError(
+            f"Malformed HOROVOD_TPU_FAULT {spec!r}: epoch must be >= 0.")
+    return FaultSpec(parts[0], kv["rank"], kv[when_key])
 
 
 def parse_fault_specs(value: str) -> List[FaultSpec]:

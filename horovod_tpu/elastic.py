@@ -112,10 +112,37 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         raise
 
 
+# The active async snapshot stream, owned by run_elastic on the restore
+# root (the writing rank).  Module-level so training loops can call
+# elastic.snapshot(state, step) without threading the stream through.
+_stream = None
+
+
+def active_stream():
+    """The run's :class:`~horovod_tpu.ckpt_stream.AsyncCheckpointer`
+    (restore-root rank only, while inside :func:`run_elastic` with
+    snapshotting on), else None."""
+    return _stream
+
+
+def snapshot(state: Any, step: int) -> bool:
+    """Per-step hook for the async checkpoint stream: a cheap
+    device→host snapshot every ``snapshot_every_steps`` steps on the
+    writing rank; a no-op (False) everywhere else.  Re-raises the
+    background writer's failure, if any, as the attributed
+    ``HorovodRetryableError`` — on the owning rank, on the step path,
+    where :func:`run_elastic` handles it."""
+    s = _stream
+    if s is None:
+        return False
+    return s.maybe_snapshot(state, step)
+
+
 def run_elastic(train: Callable[[Any, int], Any], *, directory: str,
                 like: Any, root_rank: int = 0,
                 optional_keys: Tuple[str, ...] = (),
-                max_reconfigures: int = 32) -> Any:
+                max_reconfigures: int = 32,
+                snapshot_every_steps: Optional[int] = None) -> Any:
     """Drive a training function across membership changes.
 
     ``train(state, resume_epoch)`` is entered with ``state`` restored
@@ -124,26 +151,65 @@ def run_elastic(train: Callable[[Any, int], Any], *, directory: str,
     freshly restored — every time it raises
     :class:`~horovod_tpu.ops.eager.HorovodRetryableError`, i.e. every
     time the membership reconfigured under it.  ``train`` should
-    checkpoint periodically with :func:`horovod_tpu.checkpoint.save`;
-    work since the last checkpoint is replayed after a reconfiguration.
+    checkpoint periodically; work since the last checkpoint is replayed
+    after a reconfiguration.
 
-    Returns ``train``'s return value.  Aborts
-    (:class:`~horovod_tpu.ops.eager.HorovodAbortedError`) and every other
-    exception propagate unchanged — only membership changes retry.
+    ``snapshot_every_steps`` (default: ``HOROVOD_TPU_CKPT_EVERY_STEPS``,
+    0 = off) arms the async incremental stream (ckpt_stream.py): the
+    root rank gets an :class:`~horovod_tpu.ckpt_stream.AsyncCheckpointer`
+    seeded with the restored state, and ``train`` calls
+    :func:`elastic.snapshot(state, step) <snapshot>` once per step —
+    recovery then replays at most a snapshot interval plus the
+    in-flight write instead of a full checkpoint interval.
+
+    Returns ``train``'s return value (the stream is flushed first, so a
+    clean exit leaves the final snapshot committed).  Aborts
+    (:class:`~horovod_tpu.ops.eager.HorovodAbortedError`) and every
+    other exception propagate unchanged — only membership changes retry.
     """
-    from horovod_tpu import checkpoint
+    import time
+
+    from horovod_tpu import checkpoint, ckpt_stream
+    from horovod_tpu import metrics as _metrics
     from horovod_tpu.ops.eager import HorovodRetryableError
 
+    global _stream
+    cadence = (snapshot_every_steps if snapshot_every_steps is not None
+               else ckpt_stream.snapshot_every_steps_default())
+    use_stream = cadence > 0 or ckpt_stream.async_enabled()
     attempts = 0
     while True:
         # The restore itself runs collectives (epoch agreement + parameter
         # broadcast), so a membership change landing mid-restore retries
         # the same way one landing mid-train does.
         try:
+            t0 = time.monotonic()
             state, epoch = checkpoint.restore_and_broadcast(
                 directory, like, root_rank=root_rank,
                 optional_keys=optional_keys)
-            return train(state, epoch)
+            if attempts:
+                # Restore leg of a reconfiguration (native
+                # elastic.downtime_seconds covers quiesce->rebootstrap;
+                # this covers the Python restore+broadcast on top).
+                _metrics.registry.observe("elastic.resume_seconds",
+                                          time.monotonic() - t0)
+                _metrics.registry.set_gauge("elastic.last_resume_s",
+                                            time.monotonic() - t0)
+            if use_stream and basics.rank() == root_rank:
+                _stream = ckpt_stream.AsyncCheckpointer(
+                    directory, snapshot_every_steps=cadence)
+                _stream.seed(state, epoch)
+            try:
+                result = train(state, epoch)
+                if _stream is not None:
+                    # Surface a pending writer failure before declaring
+                    # success; on a clean exit the final snapshot commits.
+                    _stream.flush()
+                return result
+            finally:
+                if _stream is not None:
+                    _stream.close(flush=False)
+                    _stream = None
         except HorovodRetryableError as exc:
             attempts += 1
             if attempts > max_reconfigures:

@@ -111,6 +111,16 @@ def main(argv=None):
                    help="total crashed children relaunched as standbys "
                         "before the launcher stops replacing them "
                         "(elastic mode)")
+    p.add_argument("--ckpt-async", action="store_true",
+                   help="async incremental checkpointing (sets "
+                        "HOROVOD_TPU_CKPT_ASYNC=1): run_elastic snapshots "
+                        "device state into a host buffer and a background "
+                        "writer commits base+delta chains")
+    p.add_argument("--snapshot-every-steps", type=int, default=0,
+                   help="async snapshot cadence in steps (sets "
+                        "HOROVOD_TPU_CKPT_EVERY_STEPS and implies "
+                        "--ckpt-async); recovery replays at most this "
+                        "many steps plus the in-flight write")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to run (prefix with --)")
     args = p.parse_args(argv)
@@ -145,6 +155,11 @@ def main(argv=None):
                     args.elastic_min_ranks)
         if standby:
             env["HOROVOD_TPU_STANDBY"] = "1"
+        if args.ckpt_async or args.snapshot_every_steps > 0:
+            env["HOROVOD_TPU_CKPT_ASYNC"] = "1"
+        if args.snapshot_every_steps > 0:
+            env["HOROVOD_TPU_CKPT_EVERY_STEPS"] = str(
+                args.snapshot_every_steps)
         if args.metrics_every > 0:
             env["HOROVOD_TPU_METRICS_EVERY_S"] = str(args.metrics_every)
         if args.metrics_port > 0:

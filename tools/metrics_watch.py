@@ -184,6 +184,51 @@ def render_elastic_summary(snap: dict, name_filter: str) -> list:
             f"  {'elastic':<52} {text}"]
 
 
+def render_ckpt_summary(snap: dict, name_filter: str) -> list:
+    """One-line recovery digest: async snapshot/commit counts by kind,
+    last committed epoch, last delta size, snapshot age (how stale the
+    recovery point is), write errors, and the last reconfiguration's
+    downtime + Python resume cost — present only on jobs running the
+    async checkpoint stream (``ckpt.*``, docs/elasticity.md "Recovery
+    budget")."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    snaps = counters.get("ckpt.snapshots", 0)
+    commits = (counters.get("ckpt.commits#kind=base", 0)
+               + counters.get("ckpt.commits#kind=delta", 0))
+    if not snaps and not commits:
+        return []
+    if name_filter and all(name_filter not in n for n in (
+            "ckpt.snapshots", "ckpt.commits#kind=", "ckpt.last_commit_epoch",
+            "ckpt.last_delta_bytes", "ckpt.last_snapshot_ts",
+            "ckpt.write_errors", "elastic.last_downtime_s",
+            "elastic.last_resume_s")):
+        return []
+    text = (f"snapshots={snaps:g} commits={commits:g} "
+            f"(base={counters.get('ckpt.commits#kind=base', 0):g} "
+            f"delta={counters.get('ckpt.commits#kind=delta', 0):g})")
+    epoch = gauges.get("ckpt.last_commit_epoch")
+    if epoch is not None:
+        text += f" last_epoch={int(epoch)}"
+    delta_b = gauges.get("ckpt.last_delta_bytes")
+    if delta_b is not None:
+        text += f" last_delta={human_bytes(delta_b)}"
+    ts, snap_ts = snap.get("ts"), gauges.get("ckpt.last_snapshot_ts")
+    if ts and snap_ts:
+        text += f" snapshot_age={max(0.0, ts - snap_ts):.3g}s"
+    errors = counters.get("ckpt.write_errors", 0)
+    if errors:
+        text += f" write_errors={errors:g}"
+    down = gauges.get("elastic.last_downtime_s")
+    if down is not None:
+        text += f" last_downtime={down:.3g}s"
+    resume = gauges.get("elastic.last_resume_s")
+    if resume is not None:
+        text += f" last_resume={resume:.3g}s"
+    return ["  -- async checkpoint stream --",
+            f"  {'ckpt':<52} {text}"]
+
+
 def render_overlap_summary(snap: dict, name_filter: str) -> list[str]:
     """One-line overlap digest per rank: bucket count, p50 hidden
     fraction (share of each step's comm span that hid under backward
@@ -258,6 +303,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
     lines.extend(render_elastic_summary(snap, name_filter))
+    lines.extend(render_ckpt_summary(snap, name_filter))
     lines.extend(render_overlap_summary(snap, name_filter))
     return "\n".join(lines)
 
