@@ -1250,6 +1250,81 @@ def recovery_worker():
     print("RECDONE", flush=True)
 
 
+def policy_worker():
+    """One rank of the straggler-eviction policy drill (BENCH_POLICY_*
+    env).
+
+    Three ranks train a fixed allreduce loop under ``run_elastic`` with
+    the fleet policy armed; the drill plants ``slow:rank=1:ms=M`` on
+    exactly one process's environment.  The coordinator's policy demotes
+    the straggler at a planned tick boundary and admits the parked spare
+    in the same reconfigure (``HOROVOD_TPU_ELASTIC_MIN_RANKS`` pins the
+    floor so the swap is world-neutral).  Rank 0 then prints one
+    ``POLLEG`` JSON line: wall time from the start of delayed ticking to
+    the resumed step, the native ``policy.*`` counters, the downtime
+    gauge, and whether the restored state matched bit-exactly."""
+    import sys
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint, elastic
+    from horovod_tpu import metrics as hvd_metrics
+
+    slow_ms = int(os.environ.get("BENCH_POLICY_SLOW_MS", "30"))
+    ckpt_dir = os.environ["BENCH_POLICY_DIR"]
+    elastic.init()
+    w0 = np.arange(4096, dtype=np.float32)
+    t_start = {"t": 0.0}
+
+    def train(state, resume_epoch):
+        gen = elastic.generation()
+        if gen == 0:
+            checkpoint.save(ckpt_dir, dict(state), 0)
+            t_start["t"] = time.monotonic()
+            t0 = time.monotonic()
+            i = 0
+            while time.monotonic() - t0 < 120:
+                if elastic.generation() != gen:
+                    raise hvd.HorovodRetryableError(
+                        "membership changed between steps")
+                hvd.allreduce(np.ones(256, np.float32),
+                              name=f"pol.{gen}.{i}")
+                i += 1
+            print(f"NO_EVICTION rank={hvd.rank()}", flush=True)
+            sys.exit(5)
+        evict_s = time.monotonic() - t_start["t"]
+        ok = bool(np.array_equal(np.asarray(state["w"]), w0))
+        snap = hvd_metrics.snapshot()
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        if hvd.rank() == 0:
+            print("POLLEG " + json.dumps({
+                "slow_ms": slow_ms,
+                "evict_seconds": round(evict_s, 4),
+                "native_downtime_s": round(
+                    gauges.get("elastic.last_downtime_s", -1.0), 4),
+                "evictions": int(counters.get("policy.evictions", 0)),
+                "evictions_suppressed": int(
+                    counters.get("policy.evictions_suppressed", 0)),
+                "generation": int(gen),
+                "size": int(hvd.size()),
+                "state_ok": ok,
+            }), flush=True)
+        return state
+
+    try:
+        elastic.run_elastic(train, directory=ckpt_dir, like={"w": w0})
+    except hvd.HorovodAbortedError:
+        # The evicted straggler itself: demoted out of the membership.
+        print("POLABORT", flush=True)
+        sys.exit(3)
+    print("POLDONE", flush=True)
+
+
 def _recovery_drill():
     """Kill-one-rank recovery drill, sync full checkpoints vs the async
     delta stream, in the same run on the same machine.  Returns the
@@ -1336,6 +1411,99 @@ def _recovery_drill():
                  "checkpoint every 50 steps on the step path; async "
                  "snapshots every 2 steps into the base+delta stream"),
     }
+
+
+def _policy_drill():
+    """Planted-straggler eviction drill: three ranks plus a parked spare,
+    ``slow:rank=1:ms=M`` on exactly one process, the fleet policy armed.
+    Returns the POLLEG block from the coordinator — time-to-evict, the
+    ``policy.*`` counters, and bit-identity of the resumed state."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-policy-")
+    port = free_port()
+    slow_ms = int(os.environ.get("BENCH_POLICY_SLOW_MS", "30"))
+    procs = []
+    for i in range(4):
+        standby = i >= 3
+        env = dict(os.environ)
+        env.pop("HOROVOD_TPU_FAULT", None)
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": "3",
+            "HOROVOD_TPU_SIZE": "3",
+            "HOROVOD_TPU_RANK": str(i),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_ELASTIC": "1",
+            "HOROVOD_TPU_EVICT_THRESHOLD": "0.01",
+            "HOROVOD_TPU_EVICT_TICKS": "5",
+            "HOROVOD_TPU_EVICT_MAX": "1",
+            # Floor at the full world: the eviction waits for the spare
+            # to park, making the demotion a world-neutral 3->3 swap.
+            "HOROVOD_TPU_ELASTIC_MIN_RANKS": "3",
+            "BENCH_POLICY_DIR": tmpdir,
+            "BENCH_POLICY_SLOW_MS": str(slow_ms),
+        })
+        if i == 1:
+            # Fault targeting is by CURRENT first rank: only the victim
+            # may carry the spec, or a re-ranked survivor (or the spare
+            # adopting the seat) would inherit the delay.
+            env["HOROVOD_TPU_FAULT"] = f"slow:rank=1:ms={slow_ms}"
+        if standby:
+            env["HOROVOD_TPU_STANDBY"] = "1"
+            env["HOROVOD_TPU_STANDBY_WAIT_S"] = "60"
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--policy-worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append((p.returncode, out))
+    rc1, out1 = outs[1]
+    if rc1 != 3 or "POLABORT" not in out1:
+        raise RuntimeError(
+            f"policy drill: victim exited {rc1}, expected the eviction "
+            f"abort:\n{out1[-2000:]}")
+    rc0, out0 = outs[0]
+    for line in out0.splitlines():
+        if line.startswith("POLLEG "):
+            result = json.loads(line[len("POLLEG "):])
+            if rc0 != 0:
+                result["coordinator_exit"] = rc0
+            result["note"] = (
+                "one of three ranks slowed by slow_ms per tick; the fleet "
+                "policy demoted it after 5 consecutive over-threshold "
+                "gathers and admitted the parked spare in the same planned "
+                "reconfigure; evict_seconds = wall time from the "
+                "coordinator's first training step to its resumed step "
+                "(the straggler delays ticks from init onward, so the "
+                "hysteresis window may already be partly filled)")
+            return result
+    raise RuntimeError(
+        f"policy drill produced no POLLEG line (coordinator exit {rc0}):\n"
+        f"{out0[-2000:]}")
 
 
 def bench_scaling_tcp():
@@ -1518,6 +1686,13 @@ def bench_scaling_tcp():
             recovery = {"error": f"{type(e).__name__}: {e}"}  # the leg
     else:
         recovery = {"skipped": "BENCH_RECOVERY=0"}
+    if os.environ.get("BENCH_POLICY", "1") == "1":
+        try:
+            policy = _policy_drill()
+        except Exception as e:   # noqa: BLE001 — the drill must not sink
+            policy = {"error": f"{type(e).__name__}: {e}"}  # the leg
+    else:
+        policy = {"skipped": "BENCH_POLICY=0"}
     transport = two.get("ring_transport", "tcp")
     eff = round(two["images_per_sec_per_proc"]
                 / one["images_per_sec_per_proc"], 4)
@@ -1562,6 +1737,10 @@ def bench_scaling_tcp():
         # async delta stream) — the trajectory tracks recovery, not just
         # throughput.  BENCH_RECOVERY=0 skips it.
         "recovery": recovery,
+        # Planted-straggler eviction drill: time from the first delayed
+        # tick to the policy's planned demotion + spare admission, with
+        # the policy.* counters.  BENCH_POLICY=0 skips it.
+        "policy": policy,
     }
 
 
@@ -1774,6 +1953,8 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--recovery-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--policy-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.tcp_worker:
@@ -1784,6 +1965,9 @@ def main():
         return
     if args.recovery_worker:
         recovery_worker()
+        return
+    if args.policy_worker:
+        policy_worker()
         return
     if args.n_virtual:
         print(json.dumps(bench_scaling(args.n_virtual)))

@@ -19,6 +19,7 @@
 #include "htpu/scheduler.h"
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
+#include "htpu/policy.h"
 #include "htpu/quantize.h"
 #include "htpu/reduce.h"
 #include "htpu/timeline.h"
@@ -650,6 +651,57 @@ HTPU_API int htpu_sched_all_complete(void* sched) {
 
 HTPU_API void htpu_sched_reset(void* sched) {
   static_cast<htpu::BucketPlanner*>(sched)->Reset();
+}
+
+// ------------------------------------------------------------ fleet policy
+
+// Standalone handle over htpu::FleetPolicy (policy.h) so the Python
+// mirror (horovod_tpu/policy.py) can defer decisions to the native
+// engine and the parity tests can replay identical wait streams through
+// both.  The knobs are read from the environment at create time, same
+// as the coordinator's embedded instance.
+
+HTPU_API void* htpu_policy_create(void) { return new htpu::FleetPolicy(); }
+
+HTPU_API void htpu_policy_destroy(void* policy) {
+  delete static_cast<htpu::FleetPolicy*>(policy);
+}
+
+HTPU_API int htpu_policy_active(void* policy) {
+  return static_cast<htpu::FleetPolicy*>(policy)->active() ? 1 : 0;
+}
+
+HTPU_API void htpu_policy_observe(void* policy, int64_t tick,
+                                  const double* wait_s, int n) {
+  std::vector<double> w(wait_s, wait_s + (n > 0 ? n : 0));
+  static_cast<htpu::FleetPolicy*>(policy)->ObserveTick(uint64_t(tick), w);
+}
+
+HTPU_API int htpu_policy_next_eviction(void* policy, int process_count,
+                                       int seat_available) {
+  return static_cast<htpu::FleetPolicy*>(policy)->NextEviction(
+      process_count, seat_available != 0);
+}
+
+// Writes the reordered process indices over `pidx` in place (n entries).
+HTPU_API void htpu_policy_rerank(void* policy, int* pidx, int n) {
+  std::vector<int> in(pidx, pidx + (n > 0 ? n : 0));
+  std::vector<int> out =
+      static_cast<htpu::FleetPolicy*>(policy)->RerankOrder(in);
+  for (size_t i = 0; i < out.size(); ++i) pidx[i] = out[i];
+}
+
+HTPU_API int htpu_policy_autoscale_target(void* policy, int64_t tick) {
+  return static_cast<htpu::FleetPolicy*>(policy)->AutoscaleTarget(
+      uint64_t(tick));
+}
+
+HTPU_API double htpu_policy_ewma(void* policy, int proc) {
+  return static_cast<htpu::FleetPolicy*>(policy)->ewma(proc);
+}
+
+HTPU_API int htpu_policy_consecutive_slow(void* policy, int proc) {
+  return static_cast<htpu::FleetPolicy*>(policy)->consecutive_slow(proc);
 }
 
 }  // extern "C"

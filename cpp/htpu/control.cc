@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "htpu/flight_recorder.h"
+#include "htpu/policy.h"
 #include "htpu/scheduler.h"
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
@@ -259,6 +260,14 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
     if (cp->failover_listen_fd_ < 0) return nullptr;
   }
   cp->ParseFaultEnv();
+  // Fleet policy (policy.h): the coordinator watches per-rank imposed
+  // wait and drives planned reconfigures (straggler eviction, scripted
+  // autoscale).  Kept only when a policy knob is armed so unconfigured
+  // jobs skip it with one null check per tick.
+  if (cp->elastic_ && process_index == 0) {
+    auto policy = std::make_unique<FleetPolicy>();
+    if (policy->active()) cp->policy_ = std::move(policy);
+  }
   // Flight recorder: rank-tag the process-wide ring and arm the SIGUSR2
   // dump so a wedged tick thread can still be made to leave forensics
   // (the launcher pokes hung ranks before escalating to SIGTERM).
@@ -599,8 +608,11 @@ ControlPlane::~ControlPlane() {
 
 void ControlPlane::ParseFaultEnv() {
   // HOROVOD_TPU_FAULT=mode:rank=R:tick=T[;mode:rank=R:tick=T...] with
-  // mode one of crash/hang/drop_conn/rejoin; R matches a process's FIRST
-  // global rank (at injection time — elastic re-ranking applies).  The
+  // mode one of crash/hang/drop_conn/rejoin/slow; R matches a process's
+  // FIRST global rank (at injection time — elastic re-ranking applies).
+  // `slow` takes ms= instead of a one-shot tick (slow:rank=R:ms=M[:tick=T])
+  // and sleeps M ms on every tick from T on — the deterministic planted
+  // straggler the fleet-policy eviction drills feed on.  The
   // Python side (core.parse_fault_spec) validates strictly and raises on
   // malformed specs; this independent parse is lenient — a spec the
   // strict parser rejected can only get here via raw env tampering, and a
@@ -618,7 +630,7 @@ void ControlPlane::ParseFaultEnv() {
     if (!s.empty()) {
       size_t c = s.find(':');
       std::string mode = s.substr(0, c);
-      long long rank = -1, tick = -1;
+      long long rank = -1, tick = -1, ms = 0;
       while (c != std::string::npos) {
         size_t next = s.find(':', c + 1);
         std::string kv = s.substr(
@@ -626,17 +638,26 @@ void ControlPlane::ParseFaultEnv() {
             next == std::string::npos ? std::string::npos : next - c - 1);
         if (kv.rfind("rank=", 0) == 0) rank = atoll(kv.c_str() + 5);
         else if (kv.rfind("tick=", 0) == 0) tick = atoll(kv.c_str() + 5);
+        else if (kv.rfind("ms=", 0) == 0) ms = atoll(kv.c_str() + 3);
         c = next;
       }
       int m = mode == "crash" ? 1 : mode == "hang" ? 2
-              : mode == "drop_conn" ? 3 : mode == "rejoin" ? 4 : 0;
+              : mode == "drop_conn" ? 3 : mode == "rejoin" ? 4
+              : mode == "slow" ? 5 : 0;
       if (mode == "crash_in_save") {
         // Python-owned fault: the checkpoint writer thread
         // (ckpt_stream.py) fires it mid-commit; not a tick fault and
         // not malformed — nothing for the native plane to arm.
       } else if (m == 4 && rank >= 0 && tick > 0) {
         if (int(rank) == first_rank_) rejoin_tick_ = tick;
-      } else if (m && rank >= 0 && tick > 0) {
+      } else if (m == 5 && rank >= 0 && ms > 0) {
+        FaultSpec fs;
+        fs.mode = m;
+        fs.rank = int(rank);
+        fs.tick = tick;   // optional: -1 = from the first tick
+        fs.ms = ms;
+        faults_.push_back(fs);
+      } else if (m && m != 5 && rank >= 0 && tick > 0) {
         FaultSpec fs;
         fs.mode = m;
         fs.rank = int(rank);
@@ -646,7 +667,7 @@ void ControlPlane::ParseFaultEnv() {
         fprintf(stderr,
                 "htpu control: ignoring malformed HOROVOD_TPU_FAULT "
                 "spec '%s' (want crash|hang|drop_conn|rejoin:rank=R:tick=T"
-                "[;...])\n", s.c_str());
+                " or slow:rank=R:ms=M[:tick=T][;...])\n", s.c_str());
       }
     }
     if (semi == std::string::npos) break;
@@ -656,10 +677,28 @@ void ControlPlane::ParseFaultEnv() {
 
 void ControlPlane::MaybeInjectFault() {
   for (FaultSpec& fs : faults_) {
-    if (!fs.mode || fs.rank != first_rank_ ||
-        tick_count_ != uint64_t(fs.tick)) {
+    if (!fs.mode || fs.rank != first_rank_) continue;
+    if (fs.mode == 5) {
+      // Planted straggler: a deterministic per-tick delay (every tick
+      // from fs.tick on; fs.tick < 0 = always).  Runs before the frame
+      // send, so the request-ready stamp — and therefore the
+      // coordinator's imposed-wait attribution — sees exactly this
+      // lateness.  Never disarms: eviction, not time, ends it.
+      if (fs.tick >= 0 && tick_count_ < uint64_t(fs.tick)) continue;
+      if (!fs.announced) {
+        fs.announced = true;
+        fprintf(stderr,
+                "htpu fault injection: slowing rank %d by %lldms per tick "
+                "from tick %llu\n", first_rank_, fs.ms,
+                (unsigned long long)tick_count_);
+        fflush(stderr);
+        FlightRecorder::Get().Record("fault.slow", "injected per-tick delay",
+                                     fs.ms, first_rank_);
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(fs.ms));
       continue;
     }
+    if (tick_count_ != uint64_t(fs.tick)) continue;
     if (fs.mode == 1) {
       fprintf(stderr, "htpu fault injection: crashing rank %d at tick %llu\n",
               first_rank_, (unsigned long long)tick_count_);
@@ -1247,6 +1286,13 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       return true;
     }
   }
+  if (elastic_ && abort_rank < 0 && !shutdown && policy_ != nullptr &&
+      RunFleetPolicy(response_list_blob)) {
+    // The policy drove a planned reconfigure at this clean tick boundary;
+    // the blob is the RECONFIGURE frame (or the abort from a failed
+    // rebuild) — final either way, exactly like the failure-driven path.
+    return true;
+  }
 
   if (abort_rank >= 0) {
     // Broadcast the ABORT control message (best effort — some links may
@@ -1659,10 +1705,90 @@ void ControlPlane::AcceptStandbys() {
   }
 }
 
+bool ControlPlane::RunFleetPolicy(std::string* response_list_blob) {
+  // Scripted autoscale first: an explicit operator directive outranks the
+  // reactive eviction policy.  The target is a standing state, not an
+  // edge — evaluated every tick until the fleet matches it, so a grow
+  // directive waits as long as it takes standbys to park.
+  int target = policy_->AutoscaleTarget(tick_count_);
+  if (target > initial_process_count_) {
+    target = initial_process_count_;   // membership never grows past launch
+  }
+  if (target > 0 && target != process_count_) {
+    if (target < process_count_) {
+      if (target * ranks_per_process_ >= elastic_min_ranks_) {
+        // Shrink: park the highest process indices (they find themselves
+        // absent from the member table, self-abort, and their supervisor
+        // relaunches them as parked standbys — ready for the next grow).
+        std::vector<int> dead;
+        for (int p = target; p < process_count_; ++p) dead.push_back(p);
+        std::string reason = "autoscale: shrink to " +
+                             std::to_string(target) + " process(es)";
+        Metrics::Get().Counter("policy.rescales")
+            ->fetch_add(1, std::memory_order_relaxed);
+        FlightRecorder::Get().Record("policy.rescale", reason.c_str(),
+                                     target, -1, generation_ + 1);
+        CoordinateReconfigure(dead, -1, reason, response_list_blob, target);
+        return true;
+      }
+      if (autoscale_suppressed_target_ != target) {
+        // Log-and-continue, once per directive: the script asked for
+        // fewer ranks than the quorum floor allows.
+        autoscale_suppressed_target_ = target;
+        fprintf(stderr,
+                "htpu policy: NOT shrinking to %d process(es): %d ranks "
+                "would fall below HOROVOD_TPU_ELASTIC_MIN_RANKS=%d\n",
+                target, target * ranks_per_process_, elastic_min_ranks_);
+      }
+    } else {
+      AcceptStandbys();
+      if (!standby_fds_.empty()) {
+        std::string reason = "autoscale: grow to " + std::to_string(target) +
+                             " process(es)";
+        Metrics::Get().Counter("policy.rescales")
+            ->fetch_add(1, std::memory_order_relaxed);
+        FlightRecorder::Get().Record("policy.rescale", reason.c_str(),
+                                     target, -1, generation_ + 1);
+        CoordinateReconfigure(std::vector<int>(), -1, reason,
+                              response_list_blob, target);
+        return true;
+      }
+      // No standby parked yet: stay armed, retry next tick.
+    }
+  }
+  if (policy_->evict_enabled()) {
+    AcceptStandbys();   // a parked spare makes the eviction world-neutral
+    const bool seat_available =
+        !standby_fds_.empty() ||
+        (process_count_ - 1) * ranks_per_process_ >= elastic_min_ranks_;
+    int victim = policy_->NextEviction(process_count_, seat_available);
+    if (victim > 0 && victim < process_count_) {
+      const int32_t victim_rank = worker_first_rank_[size_t(victim)];
+      const double ewma_s = policy_->ewma(victim);
+      char detail[160];
+      snprintf(detail, sizeof(detail),
+               "straggler rank %d demoted to standby by fleet policy "
+               "(ewma_wait=%.1fms > threshold %.1fms for %d ticks)",
+               victim_rank, ewma_s * 1e3, policy_->threshold_s() * 1e3,
+               policy_->evict_ticks());
+      Metrics::Get().Counter("policy.evictions")
+          ->fetch_add(1, std::memory_order_relaxed);
+      FlightRecorder::Get().Record("policy.evict", detail,
+                                   (long long)(ewma_s * 1e6), victim_rank,
+                                   generation_ + 1);
+      CoordinateReconfigure(std::vector<int>{victim}, victim_rank, detail,
+                            response_list_blob);
+      return true;
+    }
+  }
+  return false;
+}
+
 bool ControlPlane::CoordinateReconfigure(const std::vector<int>& dead_procs,
                                          int32_t lost_rank,
                                          const std::string& reason,
-                                         std::string* response_list_blob) {
+                                         std::string* response_list_blob,
+                                         int admit_cap) {
   const auto t0 = std::chrono::steady_clock::now();
   AcceptStandbys();   // a relaunched child may already be waiting
   std::vector<char> dead(size_t(process_count_), 0);
@@ -1674,30 +1800,68 @@ bool ControlPlane::CoordinateReconfigure(const std::vector<int>& dead_procs,
 
   // Dense re-rank: survivors keep their relative order (the coordinator
   // stays process 0), admitted standbys append, and first ranks follow
-  // the uniform ranks-per-process layout.
+  // the uniform ranks-per-process layout.  With a fleet policy armed the
+  // non-coordinator survivors are reordered fastest-first (slow hosts
+  // cluster ring-adjacent at the tail); the ordering is the identity for
+  // a uniform fleet, so the PR 9 dense re-rank is preserved exactly when
+  // the policy has nothing to say.
   ResponseList out;
   out.has_elastic_ext = true;
   out.generation = generation_ + 1;
   out.reconfigure = true;
   out.lost_rank = lost_rank;
   out.lost_reason = reason;
+  std::vector<int> survivors;
+  for (int p = 1; p < process_count_; ++p) {
+    if (!dead[size_t(p)]) survivors.push_back(p);
+  }
+  if (!dead[0] && policy_ != nullptr && policy_->rerank_enabled()) {
+    std::vector<int> reordered = policy_->RerankOrder(survivors);
+    if (reordered != survivors) {
+      std::string order;
+      for (int p : reordered) {
+        if (!order.empty()) order += ",";
+        order += std::to_string(p);
+      }
+      FlightRecorder::Get().Record("policy.rerank", order.c_str(),
+                                   int64_t(reordered.size()), -1,
+                                   generation_ + 1);
+    }
+    survivors = std::move(reordered);
+  }
   std::vector<int> new_fds, new_first;
-  for (int p = 0; p < process_count_; ++p) {
-    if (dead[size_t(p)]) continue;
+  // old process index -> new (or -1: evicted/parked); feeds the policy's
+  // per-process EWMA remap so attribution survives the re-rank.
+  std::vector<int> old_to_new(size_t(process_count_), -1);
+  if (!dead[0]) {
+    ElasticMember m;
+    m.old_pidx = 0;
+    m.new_pidx = 0;
+    m.first_rank = 0;
+    out.members.push_back(m);
+    old_to_new[0] = 0;
+    new_fds.push_back(-1);
+    new_first.push_back(0);
+  }
+  for (int p : survivors) {
     ElasticMember m;
     m.old_pidx = p;
     m.new_pidx = int32_t(new_fds.size());
     m.first_rank = m.new_pidx * ranks_per_process_;
     out.members.push_back(m);
-    new_fds.push_back(p == 0 ? -1 : worker_fds_[size_t(p)]);
+    old_to_new[size_t(p)] = m.new_pidx;
+    new_fds.push_back(worker_fds_[size_t(p)]);
     new_first.push_back(m.first_rank);
   }
+  const int seat_cap =
+      admit_cap > 0 ? std::min(admit_cap, initial_process_count_)
+                    : initial_process_count_;
   std::vector<std::pair<int, int32_t>> parked;
   parked.swap(standby_fds_);
   std::vector<int> admitted_fds;
   for (auto& sb : parked) {
-    if (int(new_fds.size()) >= initial_process_count_) {
-      standby_fds_.push_back(sb);   // over launch size: stays parked
+    if (int(new_fds.size()) >= seat_cap) {
+      standby_fds_.push_back(sb);   // over the seat cap: stays parked
       continue;
     }
     ElasticMember m;
@@ -1737,6 +1901,9 @@ bool ControlPlane::CoordinateReconfigure(const std::vector<int>& dead_procs,
   worker_fds_ = std::move(new_fds);
   worker_first_rank_ = std::move(new_first);
   FlushMembershipState();
+  // Carry EWMA attribution across the re-rank (admitted standbys start
+  // with no history); the flushed per-rank series restart in parallel.
+  if (policy_ != nullptr) policy_->OnReconfigure(old_to_new, new_count);
   table_.reset(new MessageTable(new_count * ranks_per_process_));
   cache_.reset(new ResponseCache(cache_capacity_, new_count));
   FlightRecorder::Get().Record("elastic.reconfigure", reason.c_str(),
@@ -2171,6 +2338,14 @@ void ControlPlane::FlushMembershipState() {
   clock_sync_.clear();
   skew_names_.clear();
   offset_names_.clear();
+  // Retire the per-rank metric series alongside the name caches: the
+  // rank labels just changed meaning, so letting the old histograms and
+  // gauges keep accumulating would charge the pre-reconfigure host's
+  // skew to whichever process now holds its rank number.  The series
+  // restart (empty) under the new membership on the next gather.
+  Metrics::Get().RemoveMatching("control.gather_skew_seconds#rank=");
+  Metrics::Get().RemoveMatching("control.clock_offset_us#rank=");
+  Metrics::Get().RemoveMatching("policy.ewma_wait_s#rank=");
   last_resp_recv_us_ = 0;
   last_bcast_us_ = 0;
   // The replicated coordinator digest was keyed by the old membership;
@@ -2262,12 +2437,26 @@ void ControlPlane::ObserveGatherSkew(
                             std::to_string(rank));
     }
   }
+  std::vector<double> wait_s(arrival_us.size(), -1.0);
   for (size_t p = 0; p < arrival_us.size(); ++p) {
     if (!have_arrival[p]) continue;
     // Lateness vs the median request-ready time; early ranks clamp to 0
     // so the histogram reads directly as "imposed wait".
     double skew_s = (double(arrival_us[p]) - median) / 1e6;
-    Metrics::Get().Observe(skew_names_[p], skew_s < 0 ? 0.0 : skew_s);
+    wait_s[p] = skew_s < 0 ? 0.0 : skew_s;
+    Metrics::Get().Observe(skew_names_[p], wait_s[p]);
+  }
+  if (policy_ != nullptr) {
+    // Same per-tick imposed-wait samples feed the fleet policy's EWMAs;
+    // the smoothed view is published per rank for offline tuning.
+    policy_->ObserveTick(tick_count_, wait_s);
+    for (size_t p = 0; p < wait_s.size(); ++p) {
+      double ew = policy_->ewma(int(p));
+      if (ew < 0) continue;
+      int rank = p < all_first_ranks_.size() ? all_first_ranks_[p] : int(p);
+      Metrics::Get().SetGauge(
+          "policy.ewma_wait_s#rank=" + std::to_string(rank), ew);
+    }
   }
 }
 

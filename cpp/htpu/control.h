@@ -38,6 +38,7 @@
 
 namespace htpu {
 
+class FleetPolicy;
 class Timeline;
 
 class ControlPlane {
@@ -174,9 +175,16 @@ class ControlPlane {
   // On success *response_list_blob is the RECONFIGURE frame (returned to
   // this process's own Python controller).  False => fell back to abort
   // (blob is the abort frame).
+  // admit_cap bounds the total post-admission process count (scripted
+  // autoscale grows to an exact target); -1 = the launch size.
   bool CoordinateReconfigure(const std::vector<int>& dead_procs,
                              int32_t lost_rank, const std::string& reason,
-                             std::string* response_list_blob);
+                             std::string* response_list_blob,
+                             int admit_cap = -1);
+  // Coordinator: evaluate the fleet policy (straggler eviction, scripted
+  // autoscale) after a clean gather.  True => it drove a reconfigure and
+  // *response_list_blob is final for this tick.
+  bool RunFleetPolicy(std::string* response_list_blob);
   // Worker: apply a received RECONFIGURE frame — adopt the new identity
   // from the membership table (or self-abort if evicted), flush caches,
   // and rebuild the data plane.  Mirrors the tail of CoordinateReconfigure.
@@ -309,13 +317,17 @@ class ControlPlane {
 
   // Fault injection (HOROVOD_TPU_FAULT=mode:rank=R:tick=T[;...], matched
   // against first_rank_): 1 = crash, 2 = hang, 3 = drop_conn, 4 = rejoin
-  // (coordinator-side: admit parked standbys at tick >= T).  Multiple
-  // semicolon-separated specs are allowed so elastic scenarios can script
-  // a kill and a later readmit in one env var.
+  // (coordinator-side: admit parked standbys at tick >= T), 5 = slow
+  // (slow:rank=R:ms=M[:tick=T] — sleep M ms on EVERY tick from T on, the
+  // deterministic planted straggler the fleet-policy drills evict).
+  // Multiple semicolon-separated specs are allowed so elastic scenarios
+  // can script a kill and a later readmit in one env var.
   struct FaultSpec {
     int mode = 0;
     int rank = -1;
     long long tick = -1;
+    long long ms = 0;    // slow only: injected per-tick delay
+    bool announced = false;   // slow only: stderr/flight once, first fire
   };
   std::vector<FaultSpec> faults_;
   // Armed rejoin action (mode 4): fires on the coordinator once per arm,
@@ -442,6 +454,12 @@ class ControlPlane {
   // This process joined as a standby (HOROVOD_TPU_STANDBY=1) and parks in
   // Create until a RECONFIGURE frame admits it.
   bool is_standby_ = false;
+  // Coordinator-side fleet policy (policy.h): straggler eviction, ring
+  // re-ranking and scripted autoscaling.  Created at bootstrap only when
+  // a policy knob is armed — null means every tick skips it for free.
+  std::unique_ptr<FleetPolicy> policy_;
+  // Last autoscale target refused for quorum (logged once per directive).
+  int autoscale_suppressed_target_ = -1;
 
   // ---- coordinator failover (elastic only) ----
   // Every process opens this listener at bootstrap and advertises its port

@@ -176,6 +176,21 @@ void Metrics::Reset() {
   }
 }
 
+int Metrics::RemoveMatching(const std::string& prefix) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int removed = 0;
+  auto erase_prefixed = [&](auto& map) {
+    for (auto it = map.lower_bound(prefix); it != map.end();) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      it = map.erase(it);
+      ++removed;
+    }
+  };
+  erase_prefixed(gauges_);
+  erase_prefixed(histograms_);
+  return removed;
+}
+
 ScopedTimer::ScopedTimer(const char* name)
     : name_(name), start_(NowSeconds()) {}
 

@@ -63,6 +63,15 @@ class Metrics {
   // pointers stay valid).
   void Reset();
 
+  // Erase every gauge and histogram whose name starts with `prefix`;
+  // returns the number removed.  Counters are deliberately exempt: the
+  // Counter() pointer-stability contract above says the counter map
+  // only grows.  Gauges and histograms are looked up by name on every
+  // SetGauge/Observe call, so erasing them is safe — this is how
+  // FlushMembershipState retires per-rank series whose rank labels just
+  // changed meaning under an elastic re-rank.
+  int RemoveMatching(const std::string& prefix);
+
  private:
   Metrics() = default;
 

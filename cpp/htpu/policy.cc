@@ -1,0 +1,227 @@
+#include "htpu/policy.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+
+#include "htpu/metrics.h"
+
+namespace htpu {
+
+FleetPolicy::FleetPolicy() {
+  // Lenient like every other native knob parse: a malformed value keeps
+  // the default instead of aborting (the strict Python-side validation
+  // in horovod_tpu/policy.py already rejected typos at launch).
+  double threshold_s = 0.0;
+  if (const char* e = getenv("HOROVOD_TPU_EVICT_THRESHOLD")) {
+    char* end = nullptr;
+    double v = strtod(e, &end);
+    if (end && *end == '\0' && v >= 0) threshold_s = v;
+  }
+  threshold_s_ = threshold_s;
+  int evict_ticks = 5;
+  if (const char* e = getenv("HOROVOD_TPU_EVICT_TICKS")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) evict_ticks = int(v);
+  }
+  evict_ticks_ = evict_ticks;
+  int evict_max = 1;
+  if (const char* e = getenv("HOROVOD_TPU_EVICT_MAX")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v >= 0) evict_max = int(v);
+  }
+  evict_max_ = evict_max;
+  // HOROVOD_TPU_POLICY_RERANK=0 keeps the PR 9 survivor order even with
+  // a policy armed.
+  const char* rr = getenv("HOROVOD_TPU_POLICY_RERANK");
+  rerank_ = !(rr && std::string(rr) == "0");
+  if (const char* e = getenv("HOROVOD_TPU_AUTOSCALE")) {
+    if (*e && !ParseAutoscaleScript(e, &schedule_)) {
+      fprintf(stderr,
+              "htpu policy: ignoring malformed HOROVOD_TPU_AUTOSCALE "
+              "'%s' (want tick:<T>=<procs>[,tick:<T>=<procs>...])\n", e);
+      schedule_.clear();
+    }
+  }
+  if (const char* e = getenv("HOROVOD_TPU_AUTOSCALE_FILE")) {
+    autoscale_file_ = e;
+  }
+}
+
+bool FleetPolicy::ParseAutoscaleScript(
+    const std::string& script,
+    std::vector<std::pair<uint64_t, int>>* out) {
+  out->clear();
+  size_t start = 0;
+  while (start <= script.size()) {
+    size_t comma = script.find(',', start);
+    std::string entry = script.substr(
+        start,
+        comma == std::string::npos ? std::string::npos : comma - start);
+    if (!entry.empty()) {
+      if (entry.rfind("tick:", 0) != 0) return false;
+      size_t eq = entry.find('=');
+      if (eq == std::string::npos) return false;
+      char* end = nullptr;
+      long long tick = strtoll(entry.c_str() + 5, &end, 10);
+      if (!end || *end != '=' || tick <= 0) return false;
+      long long target = strtoll(entry.c_str() + eq + 1, &end, 10);
+      if (!end || *end != '\0' || target <= 0) return false;
+      out->emplace_back(uint64_t(tick), int(target));
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  std::stable_sort(out->begin(), out->end(),
+                   [](const std::pair<uint64_t, int>& a,
+                      const std::pair<uint64_t, int>& b) {
+                     return a.first < b.first;
+                   });
+  return true;
+}
+
+void FleetPolicy::ObserveTick(uint64_t /*tick*/,
+                              const std::vector<double>& wait_s) {
+  if (procs_.size() < wait_s.size()) procs_.resize(wait_s.size());
+  for (size_t p = 0; p < wait_s.size(); ++p) {
+    if (wait_s[p] < 0) continue;   // no sample this gather
+    ProcState& ps = procs_[p];
+    ps.ewma = ps.valid ? alpha_ * wait_s[p] + (1.0 - alpha_) * ps.ewma
+                       : wait_s[p];
+    ps.valid = true;
+  }
+  if (!evict_enabled()) return;
+  // A process is "slow" only RELATIVE to the fleet: its EWMA must sit
+  // threshold_s_ above the median EWMA.  The imposed-wait inputs are
+  // already median-relative per tick, but re-anchoring on the smoothed
+  // values too means a fleet-wide slowdown (every EWMA elevated alike)
+  // never nominates anyone — skew is a property of one host, load is a
+  // property of the job.
+  std::vector<double> ew;
+  for (const ProcState& ps : procs_) {
+    if (ps.valid) ew.push_back(ps.ewma);
+  }
+  if (ew.size() < 2) return;
+  std::nth_element(ew.begin(), ew.begin() + long(ew.size() / 2), ew.end());
+  double median = ew[ew.size() / 2];
+  if (ew.size() % 2 == 0) {
+    double lower = *std::max_element(ew.begin(),
+                                     ew.begin() + long(ew.size() / 2));
+    median = (median + lower) / 2.0;
+  }
+  for (ProcState& ps : procs_) {
+    if (!ps.valid) continue;
+    if (ps.ewma - median > threshold_s_) {
+      ++ps.consecutive;
+    } else {
+      // Hysteresis: one healthy gather resets the whole window — a rank
+      // must be slow for evict_ticks_ CONSECUTIVE gathers to be evicted.
+      ps.consecutive = 0;
+      ps.suppress_logged = false;
+    }
+  }
+}
+
+int FleetPolicy::NextEviction(int process_count, bool seat_available) {
+  if (!evict_enabled()) return -1;
+  int candidate = -1;
+  double worst = 0.0;
+  // Process 0 IS the coordinator — never a candidate (failover, not
+  // eviction, handles a slow coordinator).
+  for (int p = 1; p < process_count && size_t(p) < procs_.size(); ++p) {
+    const ProcState& ps = procs_[size_t(p)];
+    if (!ps.valid || ps.consecutive < evict_ticks_) continue;
+    if (candidate < 0 || ps.ewma > worst) {
+      candidate = p;
+      worst = ps.ewma;
+    }
+  }
+  if (candidate < 0) return -1;
+  const char* why = nullptr;
+  if (evictions_ >= evict_max_) {
+    why = "eviction budget HOROVOD_TPU_EVICT_MAX exhausted";
+  } else if (!seat_available) {
+    why = "no parked standby and shrinking would fall below the rank floor";
+  }
+  if (why != nullptr) {
+    // Log-and-continue: the counter ticks every suppressed opportunity
+    // (tunable offline from snapshots); the stderr line fires once per
+    // slow episode so a chronically slow fleet doesn't flood the log.
+    Metrics::Get().Counter("policy.evictions_suppressed")
+        ->fetch_add(1, std::memory_order_relaxed);
+    ProcState& ps = procs_[size_t(candidate)];
+    if (!ps.suppress_logged) {
+      ps.suppress_logged = true;
+      fprintf(stderr,
+              "htpu policy: NOT evicting straggler process %d "
+              "(ewma_wait=%.1fms > threshold for %d ticks): %s\n",
+              candidate, ps.ewma * 1e3, ps.consecutive, why);
+    }
+    return -1;
+  }
+  ++evictions_;
+  return candidate;
+}
+
+std::vector<int> FleetPolicy::RerankOrder(
+    const std::vector<int>& old_pidx) const {
+  std::vector<int> order = old_pidx;
+  if (!rerank_enabled()) return order;
+  // Bucket to whole milliseconds so sub-noise EWMA differences cannot
+  // perturb a uniform fleet; the stable sort keeps the PR 9 dense order
+  // within a bucket, so "no straggler" reduces to the identity.
+  std::stable_sort(order.begin(), order.end(), [this](int a, int b) {
+    auto bucket = [this](int p) {
+      return size_t(p) < procs_.size() && procs_[size_t(p)].valid
+                 ? (long long)(procs_[size_t(p)].ewma * 1e3)
+                 : 0LL;
+    };
+    return bucket(a) < bucket(b);
+  });
+  return order;
+}
+
+int FleetPolicy::AutoscaleTarget(uint64_t tick) {
+  int target = -1;
+  for (const auto& entry : schedule_) {
+    if (entry.first <= tick) target = entry.second;
+  }
+  if (!autoscale_file_.empty()) {
+    // File-signal seam: an external autoscaler (queue-depth watcher,
+    // preemption notice) writes a bare process count; the file's word
+    // overrides the script from the moment it parses.
+    std::ifstream f(autoscale_file_);
+    long long v = 0;
+    if (f && (f >> v) && v > 0) target = int(v);
+  }
+  return target;
+}
+
+void FleetPolicy::OnReconfigure(const std::vector<int>& old_to_new,
+                                int new_count) {
+  std::vector<ProcState> next(static_cast<size_t>(new_count));
+  for (size_t p = 0; p < old_to_new.size() && p < procs_.size(); ++p) {
+    int np = old_to_new[p];
+    if (np >= 0 && np < new_count) next[size_t(np)] = procs_[p];
+  }
+  procs_ = std::move(next);
+}
+
+double FleetPolicy::ewma(int proc) const {
+  return proc >= 0 && size_t(proc) < procs_.size() &&
+                 procs_[size_t(proc)].valid
+             ? procs_[size_t(proc)].ewma
+             : -1.0;
+}
+
+int FleetPolicy::consecutive_slow(int proc) const {
+  return proc >= 0 && size_t(proc) < procs_.size()
+             ? procs_[size_t(proc)].consecutive
+             : 0;
+}
+
+}  // namespace htpu

@@ -1,0 +1,130 @@
+// Coordinator-side fleet policy: the decision half of elasticity.
+//
+// PR 9/10 gave the control plane the *mechanism* to change membership
+// (CoordinateReconfigure: dense re-rank, standby admission, generation
+// stamping) and PR 7 gave it the *signal* (per-rank gather-skew
+// attribution: how long the fleet waited on each process).  This class
+// closes the loop: it watches the per-tick imposed-wait stream and
+// decides when to act —
+//
+//   * straggler eviction: a process whose EWMA imposed-wait stays more
+//     than HOROVOD_TPU_EVICT_THRESHOLD seconds above the fleet median
+//     for HOROVOD_TPU_EVICT_TICKS consecutive gathers is demoted via a
+//     planned reconfigure.  A HOROVOD_TPU_EVICT_MAX budget bounds total
+//     evictions so a systemic slowdown can never evict the fleet into
+//     quorum loss (suppressed decisions are counted, not acted on).
+//   * ring re-ranking: on any reconfigure, survivors are ordered by
+//     their EWMA so slow hosts end up ring-adjacent (the skew is paid
+//     on the fewest cross-host hops).  Equal-speed fleets keep the
+//     identity order, preserving the PR 9 dense re-rank exactly.
+//   * scripted autoscaling: HOROVOD_TPU_AUTOSCALE="tick:N=S,..." (or a
+//     target count polled from HOROVOD_TPU_AUTOSCALE_FILE) names the
+//     desired process count per tick window; the coordinator grows by
+//     admitting parked standbys and shrinks by parking the highest
+//     process indices.
+//
+// The class itself is pure decision state — it owns no sockets and
+// performs no reconfiguration; ControlPlane::Tick feeds it one
+// imposed-wait vector per gather and acts on what it returns.  All
+// methods are called from the coordinator's tick thread only.
+#ifndef HTPU_POLICY_H_
+#define HTPU_POLICY_H_
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace htpu {
+
+class FleetPolicy {
+ public:
+  // Reads every HOROVOD_TPU_EVICT_* / AUTOSCALE / POLICY_RERANK knob
+  // from the environment (docs/running.md).  Malformed values fall back
+  // to the defaults — policy is an optimisation layer and must never
+  // take down a healthy job.
+  FleetPolicy();
+
+  // Any policy armed?  ControlPlane only keeps an instance when true,
+  // so an unconfigured job pays nothing.
+  bool active() const { return evict_enabled() || autoscale_enabled(); }
+  bool evict_enabled() const { return threshold_s_ > 0; }
+  bool autoscale_enabled() const {
+    return !schedule_.empty() || !autoscale_file_.empty();
+  }
+  // Re-ranking follows the armed policies (HOROVOD_TPU_POLICY_RERANK=0
+  // opts out); an inactive policy never reorders, so non-policy elastic
+  // jobs keep the PR 9 survivor order bit-for-bit.
+  bool rerank_enabled() const { return rerank_ && active(); }
+
+  // One gather's attribution: wait_s[p] is process p's imposed wait in
+  // seconds (lateness past the fleet median, clamped at 0 — exactly the
+  // control.gather_skew_seconds sample), or < 0 when p had no sample
+  // this tick.  Updates EWMAs and the consecutive-slow counters.
+  void ObserveTick(uint64_t tick, const std::vector<double>& wait_s);
+
+  // Eviction decision for this tick: the process index to demote, or -1.
+  // `seat_available` says the eviction can proceed without quorum risk
+  // (a spare is parked, or shrinking stays above the rank floor); a
+  // candidate without a seat — or past the eviction budget — is
+  // suppressed: counted, logged once, never acted on.
+  int NextEviction(int process_count, bool seat_available);
+
+  // Survivor ordering for CoordinateReconfigure: `old_pidx` lists the
+  // surviving non-coordinator process indices in their PR 9 dense order;
+  // the result is the same set ordered fastest-first (slow hosts cluster
+  // ring-adjacent at the tail).  EWMAs are bucketed to whole
+  // milliseconds first so measurement noise cannot reorder a uniform
+  // fleet: the sort is stable and equal buckets keep the input order.
+  std::vector<int> RerankOrder(const std::vector<int>& old_pidx) const;
+
+  // Scripted/file-signal target process count at `tick`, or -1 when no
+  // directive applies yet.  Idempotent: the caller compares against the
+  // live process count and retries until the fleet matches (grow waits
+  // for standbys to park), so a directive is a standing target, not an
+  // edge trigger.
+  int AutoscaleTarget(uint64_t tick);
+
+  // A reconfigure happened: remap per-process EWMA state through
+  // old_to_new (old process index -> new, or -1 when evicted/parked).
+  // Newly admitted processes start with no history.
+  void OnReconfigure(const std::vector<int>& old_to_new, int new_count);
+
+  // Introspection (metrics, logging, the C API mirror).
+  double ewma(int proc) const;
+  int consecutive_slow(int proc) const;
+  double threshold_s() const { return threshold_s_; }
+  int evict_ticks() const { return evict_ticks_; }
+  int evict_max() const { return evict_max_; }
+  int evictions() const { return evictions_; }
+
+  // "tick:N=S,tick:M=S2" -> sorted [(N, S), (M, S2)]; false on any
+  // malformed entry (the strict Python parser in horovod_tpu/policy.py
+  // rejects these at launch; this lenient half only sees raw env
+  // tampering and must not abort).
+  static bool ParseAutoscaleScript(
+      const std::string& script,
+      std::vector<std::pair<uint64_t, int>>* out);
+
+ private:
+  struct ProcState {
+    double ewma = 0.0;
+    bool valid = false;
+    int consecutive = 0;   // ticks spent above median + threshold
+    bool suppress_logged = false;
+  };
+
+  double threshold_s_ = 0.0;   // HOROVOD_TPU_EVICT_THRESHOLD (0 = off)
+  int evict_ticks_ = 5;        // HOROVOD_TPU_EVICT_TICKS
+  int evict_max_ = 1;          // HOROVOD_TPU_EVICT_MAX
+  bool rerank_ = true;         // HOROVOD_TPU_POLICY_RERANK
+  double alpha_ = 0.2;         // EWMA smoothing factor (fixed)
+  std::vector<std::pair<uint64_t, int>> schedule_;   // sorted by tick
+  std::string autoscale_file_;   // HOROVOD_TPU_AUTOSCALE_FILE
+  std::vector<ProcState> procs_;
+  int evictions_ = 0;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_POLICY_H_

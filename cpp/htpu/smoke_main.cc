@@ -30,6 +30,8 @@
 
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
+#include "htpu/metrics.h"
+#include "htpu/policy.h"
 #include "htpu/scheduler.h"
 #include "htpu/wire.h"
 
@@ -632,10 +634,116 @@ int RunOverlapPlannerPhase() {
   return 0;
 }
 
+// Fleet-policy phase: the straggler/autoscale decision engine under the
+// sanitizers in its live shape — the tick thread feeding ObserveTick and
+// taking eviction/rerank/autoscale decisions while a reader thread
+// concurrently snapshots the metrics registry and retires the per-rank
+// policy gauges (Metrics::RemoveMatching), the exact concurrency
+// FlushMembershipState and the metrics exporters run against live ticks.
+int RunFleetPolicyPhase() {
+  setenv("HOROVOD_TPU_EVICT_THRESHOLD", "0.010", 1);
+  setenv("HOROVOD_TPU_EVICT_TICKS", "4", 1);
+  setenv("HOROVOD_TPU_EVICT_MAX", "1", 1);
+  setenv("HOROVOD_TPU_AUTOSCALE", "tick:50=2,tick:120=3", 1);
+  int rc = 1;
+  do {
+    std::vector<std::pair<uint64_t, int>> sched;
+    if (htpu::FleetPolicy::ParseAutoscaleScript("tick:nope", &sched)) {
+      fprintf(stderr, "smoke: malformed autoscale script accepted\n");
+      break;
+    }
+    htpu::FleetPolicy policy;
+    if (!policy.active() || !policy.evict_enabled() ||
+        !policy.autoscale_enabled() || !policy.rerank_enabled()) {
+      fprintf(stderr, "smoke: policy knobs did not arm the engine\n");
+      break;
+    }
+    std::atomic<bool> done{false};
+    std::thread reader([&] {
+      while (!done.load()) {
+        void* buf = nullptr;
+        int len = htpu_metrics_snapshot(&buf);
+        if (len > 0 && buf != nullptr) htpu_free(buf);
+        htpu::Metrics::Get().RemoveMatching("policy.ewma_wait_s#rank=");
+        std::this_thread::yield();
+      }
+    });
+    int evicted = -1;
+    bool suppressed_seen = false;
+    bool bad = false;
+    for (uint64_t tick = 1; tick <= 200 && !bad; ++tick) {
+      // Process 2 is the planted straggler: 30ms of imposed wait against
+      // a 10ms threshold over the fleet median.
+      std::vector<double> wait_s = {0.0, 0.001, 0.030};
+      policy.ObserveTick(tick, wait_s);
+      for (size_t p = 0; p < wait_s.size(); ++p) {
+        double ew = policy.ewma(int(p));
+        if (ew >= 0) {
+          htpu::Metrics::Get().SetGauge(
+              "policy.ewma_wait_s#rank=" + std::to_string(p), ew);
+        }
+      }
+      int victim = policy.NextEviction(3, /*seat_available=*/true);
+      if (victim >= 0) {
+        if (evicted >= 0 || victim != 2) {
+          fprintf(stderr,
+                  "smoke: policy evicted proc %d (wanted one eviction of "
+                  "proc 2)\n", victim);
+          bad = true;
+        }
+        evicted = victim;
+      } else if (evicted >= 0 && policy.consecutive_slow(2) >= 4) {
+        suppressed_seen = true;   // budget of 1 suppresses the repeats
+      }
+    }
+    done.store(true);
+    reader.join();
+    if (bad) break;
+    if (evicted != 2 || !suppressed_seen) {
+      fprintf(stderr, "smoke: policy eviction/suppression missing "
+              "(evicted=%d suppressed=%d)\n", evicted, int(suppressed_seen));
+      break;
+    }
+    if (policy.AutoscaleTarget(10) != -1 || policy.AutoscaleTarget(60) != 2 ||
+        policy.AutoscaleTarget(150) != 3) {
+      fprintf(stderr, "smoke: autoscale schedule misresolved\n");
+      break;
+    }
+    std::vector<int> order = policy.RerankOrder({2, 1});
+    if (order.size() != 2 || order[0] != 1 || order[1] != 2) {
+      fprintf(stderr, "smoke: rerank did not sort the straggler last\n");
+      break;
+    }
+    // Reconfigure remap: proc 2 evicted, survivors densify to {0,1}.
+    policy.OnReconfigure({0, 1, -1}, 2);
+    if (policy.ewma(2) != -1.0 || policy.ewma(1) < 0) {
+      fprintf(stderr, "smoke: policy state remap lost a survivor\n");
+      break;
+    }
+    // RemoveMatching retires gauges but never counters.
+    htpu::Metrics::Get().SetGauge("policy.ewma_wait_s#rank=0", 1.0);
+    if (htpu::Metrics::Get().RemoveMatching("policy.ewma_wait_s#rank=") < 1 ||
+        htpu::Metrics::Get().RemoveMatching("policy.evictions_suppressed")
+            != 0) {
+      fprintf(stderr, "smoke: RemoveMatching gauge/counter contract broken\n");
+      break;
+    }
+    fprintf(stderr, "smoke: fleet policy OK (evicted proc %d, budget held)\n",
+            evicted);
+    rc = 0;
+  } while (false);
+  unsetenv("HOROVOD_TPU_EVICT_THRESHOLD");
+  unsetenv("HOROVOD_TPU_EVICT_TICKS");
+  unsetenv("HOROVOD_TPU_EVICT_MAX");
+  unsetenv("HOROVOD_TPU_AUTOSCALE");
+  return rc;
+}
+
 }  // namespace
 
 int main() {
   if (RunOverlapPlannerPhase() != 0) return 1;
+  if (RunFleetPolicyPhase() != 0) return 1;
   int port = FreePort();
   if (port < 0) {
     fprintf(stderr, "smoke: no free port\n");

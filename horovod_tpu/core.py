@@ -107,18 +107,26 @@ SHUT_DOWN_ERROR = Status.aborted(
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """Parsed HOROVOD_TPU_FAULT=<mode>:rank=<R>:tick=<T> spec (or
-    ``crash_in_save:rank=<R>:epoch=<E>``, the checkpoint-writer fault).
+    ``crash_in_save:rank=<R>:epoch=<E>``, the checkpoint-writer fault,
+    or ``slow:rank=<R>:ms=<M>[:tick=<T>]``, the planted straggler).
 
     The native core parses the same env var itself (control.cc) and fires
     the tick-based faults on the tick thread; ``crash_in_save`` is
     Python-owned (ckpt_stream.py fires it mid-commit) and the native
-    parser skips it.  This Python-side parse exists to reject malformed
-    specs loudly at init() instead of silently never firing.
+    parser skips it.  ``slow`` fires in whichever controller runs the
+    tick — the native plane in multi-process jobs, the local Python loop
+    otherwise — delaying the target's tick by M ms from tick T onward
+    (every tick when tick= is omitted).  This Python-side parse exists to
+    reject malformed specs loudly at init() instead of silently never
+    firing.
     """
-    mode: str      # "crash" | "hang" | "drop_conn" | "rejoin" | "crash_in_save"
+    mode: str      # "crash" | "hang" | "drop_conn" | "rejoin"
+                   # | "crash_in_save" | "slow"
     rank: int      # first global rank of the target process
     tick: int      # 1-based negotiation tick on which the fault fires;
-                   # for crash_in_save, the 0-based snapshot epoch
+                   # for crash_in_save, the 0-based snapshot epoch; for
+                   # slow, the first delayed tick (-1 = from the start)
+    ms: int = 0    # slow only: per-tick delay in milliseconds
 
     @property
     def epoch(self) -> int:
@@ -127,7 +135,8 @@ class FaultSpec:
         return self.tick
 
 
-_FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin", "crash_in_save")
+_FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin", "crash_in_save",
+                "slow")
 
 
 def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
@@ -141,11 +150,48 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
     if not spec:
         return None
     parts = spec.split(":")
+    if parts[0] == "slow":
+        # slow:rank=<R>:ms=<M>[:tick=<T>] — a planted straggler: delay
+        # the target process's tick by M milliseconds, from tick T
+        # onward (every tick when tick= is omitted).
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+                "'slow:rank=<R>:ms=<M>[:tick=<T>]'.")
+        kv = {}
+        for part in parts[1:]:
+            key, sep, val = part.partition("=")
+            if not sep or key not in ("rank", "ms", "tick") or key in kv:
+                raise ValueError(
+                    f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+                    "'slow:rank=<R>:ms=<M>[:tick=<T>]'.")
+            try:
+                kv[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"Malformed HOROVOD_TPU_FAULT {spec!r}: {key!r} must "
+                    f"be an integer, got {val!r}.") from None
+        if "rank" not in kv or "ms" not in kv:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: both rank= and "
+                "ms= are required.")
+        if kv["rank"] < 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: rank must be >= 0.")
+        if kv["ms"] <= 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: ms must be >= 1.")
+        if "tick" in kv and kv["tick"] <= 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
+                "(ticks are counted from 1).")
+        return FaultSpec("slow", kv["rank"], kv.get("tick", -1), kv["ms"])
     if len(parts) != 3 or parts[0] not in _FAULT_MODES:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
-            "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>' or "
-            "'crash_in_save:rank=<R>:epoch=<E>'.")
+            "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>', "
+            "'crash_in_save:rank=<R>:epoch=<E>' or "
+            "'slow:rank=<R>:ms=<M>[:tick=<T>]'.")
     when_key = "epoch" if parts[0] == "crash_in_save" else "tick"
     kv = {}
     for part in parts[1:]:
@@ -940,8 +986,12 @@ class Controller:
 
         # Fail fast on malformed fault specs: the native core parses the
         # same variable leniently (warn + ignore), which would make a typo'd
-        # injection test silently pass.
-        parse_fault_specs(os.environ.get("HOROVOD_TPU_FAULT", ""))
+        # injection test silently pass.  The parsed specs are kept for the
+        # Python-owned injections (the local loop's `slow` straggler).
+        self._fault_specs = parse_fault_specs(
+            os.environ.get("HOROVOD_TPU_FAULT", ""))
+        self._fault_tick = 0
+        self._slow_announced: set = set()
 
         # Native core (cpp/htpu): message table, fusion planner and timeline
         # run in C++ when the shared library is available; the Python classes
@@ -1556,7 +1606,28 @@ class Controller:
         self._last_stall_check = now
         self._warn_stalled(self._control.stalled(self.stall_warning_time_s))
 
+    def _maybe_inject_slow_fault(self):
+        """Python-controller half of the ``slow`` fault: a deterministic
+        per-tick delay in the local negotiation loop.  Multi-process
+        ticks delegate to the native plane, which injects the same delay
+        there (control.cc MaybeInjectFault) — never both, so the stall
+        lands exactly once per tick."""
+        self._fault_tick += 1
+        for i, fs in enumerate(self._fault_specs):
+            if fs.mode != "slow" or not 0 <= fs.rank < self.size:
+                continue
+            if fs.tick >= 0 and self._fault_tick < fs.tick:
+                continue
+            if i not in self._slow_announced:
+                self._slow_announced.add(i)
+                print(f"horovod_tpu fault injection: slowing rank "
+                      f"{fs.rank} by {fs.ms}ms per tick from tick "
+                      f"{self._fault_tick}", file=sys.stderr)
+            time.sleep(fs.ms / 1e3)
+
     def _run_loop_once(self):
+        if self._fault_specs:
+            self._maybe_inject_slow_fault()
         with self._lock:
             pending = list(self._message_queue)
             self._message_queue.clear()

@@ -229,6 +229,33 @@ def _configure(lib) -> None:
         lib.htpu_sched_all_complete.argtypes = [ctypes.c_void_p]
         lib.htpu_sched_reset.restype = None
         lib.htpu_sched_reset.argtypes = [ctypes.c_void_p]
+    # Fleet-policy API (guarded like the scheduler: a prebuilt .so from
+    # before the policy engine still loads for the rest of the surface).
+    if hasattr(lib, "htpu_policy_create"):
+        lib.htpu_policy_create.restype = ctypes.c_void_p
+        lib.htpu_policy_create.argtypes = []
+        lib.htpu_policy_destroy.restype = None
+        lib.htpu_policy_destroy.argtypes = [ctypes.c_void_p]
+        lib.htpu_policy_active.restype = ctypes.c_int
+        lib.htpu_policy_active.argtypes = [ctypes.c_void_p]
+        lib.htpu_policy_observe.restype = None
+        lib.htpu_policy_observe.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int]
+        lib.htpu_policy_next_eviction.restype = ctypes.c_int
+        lib.htpu_policy_next_eviction.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.htpu_policy_rerank.restype = None
+        lib.htpu_policy_rerank.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.htpu_policy_autoscale_target.restype = ctypes.c_int
+        lib.htpu_policy_autoscale_target.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.htpu_policy_ewma.restype = ctypes.c_double
+        lib.htpu_policy_ewma.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_policy_consecutive_slow.restype = ctypes.c_int
+        lib.htpu_policy_consecutive_slow.argtypes = [
+            ctypes.c_void_p, ctypes.c_int]
 
 
 def load():
@@ -530,6 +557,68 @@ class NativeBucketPlanner:
 
     def reset(self) -> None:
         self._lib.htpu_sched_reset(self._ptr)
+
+
+def _policy_lib():
+    """The loaded library iff it exports the fleet-policy API, else None
+    (pure-Python run or stale prebuilt .so)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_policy_create"):
+        return None
+    return lib
+
+
+class NativeFleetPolicy:
+    """ctypes wrapper over the C++ fleet-policy decision engine.  Covers
+    the decision surface (observe/evict/rerank/autoscale plus the ewma
+    and consecutive-slow probes) of the pure-Python mirror in
+    horovod_tpu/policy.py; used for parity tests and offline replay —
+    the in-job native policy lives inside the ControlPlane itself."""
+
+    def __init__(self):
+        lib = _policy_lib()
+        if lib is None:
+            raise RuntimeError("native fleet policy not available")
+        self._lib = lib
+        self._ptr = lib.htpu_policy_create()
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.htpu_policy_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def active(self) -> bool:
+        return bool(self._lib.htpu_policy_active(self._ptr))
+
+    def observe_tick(self, tick: int, wait_s) -> None:
+        n = len(wait_s)
+        arr = (ctypes.c_double * n)(*[float(w) for w in wait_s])
+        self._lib.htpu_policy_observe(self._ptr, int(tick), arr, n)
+
+    def next_eviction(self, process_count: int, seat_available: bool) -> int:
+        return self._lib.htpu_policy_next_eviction(
+            self._ptr, int(process_count), 1 if seat_available else 0)
+
+    def rerank_order(self, old_pidx):
+        n = len(old_pidx)
+        arr = (ctypes.c_int * n)(*[int(p) for p in old_pidx])
+        self._lib.htpu_policy_rerank(self._ptr, arr, n)
+        return list(arr)
+
+    def autoscale_target(self, tick: int) -> int:
+        return self._lib.htpu_policy_autoscale_target(self._ptr, int(tick))
+
+    def ewma(self, proc: int) -> float:
+        return float(self._lib.htpu_policy_ewma(self._ptr, int(proc)))
+
+    def consecutive_slow(self, proc: int) -> int:
+        return self._lib.htpu_policy_consecutive_slow(self._ptr, int(proc))
 
 
 def wire_roundtrip(wire_dtype: str, values):

@@ -98,6 +98,22 @@ class MetricsRegistry:
                 },
             }
 
+    def remove_matching(self, prefix: str) -> int:
+        """Drop every gauge/histogram whose name starts with ``prefix``
+        and return how many series were removed.  Counters are exempt on
+        purpose — mirroring ``htpu::Metrics::RemoveMatching`` — so
+        process-lifetime totals survive a membership change while
+        per-rank tagged series (``...#rank=R``) are retired instead of
+        accumulating under stale rank numbering after a re-rank."""
+        with self._lock:
+            removed = 0
+            for store in (self._gauges, self._histograms):
+                stale = [k for k in store if k.startswith(prefix)]
+                for k in stale:
+                    del store[k]
+                removed += len(stale)
+            return removed
+
     def clear(self) -> None:
         with self._lock:
             self._counters.clear()

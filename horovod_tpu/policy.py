@@ -1,0 +1,323 @@
+"""Fleet policy engine (Python mirror of cpp/htpu/policy).
+
+The coordinator's self-driving layer: every control tick it consumes the
+per-rank imposed-wait samples the skew monitor already computes and turns
+them into *planned* reconfigures through the PR 9 elastic machinery —
+
+* **straggler eviction** — a process whose EWMA imposed wait sits
+  ``HOROVOD_TPU_EVICT_THRESHOLD`` seconds above the fleet's median EWMA
+  for ``HOROVOD_TPU_EVICT_TICKS`` consecutive gathers is demoted to
+  standby (drained at a tick boundary, a parked spare admitted in the
+  same reconfigure).  One healthy gather resets the window (hysteresis);
+  ``HOROVOD_TPU_EVICT_MAX`` bounds total evictions so a systemic
+  slowdown can never evict the job into quorum loss — suppressed
+  opportunities log once and count ``policy.evictions_suppressed``.
+* **ring re-ranking** — on any reconfigure survivors are stably sorted
+  by ms-bucketed EWMA so the slowest hosts become ring-adjacent
+  (``HOROVOD_TPU_POLICY_RERANK=0`` keeps the PR 9 dense order).
+* **scripted autoscaling** — ``HOROVOD_TPU_AUTOSCALE`` holds a
+  ``tick:<T>=<procs>,...`` schedule (``run.py --autoscale-script``
+  validates it at launch through :func:`parse_autoscale_script`);
+  ``HOROVOD_TPU_AUTOSCALE_FILE`` is the external-signal seam — a file
+  holding a bare process count overrides the script once it parses.
+
+The native implementation in ``cpp/htpu/policy.cc`` runs inside the
+ControlPlane and is always preferred in a native job; the pure-Python
+:class:`FleetPolicy` here is the bit-for-bit reference for parity tests
+and the decision engine available to tooling without the .so.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+#: EWMA smoothing factor for per-process imposed wait; matches
+#: ``htpu::FleetPolicy::alpha_``.
+EWMA_ALPHA = 0.2
+
+
+def parse_autoscale_script(script: str) -> List[Tuple[int, int]]:
+    """Parse ``tick:<T>=<procs>[,tick:<T>=<procs>...]`` into a
+    tick-sorted ``[(tick, target_processes), ...]`` list.
+
+    Strict — raises :class:`ValueError` on any malformed entry so
+    ``run.py --autoscale-script`` fails at launch instead of the native
+    parser silently dropping the schedule mid-job.  Empty entries
+    (trailing commas) are tolerated, matching the lenient C++ parse.
+    """
+    out: List[Tuple[int, int]] = []
+    for entry in script.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if not entry.startswith("tick:"):
+            raise ValueError(
+                f"autoscale entry {entry!r} must look like tick:<T>=<procs>")
+        body = entry[len("tick:"):]
+        tick_s, sep, target_s = body.partition("=")
+        if not sep:
+            raise ValueError(
+                f"autoscale entry {entry!r} is missing '=<procs>'")
+        try:
+            tick = int(tick_s)
+            target = int(target_s)
+        except ValueError:
+            raise ValueError(
+                f"autoscale entry {entry!r}: tick and process count must "
+                "be integers") from None
+        if tick <= 0 or target <= 0:
+            raise ValueError(
+                f"autoscale entry {entry!r}: tick and process count must "
+                "be positive")
+        out.append((tick, target))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def evict_threshold_s_from_env() -> float:
+    """``HOROVOD_TPU_EVICT_THRESHOLD`` (seconds); 0 disables eviction."""
+    raw = os.environ.get("HOROVOD_TPU_EVICT_THRESHOLD", "0")
+    try:
+        v = float(raw)
+        return v if v >= 0 else 0.0
+    except ValueError:
+        return 0.0
+
+
+def evict_ticks_from_env() -> int:
+    """``HOROVOD_TPU_EVICT_TICKS``: consecutive slow gathers before a
+    rank is demoted (the hysteresis window)."""
+    raw = os.environ.get("HOROVOD_TPU_EVICT_TICKS", "5")
+    try:
+        v = int(raw)
+        return v if v > 0 else 5
+    except ValueError:
+        return 5
+
+
+def evict_max_from_env() -> int:
+    """``HOROVOD_TPU_EVICT_MAX``: lifetime eviction budget."""
+    raw = os.environ.get("HOROVOD_TPU_EVICT_MAX", "1")
+    try:
+        v = int(raw)
+        return v if v >= 0 else 1
+    except ValueError:
+        return 1
+
+
+def rerank_enabled_from_env() -> bool:
+    """``HOROVOD_TPU_POLICY_RERANK``: straggler-adjacent survivor order
+    on reconfigure (default on; only consulted while a policy is armed)."""
+    return os.environ.get("HOROVOD_TPU_POLICY_RERANK", "1") != "0"
+
+
+class _ProcState:
+    __slots__ = ("ewma", "valid", "consecutive", "suppress_logged")
+
+    def __init__(self):
+        self.ewma = 0.0
+        self.valid = False
+        self.consecutive = 0
+        self.suppress_logged = False
+
+
+class FleetPolicy:
+    """Pure-Python fleet-policy decision engine; same semantics as
+    ``htpu::FleetPolicy`` (parity is tested through the ctypes wrapper
+    ``cpp_core.NativeFleetPolicy``)."""
+
+    def __init__(self):
+        self._threshold_s = evict_threshold_s_from_env()
+        self._evict_ticks = evict_ticks_from_env()
+        self._evict_max = evict_max_from_env()
+        self._rerank = rerank_enabled_from_env()
+        raw = os.environ.get("HOROVOD_TPU_AUTOSCALE", "")
+        try:
+            self._schedule = parse_autoscale_script(raw) if raw else []
+        except ValueError as e:
+            print(f"horovod_tpu policy: ignoring malformed "
+                  f"HOROVOD_TPU_AUTOSCALE ({e})", file=sys.stderr)
+            self._schedule = []
+        self._autoscale_file = os.environ.get("HOROVOD_TPU_AUTOSCALE_FILE",
+                                              "")
+        self._procs: List[_ProcState] = []
+        self._evictions = 0
+
+    # ------------------------------------------------------- arming state
+
+    def evict_enabled(self) -> bool:
+        return self._threshold_s > 0
+
+    def autoscale_enabled(self) -> bool:
+        return bool(self._schedule) or bool(self._autoscale_file)
+
+    def active(self) -> bool:
+        return self.evict_enabled() or self.autoscale_enabled()
+
+    def rerank_enabled(self) -> bool:
+        return self._rerank and self.active()
+
+    # ---------------------------------------------------------- accessors
+
+    @property
+    def threshold_s(self) -> float:
+        return self._threshold_s
+
+    @property
+    def evict_ticks(self) -> int:
+        return self._evict_ticks
+
+    @property
+    def evict_max(self) -> int:
+        return self._evict_max
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions
+
+    def ewma(self, proc: int) -> float:
+        if 0 <= proc < len(self._procs) and self._procs[proc].valid:
+            return self._procs[proc].ewma
+        return -1.0
+
+    def consecutive_slow(self, proc: int) -> int:
+        if 0 <= proc < len(self._procs):
+            return self._procs[proc].consecutive
+        return 0
+
+    # ---------------------------------------------------------- decisions
+
+    def observe_tick(self, tick: int, wait_s: Sequence[float]) -> None:
+        """Feed one gather's per-process imposed waits (seconds; a
+        negative entry means no sample for that process this tick)."""
+        del tick
+        while len(self._procs) < len(wait_s):
+            self._procs.append(_ProcState())
+        for p, w in enumerate(wait_s):
+            if w < 0:
+                continue
+            ps = self._procs[p]
+            ps.ewma = (EWMA_ALPHA * w + (1.0 - EWMA_ALPHA) * ps.ewma
+                       if ps.valid else float(w))
+            ps.valid = True
+        if not self.evict_enabled():
+            return
+        # Slow is RELATIVE to the fleet: re-anchoring the smoothed values
+        # on their own median means a fleet-wide slowdown (every EWMA
+        # elevated alike) never nominates anyone — skew is a property of
+        # one host, load is a property of the job.
+        ew = sorted(ps.ewma for ps in self._procs if ps.valid)
+        if len(ew) < 2:
+            return
+        mid = len(ew) // 2
+        median = (ew[mid] if len(ew) % 2
+                  else (ew[mid] + ew[mid - 1]) / 2.0)
+        for ps in self._procs:
+            if not ps.valid:
+                continue
+            if ps.ewma - median > self._threshold_s:
+                ps.consecutive += 1
+            else:
+                # Hysteresis: one healthy gather resets the whole window.
+                ps.consecutive = 0
+                ps.suppress_logged = False
+
+    def next_eviction(self, process_count: int,
+                      seat_available: bool) -> int:
+        """The process index to demote this tick, or -1.  Suppressed
+        opportunities (budget spent, no seat) count
+        ``policy.evictions_suppressed`` and log once per slow episode."""
+        if not self.evict_enabled():
+            return -1
+        candidate = -1
+        worst = 0.0
+        # Process 0 IS the coordinator — never a candidate (failover,
+        # not eviction, handles a slow coordinator).
+        for p in range(1, min(process_count, len(self._procs))):
+            ps = self._procs[p]
+            if not ps.valid or ps.consecutive < self._evict_ticks:
+                continue
+            if candidate < 0 or ps.ewma > worst:
+                candidate = p
+                worst = ps.ewma
+        if candidate < 0:
+            return -1
+        why: Optional[str] = None
+        if self._evictions >= self._evict_max:
+            why = "eviction budget HOROVOD_TPU_EVICT_MAX exhausted"
+        elif not seat_available:
+            why = ("no parked standby and shrinking would fall below "
+                   "the rank floor")
+        if why is not None:
+            from .metrics import registry
+            registry.inc("policy.evictions_suppressed")
+            ps = self._procs[candidate]
+            if not ps.suppress_logged:
+                ps.suppress_logged = True
+                print(f"horovod_tpu policy: NOT evicting straggler "
+                      f"process {candidate} (ewma_wait="
+                      f"{ps.ewma * 1e3:.1f}ms > threshold for "
+                      f"{ps.consecutive} ticks): {why}", file=sys.stderr)
+            return -1
+        self._evictions += 1
+        return candidate
+
+    def rerank_order(self, old_pidx: Sequence[int]) -> List[int]:
+        """Survivor order for the next membership: slow hosts sorted to
+        the ring's tail so they sit adjacent.  EWMAs are bucketed to
+        whole milliseconds so sub-noise differences cannot perturb a
+        uniform fleet; the stable sort keeps the PR 9 dense order within
+        a bucket, so "no straggler" reduces to the identity."""
+        order = list(old_pidx)
+        if not self.rerank_enabled():
+            return order
+
+        def bucket(p: int) -> int:
+            if 0 <= p < len(self._procs) and self._procs[p].valid:
+                return int(self._procs[p].ewma * 1e3)
+            return 0
+
+        order.sort(key=bucket)
+        return order
+
+    def autoscale_target(self, tick: int) -> int:
+        """The standing world-size target at ``tick`` (-1 = none): the
+        last schedule entry at or before the tick, overridden by the
+        file seam whenever it holds a positive integer."""
+        target = -1
+        for entry_tick, entry_target in self._schedule:
+            if entry_tick <= tick:
+                target = entry_target
+        if self._autoscale_file:
+            try:
+                with open(self._autoscale_file) as f:
+                    v = int(f.read().split()[0])
+                if v > 0:
+                    target = v
+            except (OSError, ValueError, IndexError):
+                pass
+        return target
+
+    def on_reconfigure(self, old_to_new: Sequence[int],
+                       new_count: int) -> None:
+        """Remap per-process state to the post-reconfigure numbering
+        (``old_to_new[p] = -1`` drops p: evicted, dead, or parked)."""
+        nxt = [_ProcState() for _ in range(new_count)]
+        for p, np_ in enumerate(old_to_new):
+            if 0 <= np_ < new_count and p < len(self._procs):
+                nxt[np_] = self._procs[p]
+        self._procs = nxt
+
+
+def make_fleet_policy(prefer_native: bool = True):
+    """A fleet-policy decision engine: the native one when the core
+    library exports the policy API, else the pure-Python mirror."""
+    if prefer_native:
+        try:
+            from . import cpp_core
+            return cpp_core.NativeFleetPolicy()
+        except (RuntimeError, OSError):
+            pass
+    return FleetPolicy()

@@ -111,6 +111,13 @@ def main(argv=None):
                    help="total crashed children relaunched as standbys "
                         "before the launcher stops replacing them "
                         "(elastic mode)")
+    p.add_argument("--autoscale-script", default="",
+                   help="scripted elastic autoscaling (elastic mode only): "
+                        "a tick:<T>=<procs>,... schedule, validated here "
+                        "and handed to the coordinator (sets "
+                        "HOROVOD_TPU_AUTOSCALE in every child), which "
+                        "grows/shrinks the world to each target via "
+                        "planned reconfigures (docs/elasticity.md)")
     p.add_argument("--ckpt-async", action="store_true",
                    help="async incremental checkpointing (sets "
                         "HOROVOD_TPU_CKPT_ASYNC=1): run_elastic snapshots "
@@ -126,6 +133,16 @@ def main(argv=None):
     args = p.parse_args(argv)
     if not args.elastic and args.num_standby:
         p.error("--num-standby requires --elastic")
+    if args.autoscale_script:
+        if not args.elastic:
+            p.error("--autoscale-script requires --elastic")
+        # Fail at launch on a typo'd schedule — the native parser is
+        # lenient (warn + drop), which would silently run unscaled.
+        from horovod_tpu.policy import parse_autoscale_script
+        try:
+            parse_autoscale_script(args.autoscale_script)
+        except ValueError as e:
+            p.error(f"--autoscale-script: {e}")
 
     cmd = args.command
     if cmd and cmd[0] == "--":
@@ -153,6 +170,8 @@ def main(argv=None):
             if args.elastic_min_ranks > 0:
                 env["HOROVOD_TPU_ELASTIC_MIN_RANKS"] = str(
                     args.elastic_min_ranks)
+            if args.autoscale_script:
+                env["HOROVOD_TPU_AUTOSCALE"] = args.autoscale_script
         if standby:
             env["HOROVOD_TPU_STANDBY"] = "1"
         if args.ckpt_async or args.snapshot_every_steps > 0:
@@ -265,6 +284,9 @@ def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
     an unused spare exiting 0 is success, a reaped one is teardown."""
     restarts = 0
     handled = set()
+    sb_handled = set()
+    sb_bo = Backoff()
+    sb_retry_at = 0.0
     lead = 0
     lead_done_at = None
     bo = Backoff()
@@ -307,7 +329,18 @@ def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
                           f"({max_restarts}) exhausted — not replaced",
                           file=sys.stderr)
         rc_lead = rcs[lead]
-        if rc_lead is not None:
+        if rc_lead is None:
+            # A spare that dies before admission (bad dial, crash while
+            # parked, a relaunch failing on a sick host) used to vanish
+            # silently, quietly shrinking the replacement pool.  Replace
+            # it, paced by the shared Backoff schedule so a standby
+            # crash-looping against an unreachable coordinator cannot
+            # spin-fork, and bounded by the same --max-restarts budget as
+            # worker relaunches.
+            restarts, sb_retry_at = _respawn_failed_standbys(
+                standbys, sb_handled, spawn_standby, restarts,
+                max_restarts, sb_bo, sb_retry_at)
+        else:
             if lead_done_at is None:
                 lead_done_at = time.monotonic()
             stragglers = time.monotonic() - lead_done_at > grace_s
@@ -323,6 +356,44 @@ def _supervise_elastic(procs, standbys, spawn_standby, max_restarts: int,
                 _reap(procs + standbys, sig=signal.SIGTERM, grace_s=5.0)
                 return rc_lead
         bo.sleep()
+
+
+def _respawn_failed_standbys(standbys, handled, spawn_standby, restarts,
+                             max_restarts, bo, retry_at, now=None):
+    """Replace standbys that exited non-zero before admission.
+
+    Each replacement is paced by ``bo`` (a :class:`Backoff`): the next
+    failed spare is not replaced until the previous replacement's delay
+    has elapsed, so a spare that dies instantly on spawn backs off
+    instead of fork-spinning.  Replacements draw from the same
+    ``max_restarts`` budget as worker relaunches; an exhausted budget
+    logs once per corpse.  Returns the updated ``(restarts, retry_at)``.
+    """
+    if now is None:
+        now = time.monotonic()
+    for j, sb in enumerate(list(standbys)):
+        if j in handled:
+            continue
+        rc = sb.poll()
+        if rc is None or rc == 0:
+            # Still parked, or a clean post-shutdown exit — not a failure.
+            continue
+        if restarts >= max_restarts:
+            handled.add(j)
+            print(f"horovod_tpu.run: standby pid {sb.pid} exited with "
+                  f"code {rc}; restart budget ({max_restarts}) exhausted "
+                  "— not replaced", file=sys.stderr)
+            continue
+        if now < retry_at:
+            continue   # paced: revisit this corpse on a later poll
+        handled.add(j)
+        restarts += 1
+        nb = spawn_standby()
+        retry_at = now + bo.next_delay()
+        print(f"horovod_tpu.run: standby pid {sb.pid} exited with code "
+              f"{rc} before admission; respawned as standby pid {nb.pid} "
+              f"(restart {restarts}/{max_restarts})", file=sys.stderr)
+    return restarts, retry_at
 
 
 def _reap(procs, sig, grace_s: float):

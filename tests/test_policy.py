@@ -1,0 +1,437 @@
+"""Fleet policy engine (PR: robustness): straggler eviction hysteresis,
+ring re-ranking, scripted autoscaling, and the slow-fault grammar.
+
+Pure-Python decision tests run everywhere; parity tests drive the native
+engine through the ctypes wrapper when the core library is built.  The
+end-to-end drills (planted straggler evicted in a live 3-proc job,
+scripted 4→2→4 autoscale) live in test_elastic.py under @slow.
+"""
+
+import json
+import sys
+
+import pytest
+
+from horovod_tpu import cpp_core
+from horovod_tpu import run as run_mod
+from horovod_tpu.core import parse_fault_spec, parse_fault_specs
+from horovod_tpu.metrics import registry
+from horovod_tpu.policy import (EWMA_ALPHA, FleetPolicy, make_fleet_policy,
+                                parse_autoscale_script)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+def arm_eviction(monkeypatch, threshold="0.02", ticks="3", max_evict="1"):
+    monkeypatch.setenv("HOROVOD_TPU_EVICT_THRESHOLD", threshold)
+    monkeypatch.setenv("HOROVOD_TPU_EVICT_TICKS", ticks)
+    monkeypatch.setenv("HOROVOD_TPU_EVICT_MAX", max_evict)
+
+
+def feed(policy, waits, n=1, start_tick=1):
+    for i in range(n):
+        policy.observe_tick(start_tick + i, waits)
+
+
+# ------------------------------------------------------- autoscale grammar
+
+class TestAutoscaleScript:
+    def test_parse_and_sort(self):
+        assert parse_autoscale_script("tick:30=2,tick:10=4") == [
+            (10, 4), (30, 2)]
+
+    def test_trailing_comma_tolerated(self):
+        assert parse_autoscale_script("tick:5=3,") == [(5, 3)]
+
+    @pytest.mark.parametrize("bad", [
+        "5=3", "tick:5", "tick:=3", "tick:5=", "tick:5=0", "tick:0=3",
+        "tick:-1=3", "tick:5=-2", "tick:x=3", "tick:5=y", "rank:5=3",
+    ])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_autoscale_script(bad)
+
+    def test_launcher_rejects_malformed_script(self, capsys):
+        with pytest.raises(SystemExit):
+            run_mod.main(["-np", "2", "--elastic",
+                          "--autoscale-script", "tick:nope", "--", "true"])
+        assert "--autoscale-script" in capsys.readouterr().err
+
+    def test_launcher_requires_elastic(self, capsys):
+        with pytest.raises(SystemExit):
+            run_mod.main(["-np", "2", "--autoscale-script", "tick:5=1",
+                          "--", "true"])
+        assert "requires --elastic" in capsys.readouterr().err
+
+
+# --------------------------------------------------------- arming + knobs
+
+class TestArming:
+    def test_unarmed_by_default(self):
+        p = FleetPolicy()
+        assert not p.active()
+        assert not p.evict_enabled()
+        assert not p.autoscale_enabled()
+        # Rerank only applies while the policy is armed at all.
+        assert not p.rerank_enabled()
+
+    def test_threshold_arms_eviction(self, monkeypatch):
+        arm_eviction(monkeypatch)
+        p = FleetPolicy()
+        assert p.active() and p.evict_enabled() and p.rerank_enabled()
+        assert p.threshold_s == pytest.approx(0.02)
+        assert p.evict_ticks == 3 and p.evict_max == 1
+
+    def test_schedule_arms_autoscale(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE", "tick:10=2")
+        p = FleetPolicy()
+        assert p.active() and p.autoscale_enabled()
+        assert not p.evict_enabled()
+
+    def test_malformed_schedule_warns_and_disarms(self, monkeypatch,
+                                                  capsys):
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE", "tick:banana")
+        p = FleetPolicy()
+        assert not p.autoscale_enabled()
+        assert "HOROVOD_TPU_AUTOSCALE" in capsys.readouterr().err
+
+    def test_rerank_opt_out(self, monkeypatch):
+        arm_eviction(monkeypatch)
+        monkeypatch.setenv("HOROVOD_TPU_POLICY_RERANK", "0")
+        assert not FleetPolicy().rerank_enabled()
+
+
+# ------------------------------------------------- eviction + hysteresis
+
+class TestEviction:
+    def test_straggler_evicted_after_window(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="3")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.001, 0.05], n=2)
+        assert p.next_eviction(3, True) == -1   # window not yet full
+        feed(p, [0.0, 0.001, 0.05], n=1, start_tick=3)
+        assert p.next_eviction(3, True) == 2
+        assert p.evictions == 1
+
+    def test_single_spike_does_not_evict(self, monkeypatch):
+        """One slow gather fills one slot of the hysteresis window —
+        never enough on its own — and only alpha-weights the EWMA."""
+        arm_eviction(monkeypatch, ticks="3")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.0, 0.0], n=5)
+        p.observe_tick(6, [0.0, 0.0, 0.5])
+        assert p.ewma(2) == pytest.approx(EWMA_ALPHA * 0.5)
+        assert p.consecutive_slow(2) == 1
+        assert p.next_eviction(3, True) == -1
+
+    def test_recovery_mid_window_resets_counter(self, monkeypatch):
+        """Satellite: a rank that recovers mid-window is never evicted —
+        ONE healthy gather zeroes the consecutive counter."""
+        arm_eviction(monkeypatch, ticks="3")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.001, 0.08], n=2)
+        assert p.consecutive_slow(2) == 2
+        # Recovery: EWMA decays 0.8·0.8·0.8 ≈ half per 3 healthy ticks;
+        # feed enough to drop below threshold+median.
+        feed(p, [0.0, 0.001, 0.0], n=8, start_tick=3)
+        assert p.consecutive_slow(2) == 0
+        feed(p, [0.0, 0.001, 0.08], n=2, start_tick=11)
+        assert p.next_eviction(3, True) == -1   # window restarted at 1
+        assert p.evictions == 0
+
+    def test_all_ranks_slow_no_eviction(self, monkeypatch):
+        """Satellite: fleet-wide slowdown elevates the median with every
+        EWMA — relative skew stays ~0 and nobody is nominated."""
+        arm_eviction(monkeypatch, ticks="2")
+        p = FleetPolicy()
+        feed(p, [0.3, 0.3, 0.3], n=10)
+        assert p.next_eviction(3, True) == -1
+        for proc in range(3):
+            assert p.consecutive_slow(proc) == 0
+
+    def test_budget_exhausted_logs_and_counts(self, monkeypatch, capsys):
+        """Satellite: past HOROVOD_TPU_EVICT_MAX the policy suppresses —
+        log-and-continue plus the policy.evictions_suppressed counter."""
+        arm_eviction(monkeypatch, ticks="2", max_evict="1")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.001, 0.05], n=3)
+        assert p.next_eviction(3, True) == 2
+        feed(p, [0.0, 0.001, 0.05], n=3, start_tick=4)
+        assert p.next_eviction(3, True) == -1
+        assert p.next_eviction(3, True) == -1
+        snap = registry.snapshot()
+        assert snap["counters"]["policy.evictions_suppressed"] == 2
+        err = capsys.readouterr().err
+        # One line per slow episode, not per suppressed opportunity.
+        assert err.count("NOT evicting straggler") == 1
+        assert "HOROVOD_TPU_EVICT_MAX exhausted" in err
+
+    def test_no_seat_suppresses(self, monkeypatch, capsys):
+        arm_eviction(monkeypatch, ticks="2")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.001, 0.05], n=3)
+        assert p.next_eviction(3, seat_available=False) == -1
+        assert "rank floor" in capsys.readouterr().err
+        assert registry.snapshot()["counters"][
+            "policy.evictions_suppressed"] == 1
+        # A seat appearing later lets the SAME episode evict.
+        assert p.next_eviction(3, seat_available=True) == 2
+
+    def test_coordinator_never_candidate(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="2")
+        p = FleetPolicy()
+        feed(p, [0.05, 0.0, 0.0], n=5)
+        assert p.consecutive_slow(0) >= 2
+        assert p.next_eviction(3, True) == -1
+
+    def test_worst_of_several_candidates_wins(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="2", max_evict="2")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.06, 0.09, 0.0], n=4)
+        assert p.next_eviction(4, True) == 2
+
+    def test_missing_sample_skipped(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="2")
+        p = FleetPolicy()
+        feed(p, [0.0, -1.0, 0.05], n=4)
+        assert p.ewma(1) == -1.0
+        assert p.next_eviction(3, True) == 2
+
+
+# ------------------------------------------------------------- re-ranking
+
+class TestRerank:
+    def test_straggler_sorted_last(self, monkeypatch):
+        arm_eviction(monkeypatch)
+        p = FleetPolicy()
+        feed(p, [0.0, 0.05, 0.001], n=5)
+        assert p.rerank_order([1, 2]) == [2, 1]
+
+    def test_uniform_fleet_is_identity(self, monkeypatch):
+        """Sub-ms EWMA noise is bucketed away: no straggler, no reorder
+        — the PR 9 dense order survives byte-for-byte."""
+        arm_eviction(monkeypatch)
+        p = FleetPolicy()
+        feed(p, [0.0, 0.0004, 0.0001, 0.0008], n=5)
+        assert p.rerank_order([1, 2, 3]) == [1, 2, 3]
+
+    def test_disabled_is_identity(self, monkeypatch):
+        arm_eviction(monkeypatch)
+        monkeypatch.setenv("HOROVOD_TPU_POLICY_RERANK", "0")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.05, 0.001], n=5)
+        assert p.rerank_order([1, 2]) == [1, 2]
+
+
+# ------------------------------------------------------------- autoscale
+
+class TestAutoscale:
+    def test_standing_targets(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE",
+                           "tick:10=2,tick:30=4")
+        p = FleetPolicy()
+        assert p.autoscale_target(5) == -1
+        assert p.autoscale_target(10) == 2
+        assert p.autoscale_target(29) == 2
+        assert p.autoscale_target(500) == 4
+
+    def test_file_seam_overrides_script(self, monkeypatch, tmp_path):
+        sig = tmp_path / "target"
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE", "tick:10=2")
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE_FILE", str(sig))
+        p = FleetPolicy()
+        assert p.autoscale_target(20) == 2       # file absent: script wins
+        sig.write_text("5\n")
+        assert p.autoscale_target(20) == 5       # file overrides
+        sig.write_text("garbage\n")
+        assert p.autoscale_target(20) == 2       # unparseable: script again
+
+
+# ------------------------------------------------------- reconfigure remap
+
+class TestReconfigureRemap:
+    def test_state_follows_survivors(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="2")
+        p = FleetPolicy()
+        feed(p, [0.0, 0.001, 0.05], n=3)
+        old_ewma = p.ewma(2)
+        # Proc 1 evicted; proc 2 densifies to index 1.
+        p.on_reconfigure([0, -1, 1], 2)
+        assert p.ewma(1) == pytest.approx(old_ewma)
+        assert p.ewma(2) == -1.0
+        assert p.consecutive_slow(1) >= 2
+
+
+# ----------------------------------------------------- native parity
+
+needs_native = pytest.mark.skipif(not cpp_core.available(),
+                                  reason="native core not built")
+
+
+@needs_native
+class TestNativeParity:
+    def test_decision_parity(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="3")
+        monkeypatch.setenv("HOROVOD_TPU_AUTOSCALE", "tick:10=2,tick:30=4")
+        py = FleetPolicy()
+        nat = make_fleet_policy()
+        assert type(nat).__name__ == "NativeFleetPolicy"
+        assert nat.active()
+        waves = ([[0.0, 0.001, 0.05]] * 4 + [[0.0, 0.001, 0.0]] * 2
+                 + [[0.0, 0.001, 0.05]] * 4 + [[0.02, 0.02, 0.02]] * 3)
+        for tick, w in enumerate(waves, start=1):
+            py.observe_tick(tick, w)
+            nat.observe_tick(tick, w)
+            for proc in range(3):
+                assert nat.ewma(proc) == pytest.approx(py.ewma(proc)), (
+                    tick, proc)
+                assert nat.consecutive_slow(proc) == \
+                    py.consecutive_slow(proc), (tick, proc)
+            assert nat.next_eviction(3, True) == py.next_eviction(3, True)
+            assert nat.rerank_order([1, 2]) == py.rerank_order([1, 2])
+            assert nat.autoscale_target(tick) == py.autoscale_target(tick)
+        nat.close()
+
+    def test_native_budget_suppression(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="2", max_evict="1")
+        nat = cpp_core.NativeFleetPolicy()
+        for tick in range(1, 6):
+            nat.observe_tick(tick, [0.0, 0.001, 0.05])
+        assert nat.next_eviction(3, True) == 2
+        assert nat.next_eviction(3, True) == -1   # budget of 1 spent
+        nat.close()
+
+
+# --------------------------------------------------- fault-spec grammar
+
+class TestSlowFaultSpec:
+    def test_basic(self):
+        fs = parse_fault_spec("slow:rank=1:ms=50")
+        assert (fs.mode, fs.rank, fs.ms, fs.tick) == ("slow", 1, 50, -1)
+
+    def test_with_tick(self):
+        fs = parse_fault_spec("slow:rank=0:ms=5:tick=7")
+        assert (fs.mode, fs.rank, fs.ms, fs.tick) == ("slow", 0, 5, 7)
+
+    def test_combined_specs(self):
+        specs = parse_fault_specs(
+            "slow:rank=1:ms=50;crash:rank=2:tick=30")
+        assert [s.mode for s in specs] == ["slow", "crash"]
+
+    @pytest.mark.parametrize("bad", [
+        "slow:rank=1", "slow:ms=50:tick=3", "slow:rank=1:ms=0",
+        "slow:rank=-1:ms=5", "slow:rank=1:ms=5:tick=0",
+        "slow:rank=1:ms=x", "slow:rank=1:ms=5:epoch=2",
+        "slow:rank=1:ms=5:tick=2:tick=3",
+    ])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+    def test_classic_specs_unchanged(self):
+        fs = parse_fault_spec("crash:rank=1:tick=30")
+        assert (fs.mode, fs.rank, fs.tick, fs.ms) == ("crash", 1, 30, 0)
+        with pytest.raises(ValueError):
+            parse_fault_spec("crash:rank=1:ms=30")
+
+
+# ------------------------------------------------- registry series flush
+
+class TestRegistryRemoveMatching:
+    def test_gauges_and_histograms_removed_counters_kept(self):
+        registry.set_gauge("policy.ewma_wait_s#rank=0", 1.0)
+        registry.set_gauge("policy.ewma_wait_s#rank=1", 2.0)
+        registry.observe("control.gather_skew_seconds#rank=1", 0.5)
+        registry.inc("policy.evictions_suppressed")
+        registry.set_gauge("coord.epoch", 1.0)
+        assert registry.remove_matching("policy.ewma_wait_s#rank=") == 2
+        assert registry.remove_matching(
+            "control.gather_skew_seconds#rank=") == 1
+        # Counters are exempt by contract; unrelated gauges survive.
+        assert registry.remove_matching("policy.evictions_suppressed") == 0
+        snap = registry.snapshot()
+        assert snap["counters"]["policy.evictions_suppressed"] == 1
+        # Subset checks: a controller thread left over from another test
+        # may publish its own gauges into the shared registry.
+        assert snap["gauges"].get("coord.epoch") == 1.0
+        assert not any(k.startswith("policy.ewma_wait_s#rank=")
+                       for k in snap["gauges"])
+        assert not any(k.startswith("control.gather_skew_seconds#rank=")
+                       for k in snap["histograms"])
+
+
+# ------------------------------------------------- launcher standby respawn
+
+class FakeProc:
+    _next_pid = 9000
+
+    def __init__(self, rc=None):
+        self.rc = rc
+        FakeProc._next_pid += 1
+        self.pid = FakeProc._next_pid
+
+    def poll(self):
+        return self.rc
+
+
+class TestStandbyRespawn:
+    def _spawner(self, standbys):
+        def spawn():
+            sb = FakeProc(rc=None)
+            standbys.append(sb)
+            return sb
+        return spawn
+
+    def test_failed_standby_respawned_with_backoff(self, capsys):
+        standbys = [FakeProc(rc=1)]
+        handled = set()
+        bo = run_mod.Backoff(base=0.05)
+        restarts, retry_at = run_mod._respawn_failed_standbys(
+            standbys, handled, self._spawner(standbys), 0, 3, bo, 0.0,
+            now=100.0)
+        assert restarts == 1 and len(standbys) == 2
+        assert handled == {0}
+        assert retry_at > 100.0    # next corpse waits out the backoff
+        assert "respawned as standby" in capsys.readouterr().err
+        # A second corpse inside the pacing window is NOT replaced yet...
+        standbys[1].rc = 1
+        restarts, retry_at2 = run_mod._respawn_failed_standbys(
+            standbys, handled, self._spawner(standbys), restarts, 3, bo,
+            retry_at, now=100.0)
+        assert restarts == 1 and len(standbys) == 2
+        # ...but is once the delay elapses.
+        restarts, _ = run_mod._respawn_failed_standbys(
+            standbys, handled, self._spawner(standbys), restarts, 3, bo,
+            retry_at, now=retry_at + 1.0)
+        assert restarts == 2 and len(standbys) == 3
+
+    def test_clean_exit_and_running_ignored(self, capsys):
+        standbys = [FakeProc(rc=0), FakeProc(rc=None)]
+        restarts, _ = run_mod._respawn_failed_standbys(
+            standbys, set(), self._spawner(standbys), 0, 3,
+            run_mod.Backoff(), 0.0, now=1.0)
+        assert restarts == 0 and len(standbys) == 2
+        assert capsys.readouterr().err == ""
+
+    def test_budget_exhausted_logs_once(self, capsys):
+        standbys = [FakeProc(rc=2)]
+        handled = set()
+        for _ in range(3):
+            restarts, _ = run_mod._respawn_failed_standbys(
+                standbys, handled, self._spawner(standbys), 5, 5,
+                run_mod.Backoff(), 0.0, now=1.0)
+        assert restarts == 5 and len(standbys) == 1
+        assert capsys.readouterr().err.count("restart budget") == 1
+
+
+# ----------------------------------------------------------- factory
+
+class TestFactory:
+    def test_python_fallback(self):
+        p = make_fleet_policy(prefer_native=False)
+        assert isinstance(p, FleetPolicy)

@@ -159,6 +159,31 @@ class TestStragglerReport:
                 pytest.approx(0.0, abs=1.0)
         assert report["worst_ticks"][0]["slowest_rank"] == STRAGGLER_RANK
 
+    def test_per_tick_rows_schema(self, trace_files):
+        """The machine-readable per-tick enrichment: one row per compared
+        tick, in tick order, each naming that tick's critical rank, its
+        skew past the median, and the wait it imposed on the fleet — the
+        input an offline policy replay or eviction post-mortem consumes."""
+        traces = trace_merge.read_traces(trace_files)
+        _, info = trace_merge.merge_traces(traces)
+        report = trace_merge.straggler_report(traces, info)
+        rows = report["ticks"]
+        assert len(rows) == report["ticks_compared"] == TICKS
+        assert [row["tick"] for row in rows] == sorted(
+            row["tick"] for row in rows)
+        for row in rows:
+            assert set(row) == {"tick", "slowest_rank", "skew_us",
+                                "imposed_wait_us"}
+            assert row["slowest_rank"] == STRAGGLER_RANK
+            assert row["skew_us"] == pytest.approx(STRAGGLER_LATE_US,
+                                                   rel=0.05)
+            assert row["imposed_wait_us"] >= row["skew_us"]
+        # worst_ticks is the same rows re-sorted and truncated.
+        assert report["worst_ticks"][0] in rows
+        # The whole report (rows included) must survive a JSON round trip
+        # — it is what --report-json writes.
+        assert json.loads(json.dumps(report))["ticks"] == rows
+
     def test_report_prints(self, trace_files, capsys):
         traces = trace_merge.read_traces(trace_files)
         _, info = trace_merge.merge_traces(traces)

@@ -261,7 +261,14 @@ def straggler_report(traces: List[RankTrace], info: dict,
         pr["late_mean_us"] = (pr["late_sum_us"] / pr["ticks"]
                               if pr["ticks"] else 0.0)
         del pr["late_sum_us"]
-    critical.sort(key=lambda c: c["imposed_wait_us"], reverse=True)
+    # The FULL per-tick record in tick order — what an offline policy
+    # replay (or an eviction post-mortem) consumes: every compared tick's
+    # critical rank, its skew past the median, and the wait it imposed on
+    # the rest of the fleet.  ``worst_ticks`` below is the same rows
+    # re-sorted and truncated for the human summary.
+    per_tick = sorted(critical, key=lambda c: c["tick"])
+    critical = sorted(critical, key=lambda c: c["imposed_wait_us"],
+                      reverse=True)
     ranking = sorted(per_rank,
                      key=lambda r: per_rank[r]["imposed_wait_us"],
                      reverse=True)
@@ -270,6 +277,7 @@ def straggler_report(traces: List[RankTrace], info: dict,
             "offsets_us": info["offsets_us"],
             "ticks_compared": len(critical),
             "per_rank": per_rank,
+            "ticks": per_tick,
             "slowest_ranks": ranking[:top_k],
             "worst_ticks": critical[:top_k]}
 
