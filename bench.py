@@ -1325,6 +1325,171 @@ def policy_worker():
     print("POLDONE", flush=True)
 
 
+def publish_worker():
+    """One process of the publish-while-training drill (BENCH_PUBLISH_*
+    env; two processes, four ranks, ``HOROVOD_TPU_PROCESS_SETS``
+    registers the subscriber set ``serve:2,3`` on process 1).
+
+    Both processes run the same world-allreduce training loop twice: a
+    baseline leg, then a leg where process 0 commits a checkpoint-chain
+    epoch every K steps and process 1's :class:`ParameterPublisher`
+    polls the directory between steps, streaming each committed tip to
+    the ``serve`` set on the set-scoped host plane.  Training never
+    stops; process 1 prints one ``PUBLEG`` JSON line with the measured
+    step-time delta, publish latency and commit-to-serve staleness."""
+    import sys
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint
+    from horovod_tpu import metrics as hvd_metrics
+    from horovod_tpu.publish import ParameterPublisher
+
+    ckpt_dir = os.environ["BENCH_PUBLISH_DIR"]
+    steps = int(os.environ.get("BENCH_PUBLISH_STEPS", "40"))
+    ckpt_every = int(os.environ.get("BENCH_PUBLISH_CKPT_EVERY", "10"))
+    hvd.init()
+    assert hvd.size() == 4 and hvd.process_count() == 2
+    pidx = hvd.process_index()
+    payload = np.ones(1 << 14, np.float32)
+    base_flat = {f"['w{i}']": np.arange(4096, dtype=np.float32)
+                 for i in range(4)}
+
+    def leg(publishing, tag):
+        pub = (ParameterPublisher(ckpt_dir, "serve")
+               if publishing and pidx == 1 else None)
+        prev, prev_flat = -1, None
+        times = []
+        for i in range(steps):
+            s0 = time.monotonic()
+            hvd.allreduce(payload, average=False, name=f"{tag}.{i}")
+            times.append(time.monotonic() - s0)
+            if publishing and pidx == 0 and i % ckpt_every == ckpt_every - 1:
+                epoch = i // ckpt_every
+                flat = {k: v + float(epoch) for k, v in base_flat.items()}
+                checkpoint.save_chain(ckpt_dir, flat, epoch,
+                                      prev_epoch=prev, prev_flat=prev_flat)
+                prev, prev_flat = epoch, flat
+            if pub is not None:
+                out = pub.poll()
+                if out is not None:
+                    # Published state is the committed chain tip, not a
+                    # torn or in-flight write.
+                    epoch = pub.last_published_epoch
+                    want = base_flat["['w0']"] + float(epoch)
+                    assert np.array_equal(np.asarray(out["['w0']"]), want)
+        return sum(times) / len(times)
+
+    base_s = leg(False, "base")
+    hvd.allreduce(np.ones(4, np.float32), name="phase.barrier")
+    pub_s = leg(True, "pub")
+    # Keep the coordinator alive through process 1's final publish: its
+    # last poll() may still be negotiating on the serve set when process
+    # 0 falls out of the loop.
+    hvd.allreduce(np.ones(4, np.float32), name="end.barrier")
+    if pidx == 1:
+        snap = hvd_metrics.snapshot()
+        hists = snap.get("histograms", {})
+        lat = hists.get("publish.latency_seconds", {})
+        stale = hists.get("publish.staleness_seconds#process_set=serve", {})
+        nlat = lat.get("count", 0)
+        nstale = stale.get("count", 0)
+        print("PUBLEG " + json.dumps({
+            "publishes": int(snap.get("counters", {}).get(
+                "publish.count", 0)),
+            "publish_bytes": int(snap.get("counters", {}).get(
+                "publish.bytes", 0)),
+            "publish_latency_s": round(
+                lat.get("sum", 0.0) / nlat, 5) if nlat else None,
+            "staleness_s": round(
+                stale.get("sum", 0.0) / nstale, 5) if nstale else None,
+            "publish_epoch": int(snap.get("gauges", {}).get(
+                "publish.epoch#process_set=serve", -1)),
+            "step_seconds_baseline": round(base_s, 5),
+            "step_seconds_publishing": round(pub_s, 5),
+            "step_time_delta_pct": round(
+                (pub_s - base_s) / base_s * 100.0, 2),
+        }), flush=True)
+    print("PUBDONE", flush=True)
+    sys.exit(0)
+
+
+def _publish_drill():
+    """Publish-while-training drill: two processes over the TCP control
+    plane, training on the world set while process 1 streams committed
+    checkpoint-chain tips to the ``serve`` process set.  Returns the
+    PUBLEG block — publish latency, commit-to-serve staleness, and the
+    training step-time delta the serving plane imposed."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-publish-")
+    port = free_port()
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.pop("HOROVOD_TPU_FAULT", None)
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": "2",
+            "HOROVOD_TPU_SIZE": "4",
+            "HOROVOD_TPU_RANK": str(i * 2),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_PROCESS_SETS": "serve:2,3",
+            "BENCH_PUBLISH_DIR": tmpdir,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--publish-worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append((p.returncode, out))
+    for rc, out in outs:
+        # The acceptance bar: publishing never aborts training.
+        if rc != 0 or "PUBDONE" not in out:
+            raise RuntimeError(
+                f"publish drill: worker exited {rc} without finishing "
+                f"training:\n{out[-2000:]}")
+    for line in outs[1][1].splitlines():
+        if line.startswith("PUBLEG "):
+            result = json.loads(line[len("PUBLEG "):])
+            result["note"] = (
+                "both processes train on the world set while process 0 "
+                "commits a chain epoch every 10 steps and process 1 "
+                "streams each committed tip to the serve set between its "
+                "own steps; staleness_s = commit-to-served lag, "
+                "step_time_delta_pct = training cost of the serving plane "
+                "(same host, so it includes CPU contention)")
+            return result
+    raise RuntimeError(
+        f"publish drill produced no PUBLEG line:\n{outs[1][1][-2000:]}")
+
+
 def _recovery_drill():
     """Kill-one-rank recovery drill, sync full checkpoints vs the async
     delta stream, in the same run on the same machine.  Returns the
@@ -1693,6 +1858,13 @@ def bench_scaling_tcp():
             policy = {"error": f"{type(e).__name__}: {e}"}  # the leg
     else:
         policy = {"skipped": "BENCH_POLICY=0"}
+    if os.environ.get("BENCH_PUBLISH", "1") == "1":
+        try:
+            publish = _publish_drill()
+        except Exception as e:   # noqa: BLE001 — the drill must not sink
+            publish = {"error": f"{type(e).__name__}: {e}"}  # the leg
+    else:
+        publish = {"skipped": "BENCH_PUBLISH=0"}
     transport = two.get("ring_transport", "tcp")
     eff = round(two["images_per_sec_per_proc"]
                 / one["images_per_sec_per_proc"], 4)
@@ -1741,6 +1913,11 @@ def bench_scaling_tcp():
         # tick to the policy's planned demotion + spare admission, with
         # the policy.* counters.  BENCH_POLICY=0 skips it.
         "policy": policy,
+        # Publish-while-training drill: committed chain tips streamed to
+        # a subscriber process set mid-training, with publish latency,
+        # commit-to-serve staleness, and the training step-time delta.
+        # BENCH_PUBLISH=0 skips it.
+        "publish": publish,
     }
 
 
@@ -1955,6 +2132,8 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--policy-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--publish-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.tcp_worker:
@@ -1968,6 +2147,9 @@ def main():
         return
     if args.policy_worker:
         policy_worker()
+        return
+    if args.publish_worker:
+        publish_worker()
         return
     if args.n_virtual:
         print(json.dumps(bench_scaling(args.n_virtual)))

@@ -20,6 +20,7 @@
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
 #include "htpu/policy.h"
+#include "htpu/process_set.h"
 #include "htpu/quantize.h"
 #include "htpu/reduce.h"
 #include "htpu/timeline.h"
@@ -702,6 +703,122 @@ HTPU_API double htpu_policy_ewma(void* policy, int proc) {
 
 HTPU_API int htpu_policy_consecutive_slow(void* policy, int proc) {
   return static_cast<htpu::FleetPolicy*>(policy)->consecutive_slow(proc);
+}
+
+// Per-set straggler state (policy.h): the same wait streams bucketed by
+// process set, so one tenant's slowness never nominates a rank for
+// eviction from another's.  The unsuffixed endpoints above read set 0.
+
+HTPU_API void htpu_policy_observe_set(void* policy, int set,
+                                      const double* wait_s, int n) {
+  std::vector<double> w(wait_s, wait_s + (n > 0 ? n : 0));
+  static_cast<htpu::FleetPolicy*>(policy)->ObserveTickSet(set, w);
+}
+
+HTPU_API double htpu_policy_ewma_set(void* policy, int set, int proc) {
+  return static_cast<htpu::FleetPolicy*>(policy)->ewma_set(set, proc);
+}
+
+HTPU_API int htpu_policy_consecutive_slow_set(void* policy, int set,
+                                              int proc) {
+  return static_cast<htpu::FleetPolicy*>(policy)->consecutive_slow_set(set,
+                                                                       proc);
+}
+
+HTPU_API int htpu_policy_next_eviction_set(void* policy, int set,
+                                           int process_count,
+                                           int seat_available) {
+  return static_cast<htpu::FleetPolicy*>(policy)->NextEvictionSet(
+      set, process_count, seat_available != 0);
+}
+
+// ------------------------------------------------------------- process sets
+
+// Standalone handle over htpu::ProcessSetTable (process_set.h) for the
+// Python mirror and the parity tests.  The coordinator's embedded
+// instance (HOROVOD_TPU_PROCESS_SETS) is driven through htpu_control_tick
+// via set-tagged request frames, not through these endpoints.
+
+HTPU_API void* htpu_process_sets_create(long long cache_capacity) {
+  return new htpu::ProcessSetTable(cache_capacity);
+}
+
+HTPU_API void htpu_process_sets_destroy(void* ps) {
+  delete static_cast<htpu::ProcessSetTable*>(ps);
+}
+
+// 1 on success, 0 on a malformed spec (earlier sets stay registered).
+HTPU_API int htpu_process_sets_parse_spec(void* ps, const char* spec) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->ParseSpec(spec ? spec : "")
+             ? 1
+             : 0;
+}
+
+// New set id (>= 1), or -1 on invalid input.
+HTPU_API int htpu_process_sets_add(void* ps, const char* name,
+                                   const int* ranks, int n) {
+  std::vector<int32_t> r(ranks, ranks + (n > 0 ? n : 0));
+  return static_cast<htpu::ProcessSetTable*>(ps)->Add(name ? name : "", r);
+}
+
+HTPU_API int htpu_process_sets_remove(void* ps, int id) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->Remove(id) ? 1 : 0;
+}
+
+HTPU_API int htpu_process_sets_id_of(void* ps, const char* name) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->IdOf(name ? name : "");
+}
+
+HTPU_API int htpu_process_sets_count(void* ps) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->Count();
+}
+
+HTPU_API int htpu_process_sets_size(void* ps, int id) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->SizeOf(id);
+}
+
+HTPU_API int htpu_process_sets_local_rank(void* ps, int id, int global_rank) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->LocalRank(id, global_rank);
+}
+
+HTPU_API int htpu_process_sets_generation(void* ps, int id) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->Generation(id);
+}
+
+// Per-set elastic shrink: new generation, or -1 on unknown set/rank.
+HTPU_API int htpu_process_sets_reconfigure(void* ps, int id,
+                                           int lost_global_rank) {
+  return static_cast<htpu::ProcessSetTable*>(ps)->Reconfigure(
+      id, lost_global_rank);
+}
+
+// 1 = set ready to construct, 0 = waiting, -1 = parse error, unknown set,
+// or set-local rank out of range.  Same single-message boundary format as
+// htpu_table_increment (always with_algo; the set id is the explicit arg,
+// never re-read from the frame).
+HTPU_API int htpu_process_sets_increment(void* ps, int id,
+                                         const void* req_bytes, int len) {
+  htpu::Request req;
+  size_t pos = 0;
+  if (!htpu::ParseRequest(static_cast<const uint8_t*>(req_bytes), size_t(len),
+                          &pos, &req, /*with_algo=*/true) ||
+      pos != size_t(len)) {
+    return -1;
+  }
+  req.process_set = id;
+  return static_cast<htpu::ProcessSetTable*>(ps)->Increment(id, req);
+}
+
+// Serialized Response into *out; returns its length (>=0) or -1.
+HTPU_API int htpu_process_sets_construct(void* ps, int id, const char* name,
+                                         void** out) {
+  htpu::Response resp;
+  if (!static_cast<htpu::ProcessSetTable*>(ps)->Construct(id, name, &resp)) {
+    return -1;
+  }
+  std::string buf;
+  htpu::SerializeResponse(resp, &buf, /*with_algo=*/true);
+  return CopyOut(buf, out);
 }
 
 }  // extern "C"

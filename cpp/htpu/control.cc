@@ -288,6 +288,13 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
   if (process_index == 0) {
     cp->table_.reset(new MessageTable(nranks_total));
     cp->cache_.reset(new ResponseCache(cache_cap, process_count));
+    // Non-default process sets registered at init ("name:0,1;name2:2,3").
+    // A malformed spec fails Create loudly instead of silently dropping a
+    // tenant — the coordinator is the one place the registry must exist.
+    cp->process_sets_.reset(new ProcessSetTable(cache_cap));
+    if (const char* e = getenv("HOROVOD_TPU_PROCESS_SETS")) {
+      if (!cp->process_sets_->ParseSpec(e)) return nullptr;
+    }
     if (process_count > 1) {
       cp->listen_fd_ = Listen(coord_port, nullptr);
       if (cp->listen_fd_ < 0) return nullptr;
@@ -868,6 +875,10 @@ void ControlPlane::CompressRequestFrame(const std::string& in,
   std::vector<std::string> order;
   std::unordered_map<std::string, std::string> sigs;
   for (const Request& r : list.requests) {
+    // Set-tagged requests never cache: the hit signature omits the set id,
+    // so a non-default request could false-hit a default slot of the same
+    // name.  They always travel as full requests.
+    if (r.process_set != 0) continue;
     auto ins = sigs.emplace(r.tensor_name, std::string());
     if (ins.second) order.push_back(r.tensor_name);
     // with_algo: an algorithm-preference change must miss (and later
@@ -900,7 +911,7 @@ void ControlPlane::CompressRequestFrame(const std::string& in,
   // determinism); hit names compress to bits and are remembered for a
   // flush-triggered resend.
   for (Request& r : list.requests) {
-    if (hit_names.count(r.tensor_name)) {
+    if (r.process_set == 0 && hit_names.count(r.tensor_name)) {
       cache_compressed_in_flight_.push_back(std::move(r));
     } else {
       outl.requests.push_back(std::move(r));
@@ -1201,7 +1212,30 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       }
     }
   }
-  if (abort_rank < 0) ObserveGatherSkew(arrival_us, have_arrival);
+  if (abort_rank < 0) {
+    // Straggler attribution per tenant: a process whose frame carried
+    // ONLY one non-default set's requests spent this tick in that set's
+    // collectives, so its imposed wait lands on that set's EWMA.  Cache
+    // bits are default-set traffic (set-tagged requests never cache), so
+    // their presence pins the process to the default set.
+    std::vector<int32_t> set_attr(size_t(process_count_), 0);
+    for (int p = 0; p < process_count_; ++p) {
+      const RequestList& f = frames[size_t(p)];
+      if (f.requests.empty()) continue;
+      if (f.has_cache_ext && !f.cache_bits.empty()) continue;
+      const int32_t s = f.requests[0].process_set;
+      if (s == 0) continue;
+      bool all_in_set = true;
+      for (const Request& r : f.requests) {
+        if (r.process_set != s) {
+          all_in_set = false;
+          break;
+        }
+      }
+      if (all_in_set) set_attr[size_t(p)] = s;
+    }
+    ObserveGatherSkew(arrival_us, have_arrival, set_attr);
+  }
   {
     auto gather_t1 = std::chrono::steady_clock::now();
     Metrics::Get().Observe(
@@ -1451,8 +1485,37 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   std::unordered_map<std::string, std::vector<std::vector<Request>>> contrib;
   std::vector<std::string> ready_ok;   // non-ERROR completions, in order
   std::unordered_map<std::string, Request> first_request;
+  // Non-default-set responses, kept out of PlanTick (fusion never merges
+  // across tenants) and appended unfused after the default set's plan.
+  std::vector<Response> set_responses;
   for (size_t qi = 0; qi < all_requests.size(); ++qi) {
     const Request& r = all_requests[qi];
+    if (r.process_set != 0) {
+      // Route to the set's own MessageTable.  Set-tagged requests never
+      // enter first_request / contrib: a tenant reusing a default-set
+      // tensor name must not corrupt the default plan's size/dtype lookups
+      // or earn the name a cache slot built from foreign requests.
+      const int rc =
+          process_sets_ ? process_sets_->Increment(r.process_set, r) : -1;
+      if (rc < 0) {
+        Response err;
+        err.response_type = ResponseType::ERROR;
+        err.tensor_names = {r.tensor_name};
+        err.error_message = "Request rank out of range.";
+        err.process_set = r.process_set;
+        set_responses.push_back(std::move(err));
+      } else if (rc == 1) {
+        Response resp;
+        if (process_sets_->Construct(r.process_set, r.tensor_name, &resp)) {
+          FlightRecorder::Get().Record(
+              resp.response_type == ResponseType::ERROR ? "response.error"
+                                                        : "response.ready",
+              r.tensor_name.c_str(), r.process_set, r.request_rank);
+          set_responses.push_back(std::move(resp));
+        }
+      }
+      continue;
+    }
     first_request.emplace(r.tensor_name, r);
     if (track_cache) {
       auto& c = contrib[r.tensor_name];
@@ -1521,6 +1584,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   };
   out.responses =
       PlanTick(out.responses, entry_bytes, entry_dtype, fusion_threshold);
+  for (auto& r : set_responses) out.responses.push_back(std::move(r));
   Metrics::Get().SetGauge("control.pending_tensors",
                           static_cast<double>(table_->NumPending()));
 
@@ -2408,7 +2472,8 @@ void ControlPlane::NoteClockSample(int proc, int64_t t1_us,
 
 void ControlPlane::ObserveGatherSkew(
     const std::vector<int64_t>& arrival_us,
-    const std::vector<bool>& have_arrival) {
+    const std::vector<bool>& have_arrival,
+    const std::vector<int32_t>& set_attr) {
   if (process_count_ < 2) return;
   std::vector<int64_t> vals;
   vals.reserve(arrival_us.size());
@@ -2449,7 +2514,7 @@ void ControlPlane::ObserveGatherSkew(
   if (policy_ != nullptr) {
     // Same per-tick imposed-wait samples feed the fleet policy's EWMAs;
     // the smoothed view is published per rank for offline tuning.
-    policy_->ObserveTick(tick_count_, wait_s);
+    policy_->ObserveTick(tick_count_, wait_s, set_attr);
     for (size_t p = 0; p < wait_s.size(); ++p) {
       double ew = policy_->ewma(int(p));
       if (ew < 0) continue;

@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "htpu/message_table.h"
+#include "htpu/process_set.h"
 #include "htpu/wire.h"
 
 namespace htpu {
@@ -296,8 +297,12 @@ class ControlPlane {
   // Per-tick request-ready skew: arrival_us[p] is process p's request
   // send stamp mapped onto the coordinator clock; observes
   // control.gather_skew_seconds#rank= lateness-vs-median histograms.
+  // set_attr[p] names the process set process p's tick was spent in
+  // (0 = default) for per-tenant straggler attribution in the fleet
+  // policy; empty means all default.
   void ObserveGatherSkew(const std::vector<int64_t>& arrival_us,
-                         const std::vector<bool>& have_arrival);
+                         const std::vector<bool>& have_arrival,
+                         const std::vector<int32_t>& set_attr);
 
   int process_index_ = 0;
   int process_count_ = 0;
@@ -403,6 +408,11 @@ class ControlPlane {
   std::vector<std::string> offset_names_;
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
+  // Non-default process sets (HOROVOD_TPU_PROCESS_SETS), coordinator only.
+  // Each owns its MessageTable + ResponseCache; set-tagged requests route
+  // here instead of table_, so disjoint tenants negotiating on the shared
+  // tick never cross-talk.
+  std::unique_ptr<ProcessSetTable> process_sets_;
   std::atomic<Timeline*> timeline_{nullptr};  // not owned
   std::unordered_set<std::string> negotiating_;   // timeline span state
 

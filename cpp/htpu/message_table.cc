@@ -208,11 +208,18 @@ Response MessageTable::ConstructResponse(const std::string& name) {
   }
 
   // Negotiation latency: first request seen -> response constructed.
-  Metrics::Get().Observe(
-      "control.negotiate_seconds",
+  // Per-set tables slice the series by tenant so one set's stalls never
+  // blur another's latency profile.
+  const double negotiate_s =
       std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                     it->second.first_seen)
-          .count());
+          .count();
+  if (metric_tag_.empty()) {
+    Metrics::Get().Observe("control.negotiate_seconds", negotiate_s);
+  } else {
+    Metrics::Get().Observe(
+        "control.negotiate_seconds#process_set=" + metric_tag_, negotiate_s);
+  }
 
   table_.erase(it);
 

@@ -49,6 +49,11 @@ class MessageTable {
     algo_crossover_bytes_ = crossover_bytes;
   }
 
+  // Per-tenant metric slice: a non-empty tag records negotiation latency
+  // under control.negotiate_seconds#process_set=<tag> instead of the
+  // untagged default-set series.
+  void SetMetricTag(const std::string& tag) { metric_tag_ = tag; }
+
   // Record one rank's request; returns true when all ranks have reported
   // for this tensor name.
   bool Increment(const Request& msg);
@@ -77,6 +82,7 @@ class MessageTable {
   int algo_num_hosts_ = 1;
   int algo_num_procs_ = 1;
   int64_t algo_crossover_bytes_ = kDefaultAlgoCrossoverBytes;
+  std::string metric_tag_;
   std::unordered_map<std::string, Entry> table_;
 };
 

@@ -84,12 +84,12 @@ bool FleetPolicy::ParseAutoscaleScript(
   return true;
 }
 
-void FleetPolicy::ObserveTick(uint64_t /*tick*/,
-                              const std::vector<double>& wait_s) {
-  if (procs_.size() < wait_s.size()) procs_.resize(wait_s.size());
+void FleetPolicy::UpdateSet(std::vector<ProcState>* procs,
+                            const std::vector<double>& wait_s) {
+  if (procs->size() < wait_s.size()) procs->resize(wait_s.size());
   for (size_t p = 0; p < wait_s.size(); ++p) {
     if (wait_s[p] < 0) continue;   // no sample this gather
-    ProcState& ps = procs_[p];
+    ProcState& ps = (*procs)[p];
     ps.ewma = ps.valid ? alpha_ * wait_s[p] + (1.0 - alpha_) * ps.ewma
                        : wait_s[p];
     ps.valid = true;
@@ -102,7 +102,7 @@ void FleetPolicy::ObserveTick(uint64_t /*tick*/,
   // never nominates anyone — skew is a property of one host, load is a
   // property of the job.
   std::vector<double> ew;
-  for (const ProcState& ps : procs_) {
+  for (const ProcState& ps : *procs) {
     if (ps.valid) ew.push_back(ps.ewma);
   }
   if (ew.size() < 2) return;
@@ -113,7 +113,7 @@ void FleetPolicy::ObserveTick(uint64_t /*tick*/,
                                      ew.begin() + long(ew.size() / 2));
     median = (median + lower) / 2.0;
   }
-  for (ProcState& ps : procs_) {
+  for (ProcState& ps : *procs) {
     if (!ps.valid) continue;
     if (ps.ewma - median > threshold_s_) {
       ++ps.consecutive;
@@ -126,14 +126,44 @@ void FleetPolicy::ObserveTick(uint64_t /*tick*/,
   }
 }
 
-int FleetPolicy::NextEviction(int process_count, bool seat_available) {
+void FleetPolicy::ObserveTick(uint64_t /*tick*/,
+                              const std::vector<double>& wait_s,
+                              const std::vector<int32_t>& set_attr) {
+  // Partition this gather's samples by attributed set.  The default set's
+  // pass always runs (so its consecutive-slow windows keep their
+  // every-gather cadence); a non-default set runs only on ticks that
+  // attributed it a sample.
+  std::map<int32_t, std::vector<double>> per_set;
+  std::vector<double>& dflt = per_set[0];
+  dflt.assign(wait_s.size(), -1.0);
+  for (size_t p = 0; p < wait_s.size(); ++p) {
+    const int32_t set =
+        p < set_attr.size() && set_attr[p] > 0 ? set_attr[p] : 0;
+    if (set == 0) {
+      dflt[p] = wait_s[p];
+      continue;
+    }
+    auto& v = per_set[set];
+    if (v.empty()) v.assign(wait_s.size(), -1.0);
+    v[p] = wait_s[p];
+  }
+  for (auto& kv : per_set) UpdateSet(&sets_[kv.first], kv.second);
+}
+
+void FleetPolicy::ObserveTickSet(int32_t set,
+                                 const std::vector<double>& wait_s) {
+  UpdateSet(&sets_[set], wait_s);
+}
+
+int FleetPolicy::NominateIn(int32_t set, std::vector<ProcState>* procs,
+                            int process_count, bool seat_available) {
   if (!evict_enabled()) return -1;
   int candidate = -1;
   double worst = 0.0;
   // Process 0 IS the coordinator — never a candidate (failover, not
   // eviction, handles a slow coordinator).
-  for (int p = 1; p < process_count && size_t(p) < procs_.size(); ++p) {
-    const ProcState& ps = procs_[size_t(p)];
+  for (int p = 1; p < process_count && size_t(p) < procs->size(); ++p) {
+    const ProcState& ps = (*procs)[size_t(p)];
     if (!ps.valid || ps.consecutive < evict_ticks_) continue;
     if (candidate < 0 || ps.ewma > worst) {
       candidate = p;
@@ -153,13 +183,13 @@ int FleetPolicy::NextEviction(int process_count, bool seat_available) {
     // slow episode so a chronically slow fleet doesn't flood the log.
     Metrics::Get().Counter("policy.evictions_suppressed")
         ->fetch_add(1, std::memory_order_relaxed);
-    ProcState& ps = procs_[size_t(candidate)];
+    ProcState& ps = (*procs)[size_t(candidate)];
     if (!ps.suppress_logged) {
       ps.suppress_logged = true;
       fprintf(stderr,
               "htpu policy: NOT evicting straggler process %d "
-              "(ewma_wait=%.1fms > threshold for %d ticks): %s\n",
-              candidate, ps.ewma * 1e3, ps.consecutive, why);
+              "(set %d, ewma_wait=%.1fms > threshold for %d ticks): %s\n",
+              candidate, set, ps.ewma * 1e3, ps.consecutive, why);
     }
     return -1;
   }
@@ -167,17 +197,30 @@ int FleetPolicy::NextEviction(int process_count, bool seat_available) {
   return candidate;
 }
 
+int FleetPolicy::NextEviction(int process_count, bool seat_available) {
+  return NominateIn(0, &sets_[0], process_count, seat_available);
+}
+
+int FleetPolicy::NextEvictionSet(int32_t set, int process_count,
+                                 bool seat_available) {
+  return NominateIn(set, &sets_[set], process_count, seat_available);
+}
+
 std::vector<int> FleetPolicy::RerankOrder(
     const std::vector<int>& old_pidx) const {
   std::vector<int> order = old_pidx;
   if (!rerank_enabled()) return order;
+  auto it = sets_.find(0);
+  if (it == sets_.end()) return order;
+  const std::vector<ProcState>& procs = it->second;
   // Bucket to whole milliseconds so sub-noise EWMA differences cannot
   // perturb a uniform fleet; the stable sort keeps the PR 9 dense order
-  // within a bucket, so "no straggler" reduces to the identity.
-  std::stable_sort(order.begin(), order.end(), [this](int a, int b) {
-    auto bucket = [this](int p) {
-      return size_t(p) < procs_.size() && procs_[size_t(p)].valid
-                 ? (long long)(procs_[size_t(p)].ewma * 1e3)
+  // within a bucket, so "no straggler" reduces to the identity.  Ring
+  // order is pod-global, so only the default set's EWMAs drive it.
+  std::stable_sort(order.begin(), order.end(), [&procs](int a, int b) {
+    auto bucket = [&procs](int p) {
+      return size_t(p) < procs.size() && procs[size_t(p)].valid
+                 ? (long long)(procs[size_t(p)].ewma * 1e3)
                  : 0LL;
     };
     return bucket(a) < bucket(b);
@@ -203,24 +246,35 @@ int FleetPolicy::AutoscaleTarget(uint64_t tick) {
 
 void FleetPolicy::OnReconfigure(const std::vector<int>& old_to_new,
                                 int new_count) {
-  std::vector<ProcState> next(static_cast<size_t>(new_count));
-  for (size_t p = 0; p < old_to_new.size() && p < procs_.size(); ++p) {
-    int np = old_to_new[p];
-    if (np >= 0 && np < new_count) next[size_t(np)] = procs_[p];
+  // Process indices are pod-global in every set's state vector, so one
+  // membership change remaps them all.
+  for (auto& kv : sets_) {
+    std::vector<ProcState>& procs = kv.second;
+    std::vector<ProcState> next(static_cast<size_t>(new_count));
+    for (size_t p = 0; p < old_to_new.size() && p < procs.size(); ++p) {
+      int np = old_to_new[p];
+      if (np >= 0 && np < new_count) next[size_t(np)] = procs[p];
+    }
+    procs = std::move(next);
   }
-  procs_ = std::move(next);
 }
 
-double FleetPolicy::ewma(int proc) const {
-  return proc >= 0 && size_t(proc) < procs_.size() &&
-                 procs_[size_t(proc)].valid
-             ? procs_[size_t(proc)].ewma
+double FleetPolicy::ewma_set(int32_t set, int proc) const {
+  auto it = sets_.find(set);
+  if (it == sets_.end()) return -1.0;
+  const std::vector<ProcState>& procs = it->second;
+  return proc >= 0 && size_t(proc) < procs.size() &&
+                 procs[size_t(proc)].valid
+             ? procs[size_t(proc)].ewma
              : -1.0;
 }
 
-int FleetPolicy::consecutive_slow(int proc) const {
-  return proc >= 0 && size_t(proc) < procs_.size()
-             ? procs_[size_t(proc)].consecutive
+int FleetPolicy::consecutive_slow_set(int32_t set, int proc) const {
+  auto it = sets_.find(set);
+  if (it == sets_.end()) return 0;
+  const std::vector<ProcState>& procs = it->second;
+  return proc >= 0 && size_t(proc) < procs.size()
+             ? procs[size_t(proc)].consecutive
              : 0;
 }
 

@@ -31,6 +31,7 @@
 #define HTPU_POLICY_H_
 
 #include <cstdint>
+#include <map>
 #include <string>
 #include <utility>
 #include <vector>
@@ -61,14 +62,33 @@ class FleetPolicy {
   // seconds (lateness past the fleet median, clamped at 0 — exactly the
   // control.gather_skew_seconds sample), or < 0 when p had no sample
   // this tick.  Updates EWMAs and the consecutive-slow counters.
-  void ObserveTick(uint64_t tick, const std::vector<double>& wait_s);
+  // `set_attr` (optional) names the process set each process's tick was
+  // spent in: a process whose requests this tick were ALL tagged with one
+  // non-default set has its sample bucketed under that set's EWMA state
+  // instead of the default set's — a rank slow in one tenant's
+  // collectives must never be nominated for eviction from another's.
+  // Empty attribution (or any entry 0) is the default set, bit-identical
+  // to the pre-set behavior.
+  void ObserveTick(uint64_t tick, const std::vector<double>& wait_s,
+                   const std::vector<int32_t>& set_attr =
+                       std::vector<int32_t>());
+
+  // Feed one wait vector directly into `set`'s state (tests + C API).
+  void ObserveTickSet(int32_t set, const std::vector<double>& wait_s);
 
   // Eviction decision for this tick: the process index to demote, or -1.
-  // `seat_available` says the eviction can proceed without quorum risk
-  // (a spare is parked, or shrinking stays above the rank floor); a
-  // candidate without a seat — or past the eviction budget — is
-  // suppressed: counted, logged once, never acted on.
+  // Reads the DEFAULT set's EWMA state only — pod-level eviction acts on
+  // pod-level (default-set) slowness.  `seat_available` says the eviction
+  // can proceed without quorum risk (a spare is parked, or shrinking
+  // stays above the rank floor); a candidate without a seat — or past
+  // the eviction budget — is suppressed: counted, logged once, never
+  // acted on.
   int NextEviction(int process_count, bool seat_available);
+
+  // Per-set eviction candidate (per-set reconfigure decisions): same
+  // nomination logic over `set`'s EWMA state, sharing the global
+  // eviction budget.
+  int NextEvictionSet(int32_t set, int process_count, bool seat_available);
 
   // Survivor ordering for CoordinateReconfigure: `old_pidx` lists the
   // surviving non-coordinator process indices in their PR 9 dense order;
@@ -87,12 +107,16 @@ class FleetPolicy {
 
   // A reconfigure happened: remap per-process EWMA state through
   // old_to_new (old process index -> new, or -1 when evicted/parked).
-  // Newly admitted processes start with no history.
+  // Newly admitted processes start with no history.  Every set's state
+  // remaps — process indices are pod-global in all sets.
   void OnReconfigure(const std::vector<int>& old_to_new, int new_count);
 
-  // Introspection (metrics, logging, the C API mirror).
-  double ewma(int proc) const;
-  int consecutive_slow(int proc) const;
+  // Introspection (metrics, logging, the C API mirror).  The unsuffixed
+  // forms read the default set.
+  double ewma(int proc) const { return ewma_set(0, proc); }
+  int consecutive_slow(int proc) const { return consecutive_slow_set(0, proc); }
+  double ewma_set(int32_t set, int proc) const;
+  int consecutive_slow_set(int32_t set, int proc) const;
   double threshold_s() const { return threshold_s_; }
   int evict_ticks() const { return evict_ticks_; }
   int evict_max() const { return evict_max_; }
@@ -114,6 +138,13 @@ class FleetPolicy {
     bool suppress_logged = false;
   };
 
+  // EWMA + consecutive-slow pass over one set's state vector.
+  void UpdateSet(std::vector<ProcState>* procs,
+                 const std::vector<double>& wait_s);
+  // Shared nomination logic (candidate scan + budget/seat suppression).
+  int NominateIn(int32_t set, std::vector<ProcState>* procs,
+                 int process_count, bool seat_available);
+
   double threshold_s_ = 0.0;   // HOROVOD_TPU_EVICT_THRESHOLD (0 = off)
   int evict_ticks_ = 5;        // HOROVOD_TPU_EVICT_TICKS
   int evict_max_ = 1;          // HOROVOD_TPU_EVICT_MAX
@@ -121,8 +152,10 @@ class FleetPolicy {
   double alpha_ = 0.2;         // EWMA smoothing factor (fixed)
   std::vector<std::pair<uint64_t, int>> schedule_;   // sorted by tick
   std::string autoscale_file_;   // HOROVOD_TPU_AUTOSCALE_FILE
-  std::vector<ProcState> procs_;
-  int evictions_ = 0;
+  // Per-process straggler state keyed by process set (0 = default/pod).
+  // Pod-level decisions (NextEviction, RerankOrder) read set 0 only.
+  std::map<int32_t, std::vector<ProcState>> sets_;
+  int evictions_ = 0;   // global budget, shared across all sets
 };
 
 }  // namespace htpu

@@ -1,0 +1,132 @@
+#include "htpu/process_set.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <set>
+#include <stdexcept>
+
+namespace htpu {
+
+bool ProcessSetTable::ParseSpec(const std::string& spec) {
+  if (spec.empty()) return true;
+  size_t start = 0;
+  while (start <= spec.size()) {
+    size_t end = spec.find(';', start);
+    if (end == std::string::npos) end = spec.size();
+    const std::string part = spec.substr(start, end - start);
+    start = end + 1;
+    if (part.empty()) continue;
+    const size_t colon = part.find(':');
+    if (colon == std::string::npos || colon == 0) return false;
+    const std::string name = part.substr(0, colon);
+    std::vector<int32_t> ranks;
+    size_t p = colon + 1;
+    while (p <= part.size()) {
+      size_t q = part.find(',', p);
+      if (q == std::string::npos) q = part.size();
+      const std::string tok = part.substr(p, q - p);
+      p = q + 1;
+      if (tok.empty()) return false;
+      char* endp = nullptr;
+      long v = strtol(tok.c_str(), &endp, 10);
+      if (endp == nullptr || *endp != '\0' || v < 0) return false;
+      ranks.push_back(int32_t(v));
+      if (q == part.size()) break;
+    }
+    if (Add(name, ranks) < 0) return false;
+  }
+  return true;
+}
+
+int32_t ProcessSetTable::Add(const std::string& name,
+                             const std::vector<int32_t>& ranks) {
+  if (name.empty() || ranks.empty()) return -1;
+  std::set<int32_t> uniq(ranks.begin(), ranks.end());
+  if (uniq.size() != ranks.size()) return -1;
+  std::lock_guard<std::mutex> g(mu_);
+  for (const auto& kv : sets_)
+    if (kv.second.name == name) return -1;
+  const int32_t id = next_id_++;
+  ProcessSet& ps = sets_[id];
+  ps.id = id;
+  ps.name = name;
+  ps.ranks.assign(uniq.begin(), uniq.end());
+  ps.table.reset(new MessageTable(int(ps.ranks.size())));
+  ps.table->SetMetricTag(name);
+  ps.cache.reset(new ResponseCache(cache_capacity_, int(ps.ranks.size())));
+  return id;
+}
+
+bool ProcessSetTable::Remove(int32_t id) {
+  std::lock_guard<std::mutex> g(mu_);
+  return sets_.erase(id) > 0;
+}
+
+int32_t ProcessSetTable::IdOf(const std::string& name) const {
+  std::lock_guard<std::mutex> g(mu_);
+  for (const auto& kv : sets_)
+    if (kv.second.name == name) return kv.first;
+  return -1;
+}
+
+int32_t ProcessSetTable::Count() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return int32_t(sets_.size());
+}
+
+int32_t ProcessSetTable::SizeOf(int32_t id) const {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  return it == sets_.end() ? -1 : int32_t(it->second.ranks.size());
+}
+
+int32_t ProcessSetTable::LocalRank(int32_t id, int32_t global_rank) const {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  return it == sets_.end() ? -1 : it->second.LocalRank(global_rank);
+}
+
+int32_t ProcessSetTable::Generation(int32_t id) const {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  return it == sets_.end() ? -1 : it->second.generation;
+}
+
+int32_t ProcessSetTable::Reconfigure(int32_t id, int32_t lost_global_rank) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  if (it == sets_.end()) return -1;
+  ProcessSet& ps = it->second;
+  auto pos = std::find(ps.ranks.begin(), ps.ranks.end(), lost_global_rank);
+  if (pos == ps.ranks.end()) return -1;
+  ps.ranks.erase(pos);
+  // Set-local ranks shifted: stale half-negotiated entries and cached
+  // slots would index the wrong member, so both reset with the epoch.
+  ps.table.reset(new MessageTable(int(ps.ranks.size())));
+  ps.table->SetMetricTag(ps.name);
+  ps.cache.reset(new ResponseCache(cache_capacity_, int(ps.ranks.size())));
+  return ++ps.generation;
+}
+
+int ProcessSetTable::Increment(int32_t id, const Request& r) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  if (it == sets_.end()) return -1;
+  try {
+    return it->second.table->Increment(r) ? 1 : 0;
+  } catch (const std::out_of_range&) {
+    return -1;
+  }
+}
+
+bool ProcessSetTable::Construct(int32_t id, const std::string& name,
+                                Response* out) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = sets_.find(id);
+  if (it == sets_.end()) return false;
+  *out = it->second.table->ConstructResponse(name);
+  out->process_set = id;
+  return true;
+}
+
+}  // namespace htpu

@@ -1,0 +1,98 @@
+// Multi-tenant process sets: named communicators with their own
+// negotiation namespace.
+//
+// Horovod's process-set API (horovod/common/process_set.{h,cc}) lets
+// training, eval and auxiliary jobs share one pod without stepping on each
+// other's collectives.  This re-implementation scopes the coordinator's
+// negotiation state per set: each ProcessSet owns its MessageTable (sized
+// to the set, indexed by SET-LOCAL rank), its ResponseCache slots, and a
+// membership generation that advances on per-set reconfiguration — losing
+// a rank reconfigures that set, never the pod.  Set 0 is the implicit
+// default/world set and lives outside this table (the control plane's
+// existing table_/cache_ members), so default-only jobs are untouched.
+//
+// Thread safety: the table is mutex-guarded so a coordinator tick can
+// negotiate on one set while another thread registers or tears down a
+// different set (the asan/tsan smoke drives exactly that shape).
+#ifndef HTPU_PROCESS_SET_H_
+#define HTPU_PROCESS_SET_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "htpu/message_table.h"
+#include "htpu/wire.h"
+
+namespace htpu {
+
+// One named communicator over a subset of global ranks.
+struct ProcessSet {
+  int32_t id = 0;
+  std::string name;
+  std::vector<int32_t> ranks;   // member global ranks, ascending
+  int32_t generation = 0;       // bumped by per-set reconfiguration
+  std::unique_ptr<MessageTable> table;
+  std::unique_ptr<ResponseCache> cache;
+
+  int32_t LocalRank(int32_t global_rank) const {
+    for (size_t i = 0; i < ranks.size(); ++i)
+      if (ranks[i] == global_rank) return int32_t(i);
+    return -1;
+  }
+};
+
+// Registry of non-default process sets (ids start at 1; 0 is reserved for
+// the default/world set, which the control plane owns directly).
+class ProcessSetTable {
+ public:
+  explicit ProcessSetTable(int64_t cache_capacity = 0)
+      : cache_capacity_(cache_capacity) {}
+
+  // Parse "name:0,1;name2:2,3" (the HOROVOD_TPU_PROCESS_SETS format) into
+  // registered sets; returns false (leaving earlier sets registered) on a
+  // malformed spec.
+  bool ParseSpec(const std::string& spec);
+
+  // Register a set; returns the new id, or -1 on invalid input (empty
+  // membership, duplicate global rank, or duplicate name).
+  int32_t Add(const std::string& name, const std::vector<int32_t>& ranks);
+
+  // Tear a set down; true if it existed.  Safe concurrently with ticks —
+  // in-flight requests for the removed set error out at routing.
+  bool Remove(int32_t id);
+
+  int32_t IdOf(const std::string& name) const;
+  int32_t Count() const;                  // registered non-default sets
+  int32_t SizeOf(int32_t id) const;       // member count, -1 if unknown
+  int32_t LocalRank(int32_t id, int32_t global_rank) const;
+  int32_t Generation(int32_t id) const;
+
+  // Per-set elastic reconfiguration: drop `lost_global_rank` from the
+  // set's membership, clear its negotiation state (stale per-set-local
+  // ranks would corrupt later negotiations), and bump the generation.
+  // Returns the new generation, or -1 if the set or rank is unknown.
+  int32_t Reconfigure(int32_t id, int32_t lost_global_rank);
+
+  // Route one request into its set's table; returns 1 when the set is
+  // ready to construct, 0 when still waiting, -1 on an unknown set or a
+  // set-local rank out of range.
+  int Increment(int32_t id, const Request& r);
+
+  // Construct the set's response for `name` (Increment returned 1).
+  // False on an unknown set.  The response's process_set is stamped.
+  bool Construct(int32_t id, const std::string& name, Response* out);
+
+ private:
+  mutable std::mutex mu_;
+  int64_t cache_capacity_ = 0;
+  int32_t next_id_ = 1;
+  std::map<int32_t, ProcessSet> sets_;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_PROCESS_SET_H_

@@ -32,6 +32,7 @@
 #include "htpu/flight_recorder.h"
 #include "htpu/metrics.h"
 #include "htpu/policy.h"
+#include "htpu/process_set.h"
 #include "htpu/scheduler.h"
 #include "htpu/wire.h"
 
@@ -739,11 +740,99 @@ int RunFleetPolicyPhase() {
   return rc;
 }
 
+// Process-set phase: the multi-tenant registry under the sanitizers in
+// its live shape — two disjoint tenants negotiating concurrently from
+// separate threads against the mutex-guarded ProcessSetTable, with a
+// mid-flight teardown of one set (the dynamic remove_process_set path).
+// TSan proves negotiation on set A never races registration state
+// changes on set B; ASan the per-set table/cache lifecycle across the
+// teardown.
+int RunProcessSetPhase() {
+  htpu::ProcessSetTable sets(/*cache_capacity=*/8);
+  if (!sets.ParseSpec("tenantA:0,1;tenantB:2,3")) {
+    fprintf(stderr, "smoke: process-set spec rejected\n");
+    return 1;
+  }
+  if (sets.ParseSpec("missing-colon")) {
+    fprintf(stderr, "smoke: malformed process-set spec accepted\n");
+    return 1;
+  }
+  const int32_t a = sets.IdOf("tenantA");
+  const int32_t b = sets.IdOf("tenantB");
+  if (a <= 0 || b <= 0 || a == b || sets.Count() != 2 ||
+      sets.SizeOf(a) != 2 || sets.LocalRank(b, 2) != 0 ||
+      sets.LocalRank(a, 3) != -1 || sets.Add("tenantA", {4}) != -1) {
+    fprintf(stderr, "smoke: process-set registry invariants broken\n");
+    return 1;
+  }
+  std::atomic<bool> bad{false};
+  std::atomic<bool> b_gone{false};
+  // One tenant's negotiation loop: both set-local ranks report each
+  // tensor, then the ready set constructs.  `may_vanish` is the tenant
+  // the main thread tears down mid-flight: its traffic must start
+  // failing cleanly (-1 at routing), never race or construct garbage.
+  auto drive = [&](int32_t id, const char* prefix, bool may_vanish) {
+    for (int round = 0; round < 4000; ++round) {
+      for (int r = 0; r < 2; ++r) {
+        htpu::Request req;
+        req.request_rank = r;   // set-local
+        req.device = r;
+        req.request_type = htpu::RequestType::ALLREDUCE;
+        req.tensor_name = std::string(prefix) + std::to_string(round % 8);
+        req.tensor_type = "float32";
+        req.tensor_shape = {4};
+        req.process_set = id;
+        const int rc = sets.Increment(id, req);
+        if (rc < 0) {
+          if (!may_vanish) bad.store(true);
+          return;
+        }
+        if (rc == 1) {
+          htpu::Response resp;
+          if (!sets.Construct(id, req.tensor_name, &resp)) {
+            if (!may_vanish) bad.store(true);
+            return;
+          }
+          if (resp.response_type == htpu::ResponseType::ERROR ||
+              resp.process_set != id) {
+            bad.store(true);
+            return;
+          }
+        }
+      }
+      if (may_vanish && b_gone.load()) return;
+    }
+  };
+  std::thread ta(drive, a, "tenantA/grad", false);
+  std::thread tb(drive, b, "tenantB/grad", true);
+  std::this_thread::yield();
+  if (!sets.Remove(b)) bad.store(true);   // mid-flight teardown
+  b_gone.store(true);
+  ta.join();
+  tb.join();
+  if (bad.load() || sets.Count() != 1 || sets.IdOf("tenantB") != -1) {
+    fprintf(stderr, "smoke: concurrent process-set negotiation failed\n");
+    return 1;
+  }
+  // Per-set elastic shrink: losing global rank 1 reconfigures tenantA
+  // only — membership drops, the generation advances, and the unknown
+  // rank/set cases stay inert.
+  if (sets.Reconfigure(a, 1) != 1 || sets.SizeOf(a) != 1 ||
+      sets.Generation(a) != 1 || sets.Reconfigure(a, 99) != -1 ||
+      sets.Reconfigure(b, 2) != -1) {
+    fprintf(stderr, "smoke: per-set reconfigure broken\n");
+    return 1;
+  }
+  fprintf(stderr, "smoke: process sets OK (2 tenants, mid-tick teardown)\n");
+  return 0;
+}
+
 }  // namespace
 
 int main() {
   if (RunOverlapPlannerPhase() != 0) return 1;
   if (RunFleetPolicyPhase() != 0) return 1;
+  if (RunProcessSetPhase() != 0) return 1;
   int port = FreePort();
   if (port < 0) {
     fprintf(stderr, "smoke: no free port\n");

@@ -73,9 +73,20 @@ bool AnyAlgo(const Vec& msgs) {
   return false;
 }
 
+// True when some message targets a non-default process set — only then is
+// kFlagSetExt set, so single-tenant traffic stays byte-identical to the
+// pre-set wire format.
+template <typename Vec>
+bool AnySet(const Vec& msgs) {
+  for (const auto& m : msgs)
+    if (m.process_set != 0) return true;
+  return false;
+}
+
 }  // namespace
 
-void SerializeRequest(const Request& r, std::string* out, bool with_algo) {
+void SerializeRequest(const Request& r, std::string* out, bool with_algo,
+                      bool with_set) {
   PutI32(out, r.request_rank);
   PutI32(out, int32_t(r.request_type));
   PutStr(out, r.tensor_name);
@@ -86,10 +97,11 @@ void SerializeRequest(const Request& r, std::string* out, bool with_algo) {
   for (int64_t d : r.tensor_shape) PutI64(out, d);
   PutStr(out, r.wire_dtype);
   if (with_algo) PutStr(out, r.algo);
+  if (with_set) PutI32(out, r.process_set);
 }
 
 bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out,
-                  bool with_algo) {
+                  bool with_algo, bool with_set) {
   int32_t type, ndims;
   if (!GetI32(data, len, pos, &out->request_rank)) return false;
   if (!GetI32(data, len, pos, &type)) return false;
@@ -105,10 +117,13 @@ bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out,
   if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
   out->algo.clear();
   if (with_algo && !GetStr(data, len, pos, &out->algo)) return false;
+  out->process_set = 0;
+  if (with_set && !GetI32(data, len, pos, &out->process_set)) return false;
   return true;
 }
 
-void SerializeResponse(const Response& r, std::string* out, bool with_algo) {
+void SerializeResponse(const Response& r, std::string* out, bool with_algo,
+                       bool with_set) {
   PutI32(out, int32_t(r.response_type));
   PutI32(out, int32_t(r.tensor_names.size()));
   for (const auto& n : r.tensor_names) PutStr(out, n);
@@ -119,10 +134,11 @@ void SerializeResponse(const Response& r, std::string* out, bool with_algo) {
   for (int64_t s : r.tensor_sizes) PutI64(out, s);
   PutStr(out, r.wire_dtype);
   if (with_algo) PutStr(out, r.algo);
+  if (with_set) PutI32(out, r.process_set);
 }
 
 bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
-                   Response* out, bool with_algo) {
+                   Response* out, bool with_algo, bool with_set) {
   int32_t type, n;
   if (!GetI32(data, len, pos, &type)) return false;
   out->response_type = ResponseType(type);
@@ -142,6 +158,8 @@ bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
   if (!GetStr(data, len, pos, &out->wire_dtype)) return false;
   out->algo.clear();
   if (with_algo && !GetStr(data, len, pos, &out->algo)) return false;
+  out->process_set = 0;
+  if (with_set && !GetI32(data, len, pos, &out->process_set)) return false;
   return true;
 }
 
@@ -152,15 +170,18 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
   // byte-identical to the legacy format (flags byte == shutdown bool).
   out->clear();
   const bool with_algo = AnyAlgo(l.requests);
+  const bool with_set = AnySet(l.requests);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
                 | (with_algo ? kFlagAlgoExt : 0)
-                | (l.has_elastic_ext ? kFlagElasticExt : 0);
+                | (l.has_elastic_ext ? kFlagElasticExt : 0)
+                | (with_set ? kFlagSetExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.requests.size()));
-  for (const auto& r : l.requests) SerializeRequest(r, out, with_algo);
+  for (const auto& r : l.requests)
+    SerializeRequest(r, out, with_algo, with_set);
   if (l.has_cache_ext) {
     PutI32(out, l.cache_epoch);
     PutStr(out, l.cache_bits);
@@ -176,12 +197,14 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   if (flags & ~kKnownFlags) return false;  // newer wire version
   out->shutdown = (flags & kFlagShutdown) != 0;
   const bool with_algo = (flags & kFlagAlgoExt) != 0;
+  const bool with_set = (flags & kFlagSetExt) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->requests.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
-    if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)], with_algo))
+    if (!ParseRequest(data, len, &pos, &out->requests[size_t(i)], with_algo,
+                      with_set))
       return false;
   out->has_cache_ext = (flags & kFlagCacheExt) != 0;
   out->cache_epoch = 0;
@@ -201,15 +224,18 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
 void SerializeResponseList(const ResponseList& l, std::string* out) {
   out->clear();  // whole frame — see SerializeRequestList
   const bool with_algo = AnyAlgo(l.responses);
+  const bool with_set = AnySet(l.responses);
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
                 | (with_algo ? kFlagAlgoExt : 0)
-                | (l.has_elastic_ext ? kFlagElasticExt : 0);
+                | (l.has_elastic_ext ? kFlagElasticExt : 0)
+                | (with_set ? kFlagSetExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
   PutI32(out, int32_t(l.responses.size()));
-  for (const auto& r : l.responses) SerializeResponse(r, out, with_algo);
+  for (const auto& r : l.responses)
+    SerializeResponse(r, out, with_algo, with_set);
   if (l.has_cache_ext) {
     PutI32(out, l.cache_epoch);
     PutI8(out, l.cache_flags);
@@ -257,13 +283,14 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   if (flags & ~kKnownFlags) return false;  // newer wire version
   out->shutdown = (flags & kFlagShutdown) != 0;
   const bool with_algo = (flags & kFlagAlgoExt) != 0;
+  const bool with_set = (flags & kFlagSetExt) != 0;
   if (!GetI32(data, len, &pos, &out->abort_rank)) return false;
   if (!GetStr(data, len, &pos, &out->abort_reason)) return false;
   if (!GetI32(data, len, &pos, &n) || n < 0) return false;
   out->responses.resize(size_t(n));
   for (int32_t i = 0; i < n; ++i)
     if (!ParseResponse(data, len, &pos, &out->responses[size_t(i)],
-                       with_algo))
+                       with_algo, with_set))
       return false;
   out->has_cache_ext = (flags & kFlagCacheExt) != 0;
   out->cache_epoch = 0;

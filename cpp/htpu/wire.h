@@ -11,9 +11,9 @@
 // vec<T> = i32 count + elements.
 //
 // Request  := rank:i32 type:i32 name:str dtype:str root:i32 device:i32
-//             shape:vec<i64> wire_dtype:str [algo:str]
+//             shape:vec<i64> wire_dtype:str [algo:str] [process_set:i32]
 // Response := type:i32 names:vec<str> error:str devices:vec<i32>
-//             sizes:vec<i64> wire_dtype:str [algo:str]
+//             sizes:vec<i64> wire_dtype:str [algo:str] [process_set:i32]
 // RequestList  := flags:i8 abort_rank:i32 abort_reason:str
 //                 requests:vec<Request> [cache_epoch:i32 bits:str]
 //                 [generation:i32]
@@ -63,8 +63,12 @@ constexpr uint8_t kFlagAlgoExt = 0x04;
 // Elastic-membership extension (HOROVOD_TPU_ELASTIC=1 only — non-elastic
 // frames never set the bit, so PR 2 abort traffic stays byte-identical).
 constexpr uint8_t kFlagElasticExt = 0x08;
-constexpr uint8_t kKnownFlags =
-    kFlagShutdown | kFlagCacheExt | kFlagAlgoExt | kFlagElasticExt;
+// Process-set extension: every message in the list carries a trailing
+// process_set:i32 (set only when some message targets a non-default set,
+// so default-set-only traffic stays byte-identical to the pre-set wire).
+constexpr uint8_t kFlagSetExt = 0x10;
+constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt | kFlagAlgoExt |
+                                kFlagElasticExt | kFlagSetExt;
 constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
 constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
 constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits
@@ -93,6 +97,11 @@ struct Request {
   // coordinator per fused payload.  Serialized only when the enclosing
   // list sets kFlagAlgoExt.
   std::string algo;
+  // Process set this request negotiates in (0 = default/world).
+  // Non-default sets carry SET-LOCAL request_rank (device stays the
+  // global rank) and route to that set's message table.  Serialized only
+  // when the enclosing list sets kFlagSetExt.
+  int32_t process_set = 0;
 };
 
 struct Response {
@@ -110,6 +119,9 @@ struct Response {
   // responses with equal algorithms.  Serialized only when the enclosing
   // list sets kFlagAlgoExt.
   std::string algo;
+  // Process set this response belongs to (0 = default/world); receivers
+  // only pop entries whose set matches.  Serialized under kFlagSetExt.
+  int32_t process_set = 0;
 };
 
 struct RequestList {
@@ -186,13 +198,14 @@ struct ResponseList {
 // (the C API's table endpoints) always pass true so the algo survives
 // the ctypes boundary.
 void SerializeRequest(const Request& r, std::string* out,
-                      bool with_algo = false);
+                      bool with_algo = false, bool with_set = false);
 bool ParseRequest(const uint8_t* data, size_t len, size_t* pos, Request* out,
-                  bool with_algo = false);
+                  bool with_algo = false, bool with_set = false);
 void SerializeResponse(const Response& r, std::string* out,
-                       bool with_algo = false);
+                       bool with_algo = false, bool with_set = false);
 bool ParseResponse(const uint8_t* data, size_t len, size_t* pos,
-                   Response* out, bool with_algo = false);
+                   Response* out, bool with_algo = false,
+                   bool with_set = false);
 void SerializeRequestList(const RequestList& l, std::string* out);
 bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out);
 void SerializeResponseList(const ResponseList& l, std::string* out);

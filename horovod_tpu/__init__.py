@@ -72,6 +72,11 @@ from horovod_tpu.ops.eager import (        # noqa: F401
     broadcast_async, poll, synchronize, PerRank, scatter_ranks,
     CollectiveError, HorovodAbortedError, HorovodRetryableError,
 )
+from horovod_tpu.process_set import (      # noqa: F401, E402
+    ProcessSet, add_process_set, remove_process_set, process_set_by_name,
+    reconfigure_process_set,
+)
+from horovod_tpu.publish import ParameterPublisher   # noqa: F401, E402
 from horovod_tpu import elastic            # noqa: F401, E402
 from horovod_tpu.ops import injit          # noqa: F401
 from horovod_tpu.ops.injit import (        # noqa: F401

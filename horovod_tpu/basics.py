@@ -116,6 +116,10 @@ def shutdown() -> None:
         finally:
             from horovod_tpu import metrics as _metrics_mod
             _metrics_mod.stop_exporters()
+            # Registered process sets die with the job — the next init
+            # re-seeds the registry from HOROVOD_TPU_PROCESS_SETS.
+            from horovod_tpu import process_set as _process_set_mod
+            _process_set_mod.reset()
             _state.controller = None
             _state.topology = None
             _state.mesh = None

@@ -366,6 +366,11 @@ class Request:
     # or "auto" for coordinator selection).  Validated across ranks like
     # wire_dtype; resolved to a concrete algorithm in the response.
     algo: str = ""
+    # Process set this request negotiates in (0 = the default/world set).
+    # Non-default sets carry SET-LOCAL request_rank (device stays the
+    # global rank) and route to that set's message table.  Serialized only
+    # when the enclosing list sets FLAG_SET_EXT.
+    process_set: int = 0
 
 
 @dataclasses.dataclass
@@ -386,6 +391,10 @@ class Response:
     # "auto"); fusion only merges responses with equal algorithms, and the
     # response cache replays the resolution byte-exactly.
     algo: str = ""
+    # Process set this response belongs to (0 = default/world).  Receivers
+    # only pop entries whose process_set matches, so two tenants reusing a
+    # tensor name never cross-execute.  Serialized only under FLAG_SET_EXT.
+    process_set: int = 0
 
 
 # --------------------------------------------------------------------------
@@ -835,6 +844,10 @@ class TensorTableEntry:
     # Ring wire compression for the cross-process data plane ("" = raw
     # fp32; "bf16"/"fp16"/"int8").  Negotiated across ranks like dtype.
     wire_dtype: str = ""
+    # Process set this entry negotiates in (0 = default/world).  Set
+    # entries hold one contribution per MEMBER rank this process controls
+    # and execute on the set-scoped host path.
+    process_set: int = 0
 
 
 def cache_capacity_from_env() -> int:
@@ -1195,6 +1208,13 @@ class Controller:
             capacity = cache_capacity_from_env()
             if capacity > 0:
                 self._local_cache = _LocalResponseCache(capacity)
+        # Non-default process sets (multi-tenant negotiation namespaces):
+        # the registry owns each set's scoped MessageTable + cache; the
+        # controller only routes by ``entry.process_set``.  Seeded from
+        # HOROVOD_TPU_PROCESS_SETS so ids agree with the native
+        # coordinator, which parses the same spec (control.cc Create).
+        from horovod_tpu import process_set as _process_set_mod
+        self._process_sets = _process_set_mod.registry()
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: collections.deque = collections.deque()
         self._lock = threading.Lock()
@@ -1310,19 +1330,24 @@ class Controller:
         # reinit); other collectives have a single data-plane path.
         algo = (default_allreduce_algo()
                 if entry.request_type == RequestType.ALLREDUCE else "")
-        requests = []
-        for i, contrib in enumerate(entry.per_rank):
-            requests.append(Request(
-                request_rank=first_rank + i,
-                request_type=entry.request_type,
-                tensor_name=entry.name,
-                tensor_type=np.dtype(contrib.dtype).name,
-                tensor_shape=tuple(contrib.shape),
-                root_rank=entry.root_rank,
-                device=first_rank + i,
-                wire_dtype=entry.wire_dtype,
-                algo=algo,
-            ))
+        requests: List[Request] = []
+        if entry.process_set:
+            err = self._build_set_requests(entry, algo, requests)
+            if err is not None:
+                return err
+        else:
+            for i, contrib in enumerate(entry.per_rank):
+                requests.append(Request(
+                    request_rank=first_rank + i,
+                    request_type=entry.request_type,
+                    tensor_name=entry.name,
+                    tensor_type=np.dtype(contrib.dtype).name,
+                    tensor_shape=tuple(contrib.shape),
+                    root_rank=entry.root_rank,
+                    device=first_rank + i,
+                    wire_dtype=entry.wire_dtype,
+                    algo=algo,
+                ))
         with self._lock:
             # Abort outranks plain shutdown: after a job-wide abort every
             # enqueue fails fast with the ORIGINAL attributed cause, not the
@@ -1344,6 +1369,52 @@ class Controller:
             f"{request_type_name(entry.request_type).lower()},"
             f"dtype={entry.dtype}", len(requests))
         return Status.OK()
+
+    def _build_set_requests(self, entry: TensorTableEntry, algo: str,
+                            requests: List[Request]) -> Optional[Status]:
+        """Requests for a non-default process set: SET-LOCAL request_rank,
+        global rank in ``device`` (so the coordinator's per-set table —
+        sized to the set — indexes correctly while frames stay globally
+        attributable).  Returns an error Status, or None on success."""
+        ps = self._process_sets.get(entry.process_set)
+        if ps is None:
+            return Status.invalid_argument(
+                f"Unknown process set id {entry.process_set} for tensor "
+                f"{entry.name}: register it with hvd.add_process_set() or "
+                "HOROVOD_TPU_PROCESS_SETS (see docs/process-sets.md).")
+        first = self.topology.rank
+        controlled = range(first, first + self.topology.local_size)
+        members = [g for g in ps.ranks if g in controlled]
+        if len(members) != ps.size():
+            # The set-scoped eager data plane is process-local: execution
+            # reduces the member contributions this process holds, so a
+            # set spanning processes would silently compute a partial
+            # result — fail fast instead.
+            return Status.precondition_error(
+                f"process set '{ps.name}' spans ranks {list(ps.ranks)} "
+                f"but this process controls only ranks "
+                f"{list(controlled)}: every member rank of a set must "
+                "live on one process — the set-scoped eager data plane "
+                "is process-local (see docs/process-sets.md).")
+        if len(entry.per_rank) != len(members):
+            return Status.invalid_argument(
+                f"process set '{ps.name}' needs {len(members)} "
+                f"contributions (one per member rank), got "
+                f"{len(entry.per_rank)}")
+        for g, contrib in zip(members, entry.per_rank):
+            requests.append(Request(
+                request_rank=ps.local_rank(g),
+                request_type=entry.request_type,
+                tensor_name=entry.name,
+                tensor_type=np.dtype(contrib.dtype).name,
+                tensor_shape=tuple(contrib.shape),
+                root_rank=entry.root_rank,
+                device=g,
+                wire_dtype=entry.wire_dtype,
+                algo=algo,
+                process_set=ps.id,
+            ))
+        return None
 
     # ------------------------------------------------------- background loop
 
@@ -1413,6 +1484,15 @@ class Controller:
                 names += f",+{len(pending) - 4}"
             cpp_core.flight_record("negotiate.pending", names,
                                    0, len(pending))
+            # Per-tenant request accounting (the local loop's analogue
+            # lives in _negotiate_sets; the coordinator adds its own
+            # control.negotiate_seconds#process_set= series natively).
+            for r in pending:
+                if r.process_set:
+                    ps = self._process_sets.get(r.process_set)
+                    tag = ps.name if ps is not None else str(r.process_set)
+                    _metrics.registry.inc(
+                        f"control.set_requests#process_set={tag}")
         blob = wire.serialize_request_list(
             pending, shutdown=shutting,
             abort_rank=abort_rank, abort_reason=abort_reason)
@@ -1435,9 +1515,14 @@ class Controller:
         ready = []
         for resp in responses:
             with self._lock:
+                # Pop only entries whose process set matches: two tenants
+                # reusing a tensor name must never cross-execute (the
+                # coordinator stamps set responses, wire FLAG_SET_EXT).
                 entries = [self._tensor_table.pop(n)
                            for n in resp.tensor_names
-                           if n in self._tensor_table]
+                           if n in self._tensor_table
+                           and (self._tensor_table[n].process_set
+                                == resp.process_set)]
             if entries:
                 ready.append((resp, entries))
         if self.timeline:
@@ -1463,6 +1548,7 @@ class Controller:
                 "controller.ops#type="
                 + ResponseType(resp.response_type).name.lower())
             if (resp.response_type == ResponseType.ALLREDUCE
+                    and resp.process_set == 0
                     and self.fusion_threshold > 0 and entries):
                 nbytes = sum(int(e.per_rank[0].nbytes) for e in entries)
                 _metrics.registry.observe(
@@ -1472,7 +1558,10 @@ class Controller:
             if self.timeline:
                 self.timeline.activity_end_all(entries)
             try:
-                self._executor.execute(resp, entries)
+                if resp.process_set:
+                    self._execute_set(resp, entries)
+                else:
+                    self._executor.execute(resp, entries)
             except Exception as exc:   # noqa: BLE001 — see docstring
                 status = Status(StatusType.UNKNOWN_ERROR, repr(exc))
                 for e in entries:
@@ -1581,6 +1670,14 @@ class Controller:
         from horovod_tpu import basics
         if basics._state.controller is self:
             basics._state.topology = self.topology
+        # Per-set elastic rides the pod event: every registered set
+        # containing the lost rank reconfigures itself (generation bump +
+        # tagged-series retirement) — the other tenants are untouched.
+        from horovod_tpu import process_set as _process_set_mod
+        try:
+            _process_set_mod.on_pod_reconfigure(ext.lost_rank)
+        except Exception:   # noqa: BLE001 — tenant bookkeeping must not
+            pass            # block pod survival
         _metrics.registry.set_gauge("membership.generation", generation)
         cpp_core.flight_record(
             "elastic.adopted", f"gen={generation}", first_rank, new_size)
@@ -1631,6 +1728,20 @@ class Controller:
         with self._lock:
             pending = list(self._message_queue)
             self._message_queue.clear()
+
+        # Non-default process sets negotiate on the SAME tick but in their
+        # own namespaces: partition first, run each set's pass, and keep
+        # the default path below byte-identical when only set 0 exists.
+        if any(r.process_set for r in pending):
+            set_pending: Dict[int, List[Request]] = {}
+            default_pending: List[Request] = []
+            for r in pending:
+                if r.process_set:
+                    set_pending.setdefault(r.process_set, []).append(r)
+                else:
+                    default_pending.append(r)
+            pending = default_pending
+            self._negotiate_sets(set_pending)
 
         # Response cache: a batch byte-identical to an earlier
         # fully-successful tick replays that tick's fused responses,
@@ -1705,6 +1816,91 @@ class Controller:
         self._maybe_check_stalls()
         self._tick_telemetry()
 
+    def _negotiate_sets(self, set_pending: Dict[int, List[Request]]):
+        """Local negotiation for non-default process sets.
+
+        Each set runs its own table pass and its OWN planner invocation —
+        responses never fuse across sets (native parity: the coordinator
+        appends set responses after PlanTick), and the default response
+        cache never sees set traffic.  Per-tenant observability: request
+        and tick-latency series tagged ``#process_set=<name>``."""
+        for sid in sorted(set_pending):
+            reqs = set_pending[sid]
+            ps = self._process_sets.get(sid)
+            tag = ps.name if ps is not None else str(sid)
+            t0 = time.monotonic()
+            responses: List[Response] = []
+            for req in reqs:
+                rc = self._process_sets.increment(sid, req)
+                if rc < 0:
+                    responses.append(Response(
+                        response_type=ResponseType.ERROR,
+                        tensor_names=[req.tensor_name],
+                        error_message="Request rank out of range.",
+                        process_set=sid))
+                elif rc == 1:
+                    responses.append(
+                        self._process_sets.construct_response(
+                            sid, req.tensor_name))
+            _metrics.registry.inc(
+                f"control.set_requests#process_set={tag}", len(reqs))
+            if not responses:
+                continue
+
+            def entry_bytes(name: str) -> int:
+                e = self._tensor_table[name]
+                return (int(np.prod(e.per_rank[0].shape))
+                        * np.dtype(e.dtype).itemsize)
+
+            def entry_dtype(name: str) -> str:
+                return self._tensor_table[name].dtype
+
+            fused = self._plan_fusion(responses, entry_bytes, entry_dtype,
+                                      self.fusion_threshold)
+            # The planner predates sets; re-stamp so pop guards and the
+            # execution branch route by the right namespace.
+            for resp in fused:
+                resp.process_set = sid
+            ready = []
+            for resp in fused:
+                with self._lock:
+                    entries = [self._tensor_table.pop(n)
+                               for n in resp.tensor_names
+                               if n in self._tensor_table
+                               and self._tensor_table[n].process_set == sid]
+                ready.append((resp, entries))
+            if self.timeline:
+                for _, entries in ready:
+                    self.timeline.activity_start_all(entries, "QUEUE")
+            self._execute_ready(ready)
+            _metrics.registry.observe(
+                f"control.tick_seconds#process_set={tag}",
+                time.monotonic() - t0)
+
+    def _execute_set(self, resp: Response, entries):
+        """Set-scoped host data plane: a process-local set's collectives
+        reduce/concat/broadcast the member contributions this process
+        holds (enqueue enforced full membership) — the negotiated
+        response only ordered and validated them, and a tenant's eager
+        traffic never touches the pod-wide device mesh."""
+        from horovod_tpu import process_set as _process_set_mod
+        if resp.response_type == ResponseType.ERROR:
+            status = Status(StatusType.PRECONDITION_ERROR,
+                            resp.error_message)
+            for e in entries:
+                e.callback(status, None)
+            return
+        ps = self._process_sets.get(resp.process_set)
+        for e in entries:
+            size = ps.size() if ps is not None else len(e.per_rank)
+            try:
+                out = _process_set_mod.execute_host(e, size)
+            except Exception as exc:   # noqa: BLE001 — propagate as status
+                e.callback(Status(StatusType.UNKNOWN_ERROR, repr(exc)),
+                           None)
+            else:
+                e.callback(Status.OK(), out)
+
     def _maybe_check_stalls(self):
         """Warn (once per minute) about tensors some ranks never submitted
         (reference ``CheckForStalledTensors``, ``operations.cc:1366-1412``)."""
@@ -1762,6 +1958,10 @@ class Controller:
         # its own cache in LatchAbort).
         if self._local_cache is not None:
             self._local_cache.flush()
+        # Per-set negotiation state is scoped the same way: stale
+        # set-local readiness counts would poison later reuse of the same
+        # tensor names inside a tenant.
+        self._process_sets.clear_negotiation_state()
         for e in entries:
             e.callback(status, None)
         # Keep the trace on disk usable while the job is failing: this

@@ -256,6 +256,60 @@ def _configure(lib) -> None:
         lib.htpu_policy_consecutive_slow.restype = ctypes.c_int
         lib.htpu_policy_consecutive_slow.argtypes = [
             ctypes.c_void_p, ctypes.c_int]
+    # Per-set straggler state (PR 15); hasattr-guarded so a prebuilt .so
+    # that predates process sets still loads.
+    if hasattr(lib, "htpu_policy_observe_set"):
+        lib.htpu_policy_observe_set.restype = None
+        lib.htpu_policy_observe_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int]
+        lib.htpu_policy_ewma_set.restype = ctypes.c_double
+        lib.htpu_policy_ewma_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.htpu_policy_consecutive_slow_set.restype = ctypes.c_int
+        lib.htpu_policy_consecutive_slow_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.htpu_policy_next_eviction_set.restype = ctypes.c_int
+        lib.htpu_policy_next_eviction_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    # Multi-tenant process-set registry (PR 15), same guard.
+    if hasattr(lib, "htpu_process_sets_create"):
+        lib.htpu_process_sets_create.restype = ctypes.c_void_p
+        lib.htpu_process_sets_create.argtypes = [ctypes.c_longlong]
+        lib.htpu_process_sets_destroy.restype = None
+        lib.htpu_process_sets_destroy.argtypes = [ctypes.c_void_p]
+        lib.htpu_process_sets_parse_spec.restype = ctypes.c_int
+        lib.htpu_process_sets_parse_spec.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.htpu_process_sets_add.restype = ctypes.c_int
+        lib.htpu_process_sets_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int]
+        lib.htpu_process_sets_remove.restype = ctypes.c_int
+        lib.htpu_process_sets_remove.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_process_sets_id_of.restype = ctypes.c_int
+        lib.htpu_process_sets_id_of.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.htpu_process_sets_count.restype = ctypes.c_int
+        lib.htpu_process_sets_count.argtypes = [ctypes.c_void_p]
+        lib.htpu_process_sets_size.restype = ctypes.c_int
+        lib.htpu_process_sets_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_process_sets_local_rank.restype = ctypes.c_int
+        lib.htpu_process_sets_local_rank.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.htpu_process_sets_generation.restype = ctypes.c_int
+        lib.htpu_process_sets_generation.argtypes = [
+            ctypes.c_void_p, ctypes.c_int]
+        lib.htpu_process_sets_reconfigure.restype = ctypes.c_int
+        lib.htpu_process_sets_reconfigure.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.htpu_process_sets_increment.restype = ctypes.c_int
+        lib.htpu_process_sets_increment.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.htpu_process_sets_construct.restype = ctypes.c_int
+        lib.htpu_process_sets_construct.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p)]
 
 
 def load():
@@ -619,6 +673,123 @@ class NativeFleetPolicy:
 
     def consecutive_slow(self, proc: int) -> int:
         return self._lib.htpu_policy_consecutive_slow(self._ptr, int(proc))
+
+    # -- per-set straggler state (PR 15).  A stale .so without the set
+    # endpoints raises, matching the parity tests' skip condition.
+
+    def observe_tick_set(self, process_set: int, wait_s) -> None:
+        if not hasattr(self._lib, "htpu_policy_observe_set"):
+            raise RuntimeError("native per-set policy not available")
+        n = len(wait_s)
+        arr = (ctypes.c_double * n)(*[float(w) for w in wait_s])
+        self._lib.htpu_policy_observe_set(self._ptr, int(process_set), arr, n)
+
+    def ewma_set(self, process_set: int, proc: int) -> float:
+        if not hasattr(self._lib, "htpu_policy_ewma_set"):
+            raise RuntimeError("native per-set policy not available")
+        return float(self._lib.htpu_policy_ewma_set(
+            self._ptr, int(process_set), int(proc)))
+
+    def consecutive_slow_set(self, process_set: int, proc: int) -> int:
+        if not hasattr(self._lib, "htpu_policy_consecutive_slow_set"):
+            raise RuntimeError("native per-set policy not available")
+        return self._lib.htpu_policy_consecutive_slow_set(
+            self._ptr, int(process_set), int(proc))
+
+    def next_eviction_set(self, process_set: int, process_count: int,
+                          seat_available: bool) -> int:
+        if not hasattr(self._lib, "htpu_policy_next_eviction_set"):
+            raise RuntimeError("native per-set policy not available")
+        return self._lib.htpu_policy_next_eviction_set(
+            self._ptr, int(process_set), int(process_count),
+            1 if seat_available else 0)
+
+
+def _process_sets_lib():
+    """The loaded library iff it exports the process-set API, else None
+    (pure-Python run or stale prebuilt .so)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_process_sets_create"):
+        return None
+    return lib
+
+
+class CppProcessSetTable:
+    """ctypes wrapper over the native multi-tenant process-set registry
+    (cpp/htpu/process_set.h), with the interface of the Python mirror in
+    horovod_tpu/process_set.py.  Set ids start at 1; 0 is the implicit
+    default/world set."""
+
+    def __init__(self, cache_capacity: int = 0):
+        lib = _process_sets_lib()
+        if lib is None:
+            raise RuntimeError("native process sets not available")
+        self._lib = lib
+        self._ptr = lib.htpu_process_sets_create(int(cache_capacity))
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.htpu_process_sets_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def parse_spec(self, spec: str) -> bool:
+        return bool(self._lib.htpu_process_sets_parse_spec(
+            self._ptr, spec.encode("utf-8")))
+
+    def add(self, name: str, ranks) -> int:
+        n = len(ranks)
+        arr = (ctypes.c_int * n)(*[int(r) for r in ranks])
+        return self._lib.htpu_process_sets_add(
+            self._ptr, name.encode("utf-8"), arr, n)
+
+    def remove(self, set_id: int) -> bool:
+        return bool(self._lib.htpu_process_sets_remove(self._ptr,
+                                                       int(set_id)))
+
+    def id_of(self, name: str) -> int:
+        return self._lib.htpu_process_sets_id_of(self._ptr,
+                                                 name.encode("utf-8"))
+
+    def count(self) -> int:
+        return self._lib.htpu_process_sets_count(self._ptr)
+
+    def size_of(self, set_id: int) -> int:
+        return self._lib.htpu_process_sets_size(self._ptr, int(set_id))
+
+    def local_rank(self, set_id: int, global_rank: int) -> int:
+        return self._lib.htpu_process_sets_local_rank(
+            self._ptr, int(set_id), int(global_rank))
+
+    def generation(self, set_id: int) -> int:
+        return self._lib.htpu_process_sets_generation(self._ptr, int(set_id))
+
+    def reconfigure(self, set_id: int, lost_global_rank: int) -> int:
+        return self._lib.htpu_process_sets_reconfigure(
+            self._ptr, int(set_id), int(lost_global_rank))
+
+    def increment(self, set_id: int, msg: Request) -> int:
+        # Same single-message boundary format as CppMessageTable.increment
+        # (always with_algo; the set id is the explicit arg, never re-read
+        # from the frame).
+        data = wire.serialize_request(msg, with_algo=True)
+        return self._lib.htpu_process_sets_increment(
+            self._ptr, int(set_id), data, len(data))
+
+    def construct_response(self, set_id: int, name: str) -> Response:
+        out = ctypes.c_void_p()
+        n = self._lib.htpu_process_sets_construct(
+            self._ptr, int(set_id), name.encode("utf-8"), ctypes.byref(out))
+        if n < 0:
+            raise KeyError(f"unknown process set {set_id}")
+        resp = wire.parse_single_response(_take_buffer(self._lib, out, n))
+        resp.process_set = int(set_id)
+        return resp
 
 
 def wire_roundtrip(wire_dtype: str, values):

@@ -78,20 +78,24 @@ def _as_contribution(v):
     return v if isinstance(v, jax.Array) else np.asarray(v)
 
 
-def _normalize(tensor, name_prefix: str, name: Optional[str]):
+def _normalize(tensor, name_prefix: str, name: Optional[str],
+               ncontrib: Optional[int] = None):
     st = basics._require_init()
-    nlocal = st.topology.local_size
+    # Non-default process sets contribute one value per MEMBER rank
+    # (set-local order) instead of one per controlled rank.
+    nlocal = st.topology.local_size if ncontrib is None else ncontrib
     if isinstance(tensor, PerRank):
         vals = [_as_contribution(v) for v in tensor.values]
         # Single-process may pass one value per global rank (it controls
         # them all); multi-process controls only its local ranks.
         allowed = {nlocal}
-        if st.topology.process_count == 1:
+        if ncontrib is None and st.topology.process_count == 1:
             allowed.add(st.topology.size)
         if len(vals) not in allowed:
             raise ValueError(
-                f"PerRank needs {nlocal} values (one per controlled rank), "
-                f"got {len(vals)}")
+                f"PerRank needs {nlocal} values (one per "
+                f"{'member' if ncontrib is not None else 'controlled'} "
+                f"rank), got {len(vals)}")
     else:
         arr = _as_contribution(tensor)
         vals = [arr] * nlocal
@@ -127,14 +131,35 @@ def _wire_dtype_for(compression, dtype, request_type: RequestType) -> str:
     return wire
 
 
+def _resolve_set(process_set):
+    """None/0 → the default world set; otherwise a registered
+    :class:`horovod_tpu.process_set.ProcessSet` (accepts the object, its
+    name, or its id; raises ``ValueError`` on anything unknown)."""
+    if process_set is None or process_set == 0:
+        return None
+    from horovod_tpu import process_set as _ps_mod
+    return _ps_mod.resolve(process_set)
+
+
 def _submit(request_type: RequestType, tensor, name: Optional[str],
             name_prefix: str, *, average: bool = False,
-            root_rank: int = -1, compression=None) -> int:
+            root_rank: int = -1, compression=None,
+            process_set=None) -> int:
     ctrl = basics.controller()
-    per_rank, resolved = _normalize(tensor, name_prefix, name)
+    ps = _resolve_set(process_set)
+    ncontrib = None
+    if ps is not None:
+        first = ctrl.topology.rank
+        controlled = range(first, first + ctrl.topology.local_size)
+        ncontrib = sum(1 for g in ps.ranks if g in controlled)
+    per_rank, resolved = _normalize(tensor, name_prefix, name, ncontrib)
     from horovod_tpu.ops.executor import _needs_host_path
+    # Set-scoped collectives execute on the host data plane — they never
+    # dispatch mesh programs, so they cannot race jitted steps.
     handle = ctrl.handle_manager.allocate(
-        mesh_hazard=not _needs_host_path(per_rank[0].dtype), name=resolved)
+        mesh_hazard=(ps is None
+                     and not _needs_host_path(per_rank[0].dtype)),
+        name=resolved)
 
     def callback(status: Status, result):
         ctrl.handle_manager.mark_done(handle, status, result)
@@ -149,6 +174,7 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
         callback=callback,
         wire_dtype=_wire_dtype_for(compression, per_rank[0].dtype,
                                    request_type),
+        process_set=ps.id if ps is not None else 0,
     )
     status = ctrl.enqueue(entry)
     if not status.ok():
@@ -159,7 +185,8 @@ def _submit(request_type: RequestType, tensor, name: Optional[str],
 # ------------------------------------------------------------------- public
 
 def allreduce_async(tensor, *, average: bool = True,
-                    name: Optional[str] = None, compression=None) -> int:
+                    name: Optional[str] = None, compression=None,
+                    process_set=None) -> int:
     """Start an allreduce; returns a handle for ``poll``/``synchronize``
     (reference ``horovod/torch/mpi_ops.py:86-135``).
 
@@ -168,37 +195,54 @@ def allreduce_async(tensor, *, average: bool = True,
     ``"int8"``): float32 payloads are compressed per hop on the host
     ring and materialized back to fp32 — the result dtype is unchanged.
     Default (``None``) honours ``HOROVOD_TPU_WIRE_DTYPE``; all ranks must
-    agree or negotiation raises a coordinated :class:`CollectiveError`."""
+    agree or negotiation raises a coordinated :class:`CollectiveError`.
+
+    ``process_set`` scopes the collective to a registered process set
+    (object, name, or id; reference ``mpi_ops.py process_set=``): it
+    negotiates in the set's own namespace, contributions are one per
+    MEMBER rank in set-local order, and the result reduces over the set
+    only (docs/process-sets.md)."""
     return _submit(RequestType.ALLREDUCE, tensor, name, "allreduce",
-                   average=average, compression=compression)
+                   average=average, compression=compression,
+                   process_set=process_set)
 
 
 def allreduce(tensor, *, average: bool = True,
-              name: Optional[str] = None, compression=None):
+              name: Optional[str] = None, compression=None,
+              process_set=None):
     return synchronize(allreduce_async(tensor, average=average, name=name,
-                                       compression=compression))
+                                       compression=compression,
+                                       process_set=process_set))
 
 
-def allgather_async(tensor, *, name: Optional[str] = None) -> int:
+def allgather_async(tensor, *, name: Optional[str] = None,
+                    process_set=None) -> int:
     """Start an allgather: concat across ranks on dim0; ranks may contribute
-    different dim0 sizes (reference ``mpi_ops.py:200-260``)."""
-    return _submit(RequestType.ALLGATHER, tensor, name, "allgather")
+    different dim0 sizes (reference ``mpi_ops.py:200-260``).  With
+    ``process_set=`` the concat runs in set-local rank order over the
+    set's members only."""
+    return _submit(RequestType.ALLGATHER, tensor, name, "allgather",
+                   process_set=process_set)
 
 
-def allgather(tensor, *, name: Optional[str] = None):
-    return synchronize(allgather_async(tensor, name=name))
+def allgather(tensor, *, name: Optional[str] = None, process_set=None):
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
 
 
 def broadcast_async(tensor, root_rank: int, *,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, process_set=None) -> int:
     """Start a broadcast of rank ``root_rank``'s value to all ranks
-    (reference ``mpi_ops.py:284-360``)."""
+    (reference ``mpi_ops.py:284-360``).  With ``process_set=``,
+    ``root_rank`` is the SET-LOCAL root and only member ranks receive."""
     return _submit(RequestType.BROADCAST, tensor, name, "broadcast",
-                   root_rank=root_rank)
+                   root_rank=root_rank, process_set=process_set)
 
 
-def broadcast(tensor, root_rank: int, *, name: Optional[str] = None):
-    return synchronize(broadcast_async(tensor, root_rank, name=name))
+def broadcast(tensor, root_rank: int, *, name: Optional[str] = None,
+              process_set=None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       process_set=process_set))
 
 
 def poll(handle: int) -> bool:

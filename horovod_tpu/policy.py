@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import os
 import sys
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 #: EWMA smoothing factor for per-process imposed wait; matches
 #: ``htpu::FleetPolicy::alpha_``.
@@ -142,8 +142,12 @@ class FleetPolicy:
             self._schedule = []
         self._autoscale_file = os.environ.get("HOROVOD_TPU_AUTOSCALE_FILE",
                                               "")
-        self._procs: List[_ProcState] = []
-        self._evictions = 0
+        # Per-process straggler state keyed by process set (0 = the
+        # default/pod set).  Pod-level decisions (next_eviction,
+        # rerank_order) read set 0 only; a rank slow in one tenant's
+        # collectives is never nominated for eviction from another's.
+        self._sets: Dict[int, List[_ProcState]] = {}
+        self._evictions = 0   # global budget, shared across all sets
 
     # ------------------------------------------------------- arming state
 
@@ -178,27 +182,34 @@ class FleetPolicy:
         return self._evictions
 
     def ewma(self, proc: int) -> float:
-        if 0 <= proc < len(self._procs) and self._procs[proc].valid:
-            return self._procs[proc].ewma
-        return -1.0
+        return self.ewma_set(0, proc)
 
     def consecutive_slow(self, proc: int) -> int:
-        if 0 <= proc < len(self._procs):
-            return self._procs[proc].consecutive
+        return self.consecutive_slow_set(0, proc)
+
+    def ewma_set(self, process_set: int, proc: int) -> float:
+        procs = self._sets.get(process_set, [])
+        if 0 <= proc < len(procs) and procs[proc].valid:
+            return procs[proc].ewma
+        return -1.0
+
+    def consecutive_slow_set(self, process_set: int, proc: int) -> int:
+        procs = self._sets.get(process_set, [])
+        if 0 <= proc < len(procs):
+            return procs[proc].consecutive
         return 0
 
     # ---------------------------------------------------------- decisions
 
-    def observe_tick(self, tick: int, wait_s: Sequence[float]) -> None:
-        """Feed one gather's per-process imposed waits (seconds; a
-        negative entry means no sample for that process this tick)."""
-        del tick
-        while len(self._procs) < len(wait_s):
-            self._procs.append(_ProcState())
+    def _update_set(self, procs: List[_ProcState],
+                    wait_s: Sequence[float]) -> None:
+        """EWMA + consecutive-slow pass over one set's state vector."""
+        while len(procs) < len(wait_s):
+            procs.append(_ProcState())
         for p, w in enumerate(wait_s):
             if w < 0:
                 continue
-            ps = self._procs[p]
+            ps = procs[p]
             ps.ewma = (EWMA_ALPHA * w + (1.0 - EWMA_ALPHA) * ps.ewma
                        if ps.valid else float(w))
             ps.valid = True
@@ -208,13 +219,13 @@ class FleetPolicy:
         # on their own median means a fleet-wide slowdown (every EWMA
         # elevated alike) never nominates anyone — skew is a property of
         # one host, load is a property of the job.
-        ew = sorted(ps.ewma for ps in self._procs if ps.valid)
+        ew = sorted(ps.ewma for ps in procs if ps.valid)
         if len(ew) < 2:
             return
         mid = len(ew) // 2
         median = (ew[mid] if len(ew) % 2
                   else (ew[mid] + ew[mid - 1]) / 2.0)
-        for ps in self._procs:
+        for ps in procs:
             if not ps.valid:
                 continue
             if ps.ewma - median > self._threshold_s:
@@ -224,19 +235,47 @@ class FleetPolicy:
                 ps.consecutive = 0
                 ps.suppress_logged = False
 
-    def next_eviction(self, process_count: int,
-                      seat_available: bool) -> int:
-        """The process index to demote this tick, or -1.  Suppressed
-        opportunities (budget spent, no seat) count
-        ``policy.evictions_suppressed`` and log once per slow episode."""
+    def observe_tick(self, tick: int, wait_s: Sequence[float],
+                     set_attr: Sequence[int] = ()) -> None:
+        """Feed one gather's per-process imposed waits (seconds; a
+        negative entry means no sample for that process this tick).
+
+        ``set_attr[p]`` names the process set process ``p``'s tick was
+        spent in (0 = default): its sample lands on that set's EWMA
+        state, so one tenant's slowness stays that tenant's signal.  An
+        empty attribution is all-default — bit-identical to the pre-set
+        behavior.  The default set's pass always runs so its
+        consecutive-slow windows keep their every-gather cadence; a
+        non-default set runs only on ticks that attributed it a sample.
+        """
+        del tick
+        per_set: Dict[int, List[float]] = {0: [-1.0] * len(wait_s)}
+        for p, w in enumerate(wait_s):
+            s = set_attr[p] if p < len(set_attr) and set_attr[p] > 0 else 0
+            per_set.setdefault(s, [-1.0] * len(wait_s))[p] = w
+        for s in sorted(per_set):
+            self._update_set(self._sets.setdefault(s, []), per_set[s])
+
+    def observe_tick_set(self, process_set: int,
+                         wait_s: Sequence[float]) -> None:
+        """Feed one wait vector directly into ``process_set``'s state
+        (tests + tooling; the live tick path uses ``observe_tick``'s
+        attribution)."""
+        self._update_set(self._sets.setdefault(process_set, []), wait_s)
+
+    def _nominate(self, process_set: int, process_count: int,
+                  seat_available: bool) -> int:
+        """Shared nomination: candidate scan over one set's state plus
+        the global budget / seat suppression."""
         if not self.evict_enabled():
             return -1
+        procs = self._sets.get(process_set, [])
         candidate = -1
         worst = 0.0
         # Process 0 IS the coordinator — never a candidate (failover,
         # not eviction, handles a slow coordinator).
-        for p in range(1, min(process_count, len(self._procs))):
-            ps = self._procs[p]
+        for p in range(1, min(process_count, len(procs))):
+            ps = procs[p]
             if not ps.valid or ps.consecutive < self._evict_ticks:
                 continue
             if candidate < 0 or ps.ewma > worst:
@@ -253,16 +292,31 @@ class FleetPolicy:
         if why is not None:
             from .metrics import registry
             registry.inc("policy.evictions_suppressed")
-            ps = self._procs[candidate]
+            ps = procs[candidate]
             if not ps.suppress_logged:
                 ps.suppress_logged = True
                 print(f"horovod_tpu policy: NOT evicting straggler "
-                      f"process {candidate} (ewma_wait="
+                      f"process {candidate} (set {process_set}, ewma_wait="
                       f"{ps.ewma * 1e3:.1f}ms > threshold for "
                       f"{ps.consecutive} ticks): {why}", file=sys.stderr)
             return -1
         self._evictions += 1
         return candidate
+
+    def next_eviction(self, process_count: int,
+                      seat_available: bool) -> int:
+        """The process index to demote this tick, or -1 — read from the
+        DEFAULT set's state (pod eviction acts on pod-level slowness).
+        Suppressed opportunities (budget spent, no seat) count
+        ``policy.evictions_suppressed`` and log once per slow episode."""
+        return self._nominate(0, process_count, seat_available)
+
+    def next_eviction_set(self, process_set: int, process_count: int,
+                          seat_available: bool) -> int:
+        """Per-set eviction candidate (per-set reconfigure decisions):
+        same nomination over ``process_set``'s state, sharing the global
+        eviction budget."""
+        return self._nominate(process_set, process_count, seat_available)
 
     def rerank_order(self, old_pidx: Sequence[int]) -> List[int]:
         """Survivor order for the next membership: slow hosts sorted to
@@ -273,10 +327,12 @@ class FleetPolicy:
         order = list(old_pidx)
         if not self.rerank_enabled():
             return order
+        # Ring order is pod-global: only the default set's EWMAs drive it.
+        procs = self._sets.get(0, [])
 
         def bucket(p: int) -> int:
-            if 0 <= p < len(self._procs) and self._procs[p].valid:
-                return int(self._procs[p].ewma * 1e3)
+            if 0 <= p < len(procs) and procs[p].valid:
+                return int(procs[p].ewma * 1e3)
             return 0
 
         order.sort(key=bucket)
@@ -303,12 +359,15 @@ class FleetPolicy:
     def on_reconfigure(self, old_to_new: Sequence[int],
                        new_count: int) -> None:
         """Remap per-process state to the post-reconfigure numbering
-        (``old_to_new[p] = -1`` drops p: evicted, dead, or parked)."""
-        nxt = [_ProcState() for _ in range(new_count)]
-        for p, np_ in enumerate(old_to_new):
-            if 0 <= np_ < new_count and p < len(self._procs):
-                nxt[np_] = self._procs[p]
-        self._procs = nxt
+        (``old_to_new[p] = -1`` drops p: evicted, dead, or parked).
+        Process indices are pod-global in every set's state vector, so
+        one membership change remaps them all."""
+        for s, procs in self._sets.items():
+            nxt = [_ProcState() for _ in range(new_count)]
+            for p, np_ in enumerate(old_to_new):
+                if 0 <= np_ < new_count and p < len(procs):
+                    nxt[np_] = procs[p]
+            self._sets[s] = nxt
 
 
 def make_fleet_policy(prefer_native: bool = True):

@@ -1,0 +1,475 @@
+"""Multi-tenant process sets: named communicators with their own
+negotiation namespace.
+
+Horovod's process-set API (``horovod/common/process_set.{h,cc}``,
+``horovod/torch/mpi_ops.py:add_process_set``) lets training, eval and
+auxiliary jobs share one pod without stepping on each other's
+collectives.  This module is the Python half of the subsystem:
+
+* :class:`ProcessSet` — one named communicator over a subset of global
+  ranks, with per-set membership generation (per-set elastic: losing a
+  rank reconfigures that set, never the pod).
+* :class:`ProcessSetRegistry` — the behaviour-identical Python mirror of
+  the native registry (``cpp/htpu/process_set.{h,cc}``, reachable via
+  :class:`horovod_tpu.cpp_core.CppProcessSetTable`): each set owns a
+  MessageTable sized to the set and indexed by SET-LOCAL rank, plus its
+  own response-cache slots, so two disjoint sets negotiate concurrently
+  with zero cross-talk.
+* Module-level API (re-exported from ``horovod_tpu``):
+  :func:`add_process_set`, :func:`remove_process_set`,
+  :func:`process_set_by_name`, plus the ``HOROVOD_TPU_PROCESS_SETS``
+  startup spec (``name:0,1;name2:2,3`` — same grammar the native
+  coordinator parses in ``control.cc Create``).
+
+Set ids start at 1 and are assigned in registration order; id 0 is the
+implicit default/world set owned by the controller itself.  Multi-process
+jobs must register sets through ``HOROVOD_TPU_PROCESS_SETS`` (every
+process and the native coordinator parse the same spec, so ids agree by
+construction); :func:`add_process_set` after init is single-process only
+— the native coordinator's registry is sealed at Create and a dynamically
+added id would be unknown to it.
+
+The eager data plane for a non-default set is process-local: every member
+rank of a set must be controlled by one process (the negotiated response
+orders and validates the collective; execution reduces the member
+contributions on host — see :func:`execute_host`).  See
+docs/process-sets.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu import metrics as _metrics
+
+# Metric series retired when a set reconfigures or is removed (tag value =
+# set name).  Keep in sync with docs/observability.md; counters survive by
+# registry policy (remove_matching drops gauges/histograms only).
+PER_SET_SERIES = (
+    "control.negotiate_seconds",
+    "control.tick_seconds",
+    "control.set_requests",
+    "elastic.set_generation",
+    "publish.latency_seconds",
+    "publish.staleness_seconds",
+    "publish.epoch",
+)
+
+
+class ProcessSet:
+    """One named communicator over a subset of global ranks.
+
+    Mirrors the native ``htpu::ProcessSet`` (cpp/htpu/process_set.h):
+    ascending member ranks, a set-local rank space, and a membership
+    generation bumped by per-set reconfiguration."""
+
+    def __init__(self, set_id: int, name: str, ranks: Sequence[int]):
+        self.id = int(set_id)
+        self.name = name
+        self.ranks: Tuple[int, ...] = tuple(sorted(int(r) for r in ranks))
+        self.generation = 0
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, global_rank: int) -> bool:
+        return int(global_rank) in self.ranks
+
+    def local_rank(self, global_rank: int) -> int:
+        """SET-LOCAL rank of ``global_rank`` (-1 when not a member)."""
+        try:
+            return self.ranks.index(int(global_rank))
+        except ValueError:
+            return -1
+
+    def rank(self) -> int:
+        """Set-local rank of this process's first controlled global rank
+        (-1 when this process controls no member) — the per-set analogue
+        of ``hvd.rank()``."""
+        from horovod_tpu import basics
+        return self.local_rank(basics._require_init().topology.rank)
+
+    def __repr__(self) -> str:
+        return (f"ProcessSet(id={self.id}, name={self.name!r}, "
+                f"ranks={list(self.ranks)}, generation={self.generation})")
+
+
+def parse_spec(spec: str) -> List[Tuple[str, List[int]]]:
+    """Parse the ``HOROVOD_TPU_PROCESS_SETS`` grammar
+    (``name:0,1;name2:2,3``) into ``[(name, ranks), ...]``; raises
+    ``ValueError`` on a malformed spec — same strictness as the native
+    parser (``ProcessSetTable::ParseSpec``), which refuses init rather
+    than silently dropping a tenant."""
+    out: List[Tuple[str, List[int]]] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, ranks_txt = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"malformed process-set spec entry {part!r}: expected "
+                "'name:rank,rank,...' entries separated by ';'")
+        try:
+            ranks = [int(tok) for tok in ranks_txt.split(",") if tok.strip()]
+        except ValueError:
+            raise ValueError(
+                f"malformed process-set spec entry {part!r}: ranks must "
+                "be integers") from None
+        if not ranks or any(r < 0 for r in ranks):
+            raise ValueError(
+                f"malformed process-set spec entry {part!r}: needs at "
+                "least one non-negative rank")
+        out.append((name, ranks))
+    return out
+
+
+class ProcessSetRegistry:
+    """Python mirror of the native ``ProcessSetTable``: registered sets
+    plus their scoped negotiation state (MessageTable + response cache per
+    set).  Mutex-guarded so the controller's tick thread can negotiate on
+    one set while a framework thread registers or tears down another."""
+
+    def __init__(self, cache_capacity: int = 0):
+        self._lock = threading.Lock()
+        self._cache_capacity = int(cache_capacity)
+        self._next_id = 1
+        self._sets: Dict[int, ProcessSet] = {}
+        self._tables: Dict[int, object] = {}
+        self._caches: Dict[int, object] = {}
+
+    # --------------------------------------------------------- registration
+
+    def parse_spec(self, spec: str) -> bool:
+        """Register every set in ``spec``; False (earlier entries stay
+        registered — native parity) on a malformed spec or a rejected
+        registration."""
+        try:
+            entries = parse_spec(spec)
+        except ValueError:
+            return False
+        for name, ranks in entries:
+            if self.add(name, ranks) < 0:
+                return False
+        return True
+
+    def add(self, name: str, ranks: Sequence[int]) -> int:
+        """Register a set; returns the new id, or -1 on invalid input
+        (empty membership, duplicate rank, duplicate name)."""
+        members = sorted(int(r) for r in ranks)
+        with self._lock:
+            if (not name or not members
+                    or len(set(members)) != len(members)
+                    or any(ps.name == name for ps in self._sets.values())):
+                return -1
+            sid = self._next_id
+            self._next_id += 1
+            ps = ProcessSet(sid, name, members)
+            self._sets[sid] = ps
+            self._tables[sid] = self._new_table(len(members))
+            self._caches[sid] = self._new_cache(len(members))
+            return sid
+
+    @staticmethod
+    def _new_table(size: int):
+        from horovod_tpu.core import MessageTable
+        return MessageTable(size)
+
+    def _new_cache(self, size: int):
+        del size   # capacity-bounded like the native per-set cache slots
+        from horovod_tpu.core import _LocalResponseCache
+        return _LocalResponseCache(self._cache_capacity)
+
+    def remove(self, set_id: int) -> bool:
+        """Tear a set down; True if it existed.  In-flight requests for
+        the removed set error out at routing, never cross-talk."""
+        with self._lock:
+            if set_id not in self._sets:
+                return False
+            ps = self._sets.pop(set_id)
+            self._tables.pop(set_id, None)
+            self._caches.pop(set_id, None)
+        retire_metrics(ps.name)
+        return True
+
+    # -------------------------------------------------------------- queries
+
+    def get(self, set_id: int) -> Optional[ProcessSet]:
+        with self._lock:
+            return self._sets.get(int(set_id))
+
+    def by_name(self, name: str) -> Optional[ProcessSet]:
+        with self._lock:
+            for ps in self._sets.values():
+                if ps.name == name:
+                    return ps
+        return None
+
+    def id_of(self, name: str) -> int:
+        ps = self.by_name(name)
+        return ps.id if ps is not None else -1
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._sets)
+
+    def size_of(self, set_id: int) -> int:
+        ps = self.get(set_id)
+        return ps.size() if ps is not None else -1
+
+    def local_rank(self, set_id: int, global_rank: int) -> int:
+        ps = self.get(set_id)
+        return ps.local_rank(global_rank) if ps is not None else -1
+
+    def generation(self, set_id: int) -> int:
+        ps = self.get(set_id)
+        return ps.generation if ps is not None else -1
+
+    def all(self) -> List[ProcessSet]:
+        with self._lock:
+            return list(self._sets.values())
+
+    # -------------------------------------------------------------- elastic
+
+    def reconfigure(self, set_id: int, lost_global_rank: int) -> int:
+        """Per-set elastic reconfiguration: drop the lost rank from the
+        set's membership, clear its negotiation state (stale set-local
+        ranks would corrupt later negotiations), bump the generation.
+        Returns the new generation, or -1 on an unknown set/rank."""
+        with self._lock:
+            ps = self._sets.get(int(set_id))
+            if ps is None or not ps.included(lost_global_rank):
+                return -1
+            ps.ranks = tuple(r for r in ps.ranks
+                             if r != int(lost_global_rank))
+            ps.generation += 1
+            self._tables[set_id] = self._new_table(len(ps.ranks))
+            self._caches[set_id] = self._new_cache(len(ps.ranks))
+            gen = ps.generation
+            name = ps.name
+        retire_metrics(name)
+        _metrics.registry.set_gauge(
+            f"elastic.set_generation#process_set={name}", gen)
+        return gen
+
+    # ---------------------------------------------------------- negotiation
+
+    def increment(self, set_id: int, request) -> int:
+        """Route one request into its set's table: 1 when the tensor is
+        ready to construct, 0 when still waiting, -1 on an unknown set or
+        a set-local rank out of range (native ``Increment`` parity)."""
+        with self._lock:
+            ps = self._sets.get(int(set_id))
+            table = self._tables.get(int(set_id))
+        if ps is None or table is None:
+            return -1
+        if not 0 <= request.request_rank < ps.size():
+            return -1
+        return 1 if table.increment(request) else 0
+
+    def construct_response(self, set_id: int, name: str):
+        """Construct the set's response for ``name`` (after
+        :meth:`increment` returned 1); the response's ``process_set`` is
+        stamped.  Raises ``KeyError`` on an unknown set."""
+        with self._lock:
+            table = self._tables.get(int(set_id))
+        if table is None:
+            raise KeyError(f"unknown process set id {set_id}")
+        resp = table.construct_response(name)
+        resp.process_set = int(set_id)
+        return resp
+
+    def clear_negotiation_state(self) -> None:
+        """Abort/quiesce: drop every set's readiness counts and cached
+        responses (membership and generations survive — only in-flight
+        negotiation dies with the job)."""
+        with self._lock:
+            tables = list(self._tables.values())
+            caches = list(self._caches.values())
+        for t in tables:
+            t.clear()
+        for c in caches:
+            c.flush()
+
+
+# --------------------------------------------------------------------------
+# Module-global registry + public API
+# --------------------------------------------------------------------------
+
+_registry: Optional[ProcessSetRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def registry() -> ProcessSetRegistry:
+    """The process-global set registry (created on first use; seeded from
+    ``HOROVOD_TPU_PROCESS_SETS`` so the Python ids match the native
+    coordinator's, which parses the same spec at Create)."""
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            from horovod_tpu.core import cache_capacity_from_env
+            reg = ProcessSetRegistry(cache_capacity_from_env())
+            spec = os.environ.get("HOROVOD_TPU_PROCESS_SETS", "")
+            if spec:
+                # Loud failure: a silently dropped tenant would deadlock
+                # its first collective 60s later.  parse_spec() raised
+                # semantics live in the helper; registration rejects
+                # (dup name/rank) surface here.
+                entries = parse_spec(spec)
+                for name, ranks in entries:
+                    if reg.add(name, ranks) < 0:
+                        raise ValueError(
+                            f"HOROVOD_TPU_PROCESS_SETS rejected entry "
+                            f"{name!r} (duplicate name or rank in "
+                            f"{ranks})")
+            _registry = reg
+        return _registry
+
+
+def reset() -> None:
+    """Drop the global registry (tests + shutdown); the next access
+    re-seeds from the environment."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+def get(set_id: int) -> Optional[ProcessSet]:
+    return registry().get(set_id)
+
+
+def resolve(process_set) -> ProcessSet:
+    """Accept a :class:`ProcessSet`, a set name, or a numeric id; raises
+    ``ValueError`` on anything unknown."""
+    reg = registry()
+    if isinstance(process_set, ProcessSet):
+        ps = reg.get(process_set.id)
+        if ps is not None:
+            return ps
+    elif isinstance(process_set, str):
+        ps = reg.by_name(process_set)
+        if ps is not None:
+            return ps
+    elif isinstance(process_set, int) and process_set != 0:
+        ps = reg.get(process_set)
+        if ps is not None:
+            return ps
+    raise ValueError(
+        f"Unknown process set {process_set!r}: register it with "
+        "hvd.add_process_set([...], name=...) or the "
+        "HOROVOD_TPU_PROCESS_SETS spec (see docs/process-sets.md).")
+
+
+def add_process_set(ranks: Sequence[int],
+                    name: Optional[str] = None) -> ProcessSet:
+    """Register a named process set over ``ranks`` (reference
+    ``hvd.add_process_set``).  Multi-process jobs must use the
+    ``HOROVOD_TPU_PROCESS_SETS`` startup spec instead — the native
+    coordinator's registry is sealed at init, so a dynamically added id
+    would be unknown to it and every collective on it would error."""
+    from horovod_tpu import basics
+    st = basics._state
+    if (st.initialized and st.topology is not None
+            and st.topology.process_count > 1):
+        raise RuntimeError(
+            "add_process_set() after init is single-process only: "
+            "multi-process jobs register sets with "
+            "HOROVOD_TPU_PROCESS_SETS=<name:ranks;...> on every process "
+            "so the coordinator knows them too (docs/process-sets.md).")
+    reg = registry()
+    if name is None:
+        name = "set_" + ",".join(str(int(r)) for r in sorted(ranks))
+    sid = reg.add(name, ranks)
+    if sid < 0:
+        raise ValueError(
+            f"add_process_set rejected {name!r} over {list(ranks)}: "
+            "empty membership, duplicate rank, or duplicate name.")
+    _metrics.registry.set_gauge(
+        f"elastic.set_generation#process_set={name}", 0)
+    return reg.get(sid)
+
+
+def remove_process_set(process_set) -> bool:
+    """Tear a set down (by object, name, or id); True if it existed."""
+    try:
+        ps = resolve(process_set)
+    except ValueError:
+        return False
+    return registry().remove(ps.id)
+
+
+def process_set_by_name(name: str) -> Optional[ProcessSet]:
+    return registry().by_name(name)
+
+
+def reconfigure_process_set(process_set, lost_global_rank: int) -> int:
+    """Per-set elastic: drop ``lost_global_rank`` from the set, retire its
+    tagged metric series, bump and return the new generation (-1 on an
+    unknown set/rank).  The pod is untouched — this is the per-tenant
+    failure domain (docs/process-sets.md)."""
+    ps = resolve(process_set)
+    return registry().reconfigure(ps.id, lost_global_rank)
+
+
+def on_pod_reconfigure(lost_global_rank: int) -> None:
+    """Pod-level membership-change hook (elastic RECONFIGURE broadcast):
+    every registered set containing the lost rank reconfigures itself —
+    its generation advances independently of the pod's."""
+    if lost_global_rank < 0 or _registry is None:
+        return
+    reg = registry()
+    for ps in reg.all():
+        if ps.included(lost_global_rank):
+            reg.reconfigure(ps.id, lost_global_rank)
+
+
+def retire_metrics(set_name: str) -> None:
+    """Retire every per-set gauge/histogram series tagged with this set
+    (membership changed or set removed: the old series describe a world
+    that no longer exists; counters survive as process-lifetime totals,
+    same policy as the pod re-rank path)."""
+    for prefix in PER_SET_SERIES:
+        _metrics.registry.remove_matching(
+            f"{prefix}#process_set={set_name}")
+
+
+# --------------------------------------------------------------------------
+# Set-scoped host data plane
+# --------------------------------------------------------------------------
+
+def execute_host(entry, set_size: int):
+    """Execute one negotiated set-scoped collective on host.
+
+    ``entry.per_rank`` holds one contribution per member rank in
+    set-local order (enqueue enforced process-local full membership), so
+    the collective is pure numpy: sum (÷ size for average) for
+    allreduce, dim0-concat in set-local rank order for allgather, the
+    set-local root's value for broadcast.  Results are host ``ndarray``s
+    — the set plane never touches the pod-wide device mesh, so a
+    tenant's eager traffic cannot perturb the training job's XLA
+    programs."""
+    from horovod_tpu.core import RequestType
+    contribs = [np.asarray(a) for a in entry.per_rank]
+    if entry.request_type == RequestType.ALLREDUCE:
+        out = np.sum(np.stack(contribs), axis=0,
+                     dtype=np.dtype(entry.dtype))
+        if entry.average:
+            if np.issubdtype(np.dtype(entry.dtype), np.floating):
+                out = (out / set_size).astype(entry.dtype)
+            else:
+                out = out // set_size
+        return out
+    if entry.request_type == RequestType.ALLGATHER:
+        return np.concatenate(contribs, axis=0)
+    if entry.request_type == RequestType.BROADCAST:
+        if not 0 <= entry.root_rank < len(contribs):
+            raise ValueError(
+                f"set-local root rank {entry.root_rank} out of range "
+                f"for a {len(contribs)}-member process set")
+        return contribs[entry.root_rank].copy()
+    raise ValueError(f"bad request type {entry.request_type}")

@@ -1,0 +1,196 @@
+"""Parameter-publish serving plane: stream the training job's committed
+checkpoint-chain tip to a subscriber process set.
+
+The serving half of the multi-tenant design (docs/process-sets.md): a
+training tenant checkpoints through the async incremental writer
+(:mod:`horovod_tpu.ckpt_stream` → base+delta chains,
+:mod:`horovod_tpu.checkpoint`), and a :class:`ParameterPublisher` watches
+the chain directory for newly COMMITTED epochs — never a torn or
+in-flight tip — and streams each one's reconstructed state to the
+members of a publish process set via set-scoped broadcast.  Training
+never stops: the publish traffic negotiates in the publish set's own
+namespace on the shared coordinator tick and executes on the set-scoped
+host data plane, so the training set's collectives and XLA programs are
+untouched (the publish-while-training drill in ``bench.py`` measures
+exactly this: publish latency + staleness vs the training step-time
+delta).
+
+Knobs:
+
+* ``HOROVOD_TPU_PUBLISH_EVERY`` — publish every Nth committed epoch
+  (default 1: every commit).
+* ``HOROVOD_TPU_PUBLISH_TIMEOUT_S`` — per-publish broadcast timeout in
+  seconds (default 60).
+
+Metrics (docs/observability.md): ``publish.count``, ``publish.bytes``,
+``publish.latency_seconds``, ``publish.staleness_seconds#process_set=``
+and ``publish.epoch#process_set=`` / ``publish.latency_seconds#process_set=``
+tagged with the publish set's name.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu import checkpoint as _checkpoint
+from horovod_tpu import metrics as _metrics
+from horovod_tpu import process_set as _process_set_mod
+
+
+def publish_every_default() -> int:
+    """HOROVOD_TPU_PUBLISH_EVERY: publish every Nth committed epoch
+    (default 1 — every commit; malformed/non-positive falls back)."""
+    raw = os.environ.get("HOROVOD_TPU_PUBLISH_EVERY", "")
+    try:
+        v = int(raw)
+        return v if v >= 1 else 1
+    except ValueError:
+        return 1
+
+
+def publish_timeout_default() -> float:
+    """HOROVOD_TPU_PUBLISH_TIMEOUT_S: per-publish broadcast timeout
+    (default 60 s; malformed/non-positive falls back)."""
+    raw = os.environ.get("HOROVOD_TPU_PUBLISH_TIMEOUT_S", "")
+    try:
+        v = float(raw)
+        return v if v > 0 else 60.0
+    except ValueError:
+        return 60.0
+
+
+class ParameterPublisher:
+    """Watch a checkpoint-chain directory and broadcast committed tips to
+    a subscriber process set.
+
+    ``process_set`` is the PUBLISH set (object, name, or id): its
+    set-local ``root_rank`` (default 0) must be a rank holding the
+    committed chain — typically the training tenant's first rank — and
+    the remaining members are the subscribers.  :meth:`poll` is the
+    cheap call for a serving loop: it publishes only when a new committed
+    epoch (respecting ``HOROVOD_TPU_PUBLISH_EVERY``) has appeared, and
+    returns the published state so a subscriber can swap weights in
+    place.
+    """
+
+    def __init__(self, directory: str, process_set, *,
+                 root_rank: int = 0,
+                 every: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        self.directory = directory
+        self._ps = _process_set_mod.resolve(process_set)
+        self._root = int(root_rank)
+        if not 0 <= self._root < self._ps.size():
+            raise ValueError(
+                f"publish root rank {root_rank} is not a set-local rank "
+                f"of process set '{self._ps.name}' "
+                f"(size {self._ps.size()})")
+        self.every = int(every) if every is not None else \
+            publish_every_default()
+        self.timeout_s = (float(timeout_s) if timeout_s is not None
+                          else publish_timeout_default())
+        # Last epoch actually streamed (-1 = nothing yet) and a
+        # monotonically increasing publish sequence for tensor naming —
+        # re-publishing the same epoch (subscriber set reconfigured) must
+        # not collide with in-flight names.
+        self.last_published_epoch = -1
+        self._seq = 0
+
+    # ------------------------------------------------------------- watching
+
+    def committed_tip(self) -> int:
+        """Highest committed (restorable) epoch in the directory, -1 when
+        none.  Torn or in-flight chain tips are skipped — the publisher
+        only ever streams state a recovery could also reach."""
+        latest = _checkpoint.latest_epoch(self.directory)
+        if latest < 0:
+            return -1
+        return _checkpoint.resolve_committed_epoch(self.directory,
+                                                   latest)
+
+    def pending_epoch(self) -> int:
+        """The epoch :meth:`poll` would publish now, or -1: the committed
+        tip, if it advanced at least ``every`` epochs past the last
+        publish (first publish fires on any committed tip)."""
+        tip = self.committed_tip()
+        if tip < 0:
+            return -1
+        if self.last_published_epoch < 0:
+            return tip
+        if tip - self.last_published_epoch >= self.every:
+            return tip
+        return -1
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """Publish the newest committed epoch if one is due; returns the
+        published flat state, or None when nothing new is committed."""
+        epoch = self.pending_epoch()
+        if epoch < 0:
+            return None
+        return self.publish(epoch)
+
+    # ----------------------------------------------------------- publishing
+
+    def publish(self, epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Stream committed epoch ``epoch`` (default: the committed tip)
+        to the publish set via set-scoped broadcast and return the flat
+        state every member now holds.
+
+        The chain is replayed on the ROOT member's process (committed
+        links only — ``read_chain_state`` raises on a torn chain) and
+        each leaf broadcasts in the publish set's namespace; key order is
+        broadcast first so subscribers rebuild the exact dict."""
+        from horovod_tpu.ops import eager as _eager
+        if epoch is None:
+            epoch = self.committed_tip()
+        if epoch < 0:
+            raise ValueError(
+                f"no committed checkpoint in {self.directory!r} to "
+                "publish")
+        t0 = time.monotonic()
+        flat = _checkpoint.read_chain_state(self.directory, epoch)
+        # Staleness: how old the committed tip already was when this
+        # publish started — commit-to-serve lag, the serving-plane SLO.
+        commit_age = self._commit_age_s(epoch)
+        self._seq += 1
+        prefix = f"publish/{self._ps.name}/s{self._seq}"
+        nbytes = 0
+        out: Dict[str, Any] = {}
+        for i, key in enumerate(sorted(flat)):
+            leaf = np.asarray(flat[key])
+            handle = _eager.broadcast_async(
+                leaf, self._root, name=f"{prefix}/l{i}",
+                process_set=self._ps)
+            out[key] = _eager.synchronize(handle, timeout=self.timeout_s)
+            nbytes += int(leaf.nbytes)
+        latency = time.monotonic() - t0
+        self.last_published_epoch = epoch
+        tag = self._ps.name
+        _metrics.registry.inc("publish.count")
+        _metrics.registry.inc("publish.bytes", nbytes)
+        _metrics.registry.observe("publish.latency_seconds", latency)
+        _metrics.registry.observe(
+            f"publish.latency_seconds#process_set={tag}", latency)
+        if commit_age >= 0:
+            _metrics.registry.observe(
+                f"publish.staleness_seconds#process_set={tag}",
+                commit_age + latency)
+        _metrics.registry.set_gauge(
+            f"publish.epoch#process_set={tag}", epoch)
+        return out
+
+    def _commit_age_s(self, epoch: int) -> float:
+        """Seconds since the chain link for ``epoch`` was committed, from
+        the manifest's mtime (-1 when unreadable — staleness is then
+        unreported rather than wrong)."""
+        path = os.path.join(
+            _checkpoint.checkpoint_path(self.directory, epoch),
+            _checkpoint.CHAIN_MANIFEST)
+        try:
+            return max(0.0, time.time() - os.path.getmtime(path))
+        except OSError:
+            return -1.0

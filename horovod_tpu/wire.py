@@ -35,8 +35,13 @@ FLAG_ALGO_EXT = 0x04
 # Elastic-membership extension (HOROVOD_TPU_ELASTIC=1 only — non-elastic
 # frames never set the bit, so PR 2 abort traffic stays byte-identical).
 FLAG_ELASTIC_EXT = 0x08
+# Process-set extension: every message in the list carries a trailing
+# process_set:i32 (set only when some message targets a non-default set,
+# so default-set-only traffic stays byte-identical to the pre-set wire —
+# golden-frame guarded in tests/test_process_sets.py).
+FLAG_SET_EXT = 0x10
 _KNOWN_FLAGS = (FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
-                | FLAG_ELASTIC_EXT)
+                | FLAG_ELASTIC_EXT | FLAG_SET_EXT)
 
 # Response-cache extension cflags (ResponseList direction only).
 CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
@@ -139,7 +144,8 @@ class _Reader:
         return v
 
 
-def serialize_request(r: Request, with_algo: bool = False) -> bytes:
+def serialize_request(r: Request, with_algo: bool = False,
+                      with_set: bool = False) -> bytes:
     out = bytearray()
     out += struct.pack("<i", r.request_rank)
     out += struct.pack("<i", int(r.request_type))
@@ -153,10 +159,13 @@ def serialize_request(r: Request, with_algo: bool = False) -> bytes:
     _put_str(out, r.wire_dtype)
     if with_algo:
         _put_str(out, getattr(r, "algo", ""))
+    if with_set:
+        out += struct.pack("<i", getattr(r, "process_set", 0))
     return bytes(out)
 
 
-def parse_request(rd: _Reader, with_algo: bool = False) -> Request:
+def parse_request(rd: _Reader, with_algo: bool = False,
+                  with_set: bool = False) -> Request:
     rank = rd.i32()
     rtype = RequestType(rd.i32())
     name = rd.str_()
@@ -167,12 +176,15 @@ def parse_request(rd: _Reader, with_algo: bool = False) -> Request:
     shape = tuple(rd.i64() for _ in range(ndims))
     wire_dtype = rd.str_()
     algo = rd.str_() if with_algo else ""
+    process_set = rd.i32() if with_set else 0
     return Request(request_rank=rank, request_type=rtype, tensor_name=name,
                    tensor_type=dtype, tensor_shape=shape, root_rank=root,
-                   device=device, wire_dtype=wire_dtype, algo=algo)
+                   device=device, wire_dtype=wire_dtype, algo=algo,
+                   process_set=process_set)
 
 
-def serialize_response(r: Response, with_algo: bool = False) -> bytes:
+def serialize_response(r: Response, with_algo: bool = False,
+                       with_set: bool = False) -> bytes:
     out = bytearray()
     out += struct.pack("<i", int(r.response_type))
     out += struct.pack("<i", len(r.tensor_names))
@@ -188,10 +200,13 @@ def serialize_response(r: Response, with_algo: bool = False) -> bytes:
     _put_str(out, r.wire_dtype)
     if with_algo:
         _put_str(out, getattr(r, "algo", ""))
+    if with_set:
+        out += struct.pack("<i", getattr(r, "process_set", 0))
     return bytes(out)
 
 
-def parse_response(rd: _Reader, with_algo: bool = False) -> Response:
+def parse_response(rd: _Reader, with_algo: bool = False,
+                   with_set: bool = False) -> Response:
     rtype = ResponseType(rd.i32())
     names = [rd.str_() for _ in range(rd.i32())]
     error = rd.str_()
@@ -199,9 +214,11 @@ def parse_response(rd: _Reader, with_algo: bool = False) -> Response:
     sizes = [rd.i64() for _ in range(rd.i32())]
     wire_dtype = rd.str_()
     algo = rd.str_() if with_algo else ""
+    process_set = rd.i32() if with_set else 0
     return Response(response_type=rtype, tensor_names=names,
                     error_message=error, devices=devices, tensor_sizes=sizes,
-                    wire_dtype=wire_dtype, algo=algo)
+                    wire_dtype=wire_dtype, algo=algo,
+                    process_set=process_set)
 
 
 def _any_algo(msgs) -> bool:
@@ -209,6 +226,13 @@ def _any_algo(msgs) -> bool:
     # non-empty algo, so ring-only traffic stays byte-identical to the
     # pre-algo wire format.
     return any(getattr(m, "algo", "") for m in msgs)
+
+
+def _any_set(msgs) -> bool:
+    # The set extension bit is set only when some message targets a
+    # non-default process set, so single-tenant traffic stays
+    # byte-identical to the pre-set wire format.
+    return any(getattr(m, "process_set", 0) for m in msgs)
 
 
 def _check_flags(flags: int, what: str) -> None:
@@ -236,13 +260,16 @@ def serialize_request_list(requests: List[Request],
         flags |= FLAG_ALGO_EXT
     if elastic_ext is not None:
         flags |= FLAG_ELASTIC_EXT
+    with_set = _any_set(requests)
+    if with_set:
+        flags |= FLAG_SET_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(requests))
     for r in requests:
-        out += serialize_request(r, with_algo)
+        out += serialize_request(r, with_algo, with_set)
     if cache_ext is not None:
         out += struct.pack("<i", cache_ext.epoch)
         out += struct.pack("<i", len(cache_ext.bits))
@@ -260,9 +287,10 @@ def parse_request_list_elastic(data: bytes) -> Tuple[
     _check_flags(flags, "request list")
     shutdown = bool(flags & FLAG_SHUTDOWN)
     with_algo = bool(flags & FLAG_ALGO_EXT)
+    with_set = bool(flags & FLAG_SET_EXT)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
-    reqs = [parse_request(rd, with_algo) for _ in range(rd.i32())]
+    reqs = [parse_request(rd, with_algo, with_set) for _ in range(rd.i32())]
     ext = None
     if flags & FLAG_CACHE_EXT:
         epoch = rd.i32()
@@ -309,13 +337,16 @@ def serialize_response_list(responses: List[Response],
         flags |= FLAG_ALGO_EXT
     if elastic_ext is not None:
         flags |= FLAG_ELASTIC_EXT
+    with_set = _any_set(responses)
+    if with_set:
+        flags |= FLAG_SET_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
     _put_str(out, abort_reason)
     out += struct.pack("<i", len(responses))
     for r in responses:
-        out += serialize_response(r, with_algo)
+        out += serialize_response(r, with_algo, with_set)
     if cache_ext is not None:
         out += struct.pack("<i", cache_ext.epoch)
         cflags = ((CACHE_SERVED if cache_ext.served_from_cache else 0)
@@ -360,9 +391,11 @@ def parse_response_list_elastic(data: bytes) -> Tuple[
     _check_flags(flags, "response list")
     shutdown = bool(flags & FLAG_SHUTDOWN)
     with_algo = bool(flags & FLAG_ALGO_EXT)
+    with_set = bool(flags & FLAG_SET_EXT)
     abort_rank = rd.i32()
     abort_reason = rd.str_()
-    resps = [parse_response(rd, with_algo) for _ in range(rd.i32())]
+    resps = [parse_response(rd, with_algo, with_set)
+             for _ in range(rd.i32())]
     ext = None
     if flags & FLAG_CACHE_EXT:
         epoch = rd.i32()

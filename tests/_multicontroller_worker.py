@@ -11,6 +11,15 @@ unconditionally under its launcher, ``operations.cc:1435-1532``).
 
 Prints ``LOSS <repr>`` per step and ``EAGER_GATED OK`` when the eager API
 fails fast with the jit-only error.
+
+A fifth argument ``sets`` switches to the multi-tenant scenario: two
+processes on disjoint process sets (``HOROVOD_TPU_PROCESS_SETS`` exported
+by the test) negotiate CONCURRENTLY over the shared coordinator tick —
+each tenant reuses the other's tensor names with different payloads, so
+any cross-talk (cache slot, message table, response routing) shows up as
+a wrong result.  This mode uses the disjoint-runtime TCP plane (no
+``jax.distributed``), so the control-plane env comes from the test;
+prints ``SETS_OK`` plus per-tenant metric markers.
 """
 
 import os
@@ -26,9 +35,14 @@ port = int(sys.argv[3])
 # multi-controller runtime, its allreduce payloads must ride the mesh
 # (ICI on hardware), NOT the TCP data plane.
 coord_port = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+mode = sys.argv[5] if len(sys.argv) > 5 else ""
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-if coord_port:
+if mode == "sets":
+    # Disjoint-runtime TCP plane: HOROVOD_TPU_COORD_ADDR and the
+    # SIZE/RANK/PROCESS_* identity come from the launching test.
+    pass
+elif coord_port:
     os.environ["HOROVOD_TPU_COORD_ADDR"] = f"127.0.0.1:{coord_port}"
 else:
     os.environ.pop("HOROVOD_TPU_COORD_ADDR", None)
@@ -40,10 +54,81 @@ os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-if process_id >= 0:
+if process_id >= 0 and mode != "sets":
     jax.distributed.initialize(f"127.0.0.1:{port}",
                                num_processes=num_processes,
                                process_id=process_id)
+
+if mode == "sets":
+    import numpy as np  # noqa: E402
+
+    import horovod_tpu as hvd  # noqa: E402
+    from horovod_tpu.ops.eager import PerRank  # noqa: E402
+
+    hvd.init()
+    assert hvd.size() == 4 and hvd.process_count() == 2
+    p = hvd.process_index()
+    mine, other = (("tenantA", "tenantB") if p == 0
+                   else ("tenantB", "tenantA"))
+    ps = hvd.process_set_by_name(mine)
+    assert ps is not None and ps.size() == 2, ps
+    assert ps.rank() == 0, ps.rank()
+    other_ps = hvd.process_set_by_name(other)
+    assert other_ps is not None and other_ps.generation == 0
+
+    # Concurrent per-tenant traffic: both tenants use the SAME tensor
+    # names with different payloads, several in flight per tick.
+    base = 1.0 if p == 0 else 100.0
+    for i in range(25):
+        handles = [hvd.allreduce_async(
+            PerRank([np.full((8,), base * (i + 1) + j + k, np.float32)
+                     for j in range(2)]),
+            average=False, name=f"grad.{k}", process_set=ps)
+            for k in range(3)]
+        for k, h in enumerate(handles):
+            out = np.asarray(hvd.synchronize(h))
+            want = 2 * (base * (i + 1) + k) + 1
+            np.testing.assert_allclose(out, np.full((8,), want),
+                                       rtol=1e-6, err_msg=f"i={i} k={k}")
+    # Set-scoped broadcast (set-local root 1) + ragged allgather.
+    out = np.asarray(hvd.broadcast(
+        PerRank([np.zeros(3, np.float32), np.full(3, base, np.float32)]),
+        1, name="publish.tip", process_set=ps))
+    np.testing.assert_allclose(out, np.full(3, base))
+    out = np.asarray(hvd.allgather(
+        PerRank([np.full((1, 2), base, np.float32),
+                 np.full((2, 2), base + 1, np.float32)]),
+        name="gather.tok", process_set=ps))
+    np.testing.assert_allclose(
+        out, np.concatenate([np.full((1, 2), base, np.float32),
+                             np.full((2, 2), base + 1, np.float32)]))
+
+    # The default/world plane is untouched by tenant traffic.
+    out = np.asarray(hvd.allreduce(np.ones(4, np.float32),
+                                   average=False, name="world.sum"))
+    np.testing.assert_allclose(out, np.full(4, 4.0))
+
+    snap = hvd.metrics()
+    assert (f"control.set_requests#process_set={mine}"
+            in snap["counters"]), sorted(snap["counters"])
+    # Zero cross-talk in accounting too: this process never submitted
+    # requests for the other tenant.
+    assert (f"control.set_requests#process_set={other}"
+            not in snap["counters"]), sorted(snap["counters"])
+    if p == 0:
+        # Coordinator-side native per-tenant negotiation series: BOTH
+        # tenants negotiated there, each under its own tag.
+        for t in ("tenantA", "tenantB"):
+            key = f"control.negotiate_seconds#process_set={t}"
+            assert key in snap["histograms"], sorted(
+                k for k in snap["histograms"] if "process_set" in k)
+        print("COORD_SERIES OK", flush=True)
+    # Per-set generations stayed independent (no reconfigures happened).
+    assert ps.generation == 0 and other_ps.generation == 0
+    print("SETS_OK", flush=True)
+    hvd.shutdown()
+    print("DONE", flush=True)
+    sys.exit(0)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402

@@ -79,6 +79,44 @@ class TestSkewDigest:
         assert "gather arrival skew by rank" in out
 
 
+class TestTenantDigest:
+    def _snap(self):
+        def hist(total, count):
+            return {"bounds": [0.001, 0.01, 0.1], "counts": [count, 0, 0, 0],
+                    "sum": total, "count": count}
+        return {"rank": 0, "ts": 100,
+                "counters": {"control.set_requests#process_set=tenantA": 150,
+                             "control.set_requests#process_set=tenantB": 75},
+                "gauges": {"elastic.set_generation#process_set=tenantA": 1,
+                           "publish.epoch#process_set=tenantB": 12},
+                "histograms": {
+                    "control.negotiate_seconds#process_set=tenantA":
+                        hist(0.02, 40),
+                    "publish.staleness_seconds#process_set=tenantB":
+                        hist(3.0, 6)}}
+
+    def test_one_line_per_tenant(self):
+        lines = metrics_watch.render_tenant_summary(self._snap(), "")
+        text = "\n".join(lines)
+        assert "tenants by process set" in text
+        assert "tenant[tenantA]" in text and "tenant[tenantB]" in text
+        a = next(ln for ln in lines if "tenant[tenantA]" in ln)
+        assert "requests=150" in a and "generation=1" in a
+        assert "p50_negotiate" in a
+        b = next(ln for ln in lines if "tenant[tenantB]" in ln)
+        assert "requests=75" in b and "publish_epoch=12" in b
+        assert "staleness=0.5s" in b
+
+    def test_absent_without_tagged_series(self):
+        snap = {"counters": {"control.ticks": 3}, "gauges": {},
+                "histograms": {}}
+        assert metrics_watch.render_tenant_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(), None, "")
+        assert "tenants by process set" in out
+
+
 class TestBadInputs:
     """Missing/empty inputs produce a one-line error, not a traceback or
     silence (PR: static analysis)."""

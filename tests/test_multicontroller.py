@@ -100,6 +100,49 @@ def test_eager_rides_mesh_on_shared_runtime():
         assert "DONE" in out, out
 
 
+def test_disjoint_process_sets_negotiate_concurrently():
+    """Two jobs on disjoint process sets (tenantA: ranks 0-1 on process 0,
+    tenantB: ranks 2-3 on process 1) negotiate CONCURRENTLY over the
+    shared coordinator tick with zero cross-talk: both tenants reuse the
+    same tensor names with different payloads, several in flight per
+    tick, and every result must reduce over its own set only.  Runs on
+    the disjoint-runtime TCP plane (no jax.distributed needed), with the
+    sets registered via HOROVOD_TPU_PROCESS_SETS so the native
+    coordinator parses the same spec (docs/process-sets.md)."""
+    port = _free_port()
+    env = dict(os.environ)
+    procs = []
+    for i in range(2):
+        penv = dict(env)
+        penv.update({
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": "2",
+            "HOROVOD_TPU_SIZE": "4",
+            "HOROVOD_TPU_RANK": str(i * 2),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_PROCESS_SETS": "tenantA:0,1;tenantB:2,3",
+        })
+        penv.pop("HOROVOD_TPU_TIMELINE", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), "0", "sets"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=penv))
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "SETS_OK" in out, out
+        assert "DONE" in out, out
+    # The coordinator process saw BOTH tenants' native negotiation series.
+    assert "COORD_SERIES OK" in outs[0], outs[0]
+
+
 def test_jit_only_mid_step_peer_crash_is_bounded():
     """Jit-only mode, peer dies MID-STEP: the survivor must terminate
     promptly (step watchdog abort, exit 83, or a surfaced runtime

@@ -307,6 +307,93 @@ class TestNativeParity:
         nat.close()
 
 
+# ---------------------------------------------------- per-set scoping
+
+def _native_set_policy_available() -> bool:
+    lib = cpp_core._policy_lib()
+    return lib is not None and hasattr(lib, "htpu_policy_observe_set")
+
+
+class TestPerSetScoping:
+    def test_slowness_stays_in_its_set(self, monkeypatch):
+        """Regression (PR 15): a straggler whose ticks are attributed to
+        one tenant's set is nominated from THAT set only — the default
+        set (pod eviction) and other tenants see a healthy fleet, and
+        the pod-global ring order is untouched."""
+        arm_eviction(monkeypatch, ticks="3", max_evict="4")
+        p = FleetPolicy()
+        for tick in range(1, 5):
+            # Processes 1 and 2 tick in set 1; process 2 is its straggler.
+            p.observe_tick(tick, [0.0, 0.001, 0.05], set_attr=[0, 1, 1])
+            # Set 2 runs elsewhere, healthy.
+            p.observe_tick_set(2, [-1.0, 0.002, 0.003])
+        assert p.ewma(2) == -1.0            # no default-set sample at all
+        assert p.ewma_set(1, 2) == pytest.approx(0.05)
+        assert p.consecutive_slow_set(1, 2) >= 3
+        assert p.next_eviction(3, True) == -1
+        assert p.next_eviction_set(2, 3, True) == -1
+        assert p.next_eviction_set(1, 3, True) == 2
+        # Ring re-rank is pod-global: only default-set EWMAs drive it.
+        assert p.rerank_order([1, 2]) == [1, 2]
+
+    def test_empty_attribution_is_bit_identical_to_preset(self, monkeypatch):
+        """``set_attr=()`` (the pre-set call shape) and an explicit
+        all-default attribution must walk the exact same state."""
+        arm_eviction(monkeypatch, ticks="3")
+        a, b = FleetPolicy(), FleetPolicy()
+        waves = ([[0.0, 0.001, 0.05]] * 4 + [[0.0, 0.001, 0.0]]
+                 + [[0.0, 0.001, 0.05]] * 3)
+        for tick, w in enumerate(waves, start=1):
+            a.observe_tick(tick, w)
+            b.observe_tick(tick, w, set_attr=[0, 0, 0])
+            for proc in range(3):
+                assert a.ewma(proc) == b.ewma(proc)
+                assert a.consecutive_slow(proc) == b.consecutive_slow(proc)
+            assert a.next_eviction(3, True) == b.next_eviction(3, True)
+
+    def test_budget_is_shared_across_sets(self, monkeypatch):
+        """One global eviction budget: a tenant-set eviction spends it,
+        and the next default-set straggler is suppressed (counted +
+        logged), not demoted."""
+        arm_eviction(monkeypatch, ticks="2", max_evict="1")
+        p = FleetPolicy()
+        for tick in range(1, 4):
+            p.observe_tick(tick, [0.0, 0.001, 0.05], set_attr=[0, 1, 1])
+        assert p.next_eviction_set(1, 3, True) == 2
+        assert p.evictions == 1
+        for tick in range(4, 7):
+            p.observe_tick(tick, [0.0, 0.05, 0.001])
+        assert p.next_eviction(3, True) == -1
+        assert registry.snapshot()["counters"][
+            "policy.evictions_suppressed"] >= 1
+
+    @pytest.mark.skipif(not _native_set_policy_available(),
+                        reason="native core without per-set policy")
+    def test_native_per_set_parity(self, monkeypatch):
+        arm_eviction(monkeypatch, ticks="3", max_evict="4")
+        py = FleetPolicy()
+        nat = cpp_core.NativeFleetPolicy()
+        waves = ([[-1.0, 0.001, 0.05]] * 4 + [[-1.0, 0.001, 0.0]] * 2
+                 + [[-1.0, 0.001, 0.05]] * 4)
+        try:
+            for tick, w in enumerate(waves, start=1):
+                py.observe_tick_set(1, w)
+                nat.observe_tick_set(1, w)
+                py.observe_tick(tick, [0.001, 0.002, 0.001])
+                nat.observe_tick(tick, [0.001, 0.002, 0.001])
+                for proc in range(3):
+                    assert nat.ewma_set(1, proc) == pytest.approx(
+                        py.ewma_set(1, proc)), (tick, proc)
+                    assert nat.consecutive_slow_set(1, proc) == \
+                        py.consecutive_slow_set(1, proc), (tick, proc)
+                assert nat.next_eviction_set(1, 3, True) == \
+                    py.next_eviction_set(1, 3, True), tick
+                assert nat.next_eviction(3, True) == \
+                    py.next_eviction(3, True), tick
+        finally:
+            nat.close()
+
+
 # --------------------------------------------------- fault-spec grammar
 
 class TestSlowFaultSpec:

@@ -1,0 +1,535 @@
+"""Multi-tenant process sets: spec parsing, registry behaviour, the
+FLAG_SET_EXT wire extension (with the default-set golden-frame byte pin),
+native/Python registry parity, the set-scoped host data plane, and the
+parameter-publish serving plane.
+
+The contract under test (docs/process-sets.md): two disjoint sets
+negotiate with zero cross-talk — each set owns a MessageTable indexed by
+SET-LOCAL rank plus its own cache slots — while traffic that never names
+a set stays byte-identical to the pre-PR wire format.
+"""
+
+import struct
+import types
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cpp_core, wire
+from horovod_tpu import metrics as hmetrics
+from horovod_tpu import process_set as psmod
+from horovod_tpu.core import Request, RequestType, Response, ResponseType
+
+
+# ------------------------------------------------------------ spec parsing
+
+def test_parse_spec_valid():
+    assert psmod.parse_spec("tenantA:0,1;tenantB:2,3") == [
+        ("tenantA", [0, 1]), ("tenantB", [2, 3])]
+    # Whitespace and empty entries (trailing ';') are tolerated.
+    assert psmod.parse_spec(" a : 4 ; ") == [("a", [4])]
+    assert psmod.parse_spec("") == []
+
+
+@pytest.mark.parametrize("spec", [
+    "noranks",            # no colon
+    ":0,1",               # no name
+    "a:0,x",              # non-integer rank
+    "a:-1",               # negative rank
+    "a:",                 # empty rank list
+])
+def test_parse_spec_malformed(spec):
+    with pytest.raises(ValueError, match="malformed|non-negative"):
+        psmod.parse_spec(spec)
+
+
+# ---------------------------------------------------------------- registry
+
+def _reg():
+    return psmod.ProcessSetRegistry(cache_capacity=4)
+
+
+def test_registry_add_and_queries():
+    reg = _reg()
+    a = reg.add("a", [1, 0])          # unsorted input → ascending members
+    b = reg.add("b", [2, 3])
+    assert (a, b) == (1, 2)           # ids start at 1, registration order
+    assert reg.count() == 2
+    assert reg.id_of("b") == b and reg.id_of("nope") == -1
+    assert reg.get(a).ranks == (0, 1)
+    assert reg.by_name("a").id == a
+    assert reg.size_of(a) == 2 and reg.size_of(99) == -1
+    assert reg.local_rank(b, 3) == 1
+    assert reg.local_rank(b, 0) == -1      # not a member
+    assert reg.generation(a) == 0 and reg.generation(99) == -1
+    # Rejections: empty membership, duplicate rank, duplicate name.
+    assert reg.add("c", []) == -1
+    assert reg.add("c", [4, 4]) == -1
+    assert reg.add("a", [5]) == -1
+    assert reg.count() == 2
+
+
+def test_registry_remove():
+    reg = _reg()
+    sid = reg.add("gone", [0, 1])
+    assert reg.remove(sid) and not reg.remove(sid)
+    assert reg.get(sid) is None and reg.count() == 0
+    # Ids are never reused — a stale id cannot alias a new tenant.
+    assert reg.add("next", [0]) == sid + 1
+
+
+def test_registry_reconfigure_drops_rank_and_retires_series():
+    reg = _reg()
+    sid = reg.add("elastic", [0, 2, 4])
+    hmetrics.registry.set_gauge(
+        "publish.epoch#process_set=elastic", 7)
+    hmetrics.registry.observe(
+        "control.tick_seconds#process_set=elastic", 0.5)
+    hmetrics.registry.inc("control.set_requests#process_set=elastic", 3)
+    assert reg.reconfigure(sid, 2) == 1
+    ps = reg.get(sid)
+    assert ps.ranks == (0, 4) and ps.generation == 1
+    assert ps.local_rank(4) == 1           # set-local ranks re-packed
+    snap = hmetrics.registry.snapshot()
+    # Tagged gauges/histograms retired; counters survive as totals; the
+    # generation gauge is re-published for the new membership.
+    assert "publish.epoch#process_set=elastic" not in snap["gauges"]
+    assert ("control.tick_seconds#process_set=elastic"
+            not in snap["histograms"])
+    assert snap["counters"]["control.set_requests#process_set=elastic"] == 3
+    assert snap["gauges"]["elastic.set_generation#process_set=elastic"] == 1
+    # Unknown set / rank not in the set: -1, nothing changes.
+    assert reg.reconfigure(99, 0) == -1
+    assert reg.reconfigure(sid, 2) == -1
+    assert reg.get(sid).generation == 1
+
+
+def _set_req(rank, name="g", set_id=1, rtype=RequestType.ALLREDUCE,
+             shape=(4,)):
+    return Request(request_rank=rank, request_type=rtype,
+                   tensor_name=name, tensor_type="float32",
+                   tensor_shape=shape, device=rank, process_set=set_id)
+
+
+def test_registry_increment_and_construct():
+    reg = _reg()
+    sid = reg.add("neg", [0, 1])
+    assert reg.increment(sid, _set_req(0, set_id=sid)) == 0
+    assert reg.increment(sid, _set_req(1, set_id=sid)) == 1
+    resp = reg.construct_response(sid, "g")
+    assert resp.response_type == ResponseType.ALLREDUCE
+    assert resp.tensor_names == ["g"]
+    assert resp.process_set == sid         # stamped for routing
+    # Guards: set-local rank out of range, unknown set.
+    assert reg.increment(sid, _set_req(2, set_id=sid)) == -1
+    assert reg.increment(99, _set_req(0)) == -1
+    with pytest.raises(KeyError):
+        reg.construct_response(99, "g")
+
+
+def test_clear_negotiation_state_keeps_membership():
+    reg = _reg()
+    sid = reg.add("quiesce", [0, 1])
+    reg.increment(sid, _set_req(0, set_id=sid))
+    reg.clear_negotiation_state()
+    ps = reg.get(sid)
+    assert ps.ranks == (0, 1) and ps.generation == 0
+    # The half-negotiated tensor was dropped: rank 1 alone cannot finish.
+    assert reg.increment(sid, _set_req(1, set_id=sid)) == 0
+    assert reg.increment(sid, _set_req(0, set_id=sid)) == 1
+
+
+# -------------------------------------------------------------------- wire
+
+def _s(txt):
+    b = txt.encode()
+    return struct.pack("<i", len(b)) + b
+
+
+def _legacy_request_blob(flags=0, tail=b""):
+    """Hand-built pre-PR frame for one default-set allreduce request
+    (same layout test_algo_selection pins for the algo extension)."""
+    return (struct.pack("<B", flags)
+            + struct.pack("<i", -1) + _s("")           # no abort
+            + struct.pack("<i", 1)                     # one request
+            + struct.pack("<i", 0)                     # request_rank
+            + struct.pack("<i", int(RequestType.ALLREDUCE))
+            + _s("grad/w") + _s("float32")
+            + struct.pack("<i", -1)                    # root_rank
+            + struct.pack("<i", 0)                     # device
+            + struct.pack("<i", 2)                     # ndims
+            + struct.pack("<q", 3) + struct.pack("<q", 5)
+            + _s("")                                   # wire_dtype
+            + tail)
+
+
+def _plain_req(set_id=0):
+    return Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                   tensor_name="grad/w", tensor_type="float32",
+                   tensor_shape=(3, 5), device=0, process_set=set_id)
+
+
+def test_default_set_frames_byte_identical_to_legacy():
+    """A request list that never names a set must not set FLAG_SET_EXT and
+    must match the pre-process-set wire format byte for byte (golden
+    frame — the acceptance pin for the extension's opt-in encoding)."""
+    blob = wire.serialize_request_list([_plain_req()])
+    assert not blob[0] & wire.FLAG_SET_EXT
+    assert blob == _legacy_request_blob()
+    rblob = wire.serialize_response_list(
+        [Response(ResponseType.ALLREDUCE, ["grad/w"], devices=[0])])
+    assert not rblob[0] & wire.FLAG_SET_EXT
+
+
+def test_set_tagged_request_frame_and_roundtrip():
+    """One set-tagged request flips FLAG_SET_EXT for the whole list and
+    appends exactly one little-endian i32 per request after wire_dtype."""
+    blob = wire.serialize_request_list([_plain_req(set_id=3)])
+    assert blob[0] & wire.FLAG_SET_EXT
+    assert blob == _legacy_request_blob(flags=wire.FLAG_SET_EXT,
+                                        tail=struct.pack("<i", 3))
+    back, shutdown, abort = wire.parse_request_list(blob)
+    assert not shutdown and abort is None
+    assert back[0].process_set == 3
+    assert back[0].tensor_shape == (3, 5)
+    # Mixed list: the default-set request parses back as set 0.
+    blob = wire.serialize_request_list([_plain_req(), _plain_req(set_id=2)])
+    back, _, _ = wire.parse_request_list(blob)
+    assert [r.process_set for r in back] == [0, 2]
+
+
+def test_set_tagged_response_roundtrip():
+    resps = [Response(ResponseType.ALLREDUCE, ["g"], devices=[0, 1],
+                      tensor_sizes=[4, 4], process_set=2),
+             Response(ResponseType.BROADCAST, ["tip"], devices=[0])]
+    blob = wire.serialize_response_list(resps)
+    assert blob[0] & wire.FLAG_SET_EXT
+    back, _, _ = wire.parse_response_list(blob)
+    assert [r.process_set for r in back] == [2, 0]
+    assert back[0].tensor_names == ["g"]
+    # Default-only response lists keep the flag clear.
+    blob = wire.serialize_response_list(
+        [Response(ResponseType.ALLREDUCE, ["g"], devices=[0])])
+    assert not blob[0] & wire.FLAG_SET_EXT
+
+
+# ----------------------------------------------------------- native parity
+
+needs_native_sets = pytest.mark.skipif(
+    cpp_core._process_sets_lib() is None,
+    reason="native core without process-set API")
+
+
+@needs_native_sets
+def test_native_registry_parity():
+    """The native ProcessSetTable and the Python mirror must agree on the
+    whole registration lifecycle: ids, sizes, set-local ranks,
+    reconfiguration generations, removal."""
+    cpp = cpp_core.CppProcessSetTable(cache_capacity=4)
+    py = _reg()
+    try:
+        assert cpp.parse_spec("a:0,1;b:2,3") and py.parse_spec("a:0,1;b:2,3")
+        assert cpp.add("c", [4, 5]) == py.add("c", [4, 5]) == 3
+        assert not cpp.parse_spec("bad") and not py.parse_spec("bad")
+        # Duplicate name/rank rejected identically.
+        assert cpp.add("a", [6]) == py.add("a", [6]) == -1
+        assert cpp.add("d", [7, 7]) == py.add("d", [7, 7]) == -1
+        for name in ("a", "b", "c", "zz"):
+            assert cpp.id_of(name) == py.id_of(name)
+        assert cpp.count() == py.count() == 3
+        for sid in (1, 2, 3, 9):
+            assert cpp.size_of(sid) == py.size_of(sid)
+            assert cpp.generation(sid) == py.generation(sid)
+            for g in range(6):
+                assert cpp.local_rank(sid, g) == py.local_rank(sid, g)
+        assert cpp.reconfigure(1, 1) == py.reconfigure(1, 1) == 1
+        assert cpp.size_of(1) == py.size_of(1) == 1
+        assert cpp.reconfigure(1, 1) == py.reconfigure(1, 1) == -1
+        assert cpp.remove(2) == py.remove(2) is True
+        assert cpp.count() == py.count() == 2
+        assert cpp.id_of("b") == py.id_of("b") == -1
+    finally:
+        cpp.close()
+
+
+@needs_native_sets
+def test_native_increment_construct_parity():
+    """One full set-scoped negotiation, native vs Python: readiness
+    transitions and the constructed response must match."""
+    cpp = cpp_core.CppProcessSetTable(cache_capacity=4)
+    py = _reg()
+    try:
+        sid = cpp.add("n", [2, 5])
+        assert py.add("n", [2, 5]) == sid
+        reqs = [Request(request_rank=i, request_type=RequestType.ALLREDUCE,
+                        tensor_name="g", tensor_type="float32",
+                        tensor_shape=(4,), device=g, process_set=sid)
+                for i, g in enumerate((2, 5))]
+        assert cpp.increment(sid, reqs[0]) == py.increment(sid, reqs[0]) == 0
+        assert cpp.increment(sid, reqs[1]) == py.increment(sid, reqs[1]) == 1
+        a, b = cpp.construct_response(sid, "g"), py.construct_response(sid, "g")
+        assert a.response_type == b.response_type == ResponseType.ALLREDUCE
+        assert a.tensor_names == b.tensor_names == ["g"]
+        assert a.process_set == b.process_set == sid
+        # Out-of-range set-local rank rejected on both sides.
+        bad = Request(request_rank=2, request_type=RequestType.ALLREDUCE,
+                      tensor_name="g2", tensor_type="float32",
+                      tensor_shape=(4,), device=9, process_set=sid)
+        assert cpp.increment(sid, bad) == py.increment(sid, bad) == -1
+        assert cpp.increment(99, reqs[0]) == py.increment(99, reqs[0]) == -1
+    finally:
+        cpp.close()
+
+
+# ------------------------------------------------- set-scoped host execution
+
+def _entry(rtype, per_rank, dtype="float32", average=False, root_rank=-1):
+    return types.SimpleNamespace(request_type=rtype, per_rank=per_rank,
+                                 dtype=dtype, average=average,
+                                 root_rank=root_rank)
+
+
+def test_execute_host_allreduce():
+    e = _entry(RequestType.ALLREDUCE,
+               [np.full(3, 1.0, np.float32), np.full(3, 2.0, np.float32)],
+               average=True)
+    np.testing.assert_allclose(psmod.execute_host(e, 2), np.full(3, 1.5))
+    e = _entry(RequestType.ALLREDUCE,
+               [np.array([1, 2], np.int32), np.array([2, 3], np.int32)],
+               dtype="int32", average=True)
+    out = psmod.execute_host(e, 2)
+    assert out.dtype == np.int32          # integer average floor-divides
+    np.testing.assert_array_equal(out, [1, 2])
+
+
+def test_execute_host_allgather_and_broadcast():
+    e = _entry(RequestType.ALLGATHER,
+               [np.full((1, 2), 0.0), np.full((2, 2), 1.0)])
+    assert psmod.execute_host(e, 2).shape == (3, 2)
+    e = _entry(RequestType.BROADCAST,
+               [np.zeros(2), np.full(2, 9.0)], root_rank=1)
+    np.testing.assert_allclose(psmod.execute_host(e, 2), np.full(2, 9.0))
+    e = _entry(RequestType.BROADCAST, [np.zeros(2)], root_rank=3)
+    with pytest.raises(ValueError, match="root rank"):
+        psmod.execute_host(e, 1)
+
+
+# ------------------------------------------- eager two-tenant (live runtime)
+
+def test_two_tenants_negotiate_with_zero_cross_talk(hvd):
+    """Single-process, 8 virtual chips: two disjoint 2-member tenants
+    reuse the SAME tensor names with different payloads — every result
+    must reduce over its own set only, land as a host ndarray, and the
+    default/world plane must be untouched."""
+    from horovod_tpu.ops.eager import PerRank
+    ta = hvd.add_process_set([0, 1], name="xtA")
+    tb = hvd.add_process_set([2, 3], name="xtB")
+    try:
+        assert ta.rank() == 0 and ta.size() == 2
+        for i in range(3):
+            outs = {}
+            for ps, base in ((ta, 1.0), (tb, 100.0)):
+                per = PerRank([np.full(4, base + i + j, np.float32)
+                               for j in range(2)])
+                outs[ps.name] = hvd.allreduce(per, average=False,
+                                              name=f"grad.{i}",
+                                              process_set=ps)
+            np.testing.assert_allclose(np.asarray(outs["xtA"]),
+                                       np.full(4, 2 * (1.0 + i) + 1))
+            np.testing.assert_allclose(np.asarray(outs["xtB"]),
+                                       np.full(4, 2 * (100.0 + i) + 1))
+        # average + set broadcast (set-local root) + ragged allgather.
+        out = hvd.allreduce(PerRank([np.zeros(2, np.float32),
+                                     np.full(2, 4.0, np.float32)]),
+                            name="avg", process_set="xtA")
+        np.testing.assert_allclose(np.asarray(out), np.full(2, 2.0))
+        out = hvd.broadcast(PerRank([np.zeros(3, np.float32),
+                                     np.full(3, 7.0, np.float32)]),
+                            1, name="tip", process_set=tb)
+        np.testing.assert_allclose(np.asarray(out), np.full(3, 7.0))
+        out = hvd.allgather(PerRank([np.full((1, 2), 0.0, np.float32),
+                                     np.full((2, 2), 1.0, np.float32)]),
+                            name="tok", process_set=ta.id)
+        assert np.asarray(out).shape == (3, 2)
+        # World traffic alongside, over all 8 chips, unaffected.
+        out = hvd.allreduce(np.ones(4, np.float32), average=False,
+                            name="world")
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 8.0))
+        snap = hvd.metrics()
+        for t in ("xtA", "xtB"):
+            assert snap["counters"][
+                f"control.set_requests#process_set={t}"] > 0
+            assert (f"control.tick_seconds#process_set={t}"
+                    in snap["histograms"])
+    finally:
+        hvd.remove_process_set(ta)
+        hvd.remove_process_set(tb)
+
+
+def test_per_set_reconfigure_touches_only_that_set(hvd):
+    from horovod_tpu.ops.eager import PerRank
+    a = hvd.add_process_set([0, 1, 2], name="xrA")
+    b = hvd.add_process_set([3, 4], name="xrB")
+    try:
+        gen = hvd.reconfigure_process_set(a, 1)
+        assert gen == 1 and a.ranks == (0, 2) and b.generation == 0
+        snap = hvd.metrics()
+        assert snap["gauges"][
+            "elastic.set_generation#process_set=xrA"] == 1
+        # The shrunken set keeps working with 2-member contributions.
+        out = hvd.allreduce(PerRank([np.ones(2, np.float32),
+                                     np.full(2, 2.0, np.float32)]),
+                            average=False, name="post", process_set=a)
+        np.testing.assert_allclose(np.asarray(out), np.full(2, 3.0))
+        # Losing a rank no set contains reconfigures nothing.
+        assert hvd.reconfigure_process_set(b, 0) == -1
+        assert b.generation == 0
+    finally:
+        hvd.remove_process_set(a)
+        hvd.remove_process_set(b)
+
+
+def test_add_process_set_errors_and_resolution(hvd):
+    ps = hvd.add_process_set([0, 1])
+    try:
+        assert ps.name == "set_0,1"        # auto-name from the members
+        with pytest.raises(ValueError, match="rejected"):
+            hvd.add_process_set([2], name=ps.name)
+        assert psmod.resolve(ps.name) is psmod.resolve(ps.id)
+        with pytest.raises(ValueError, match="Unknown process set"):
+            psmod.resolve("never-registered")
+        assert not hvd.remove_process_set("never-registered")
+        assert hvd.process_set_by_name(ps.name) is ps
+    finally:
+        hvd.remove_process_set(ps)
+    assert hvd.process_set_by_name(ps.name) is None
+
+
+# ----------------------------------------------- parameter-publish serving
+
+def _flat(scale):
+    return {"['w']": np.arange(6, dtype=np.float32).reshape(2, 3) * scale,
+            "['b']": np.full(2, float(scale), np.float32)}
+
+
+def test_publisher_streams_committed_tips(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    from horovod_tpu.publish import ParameterPublisher
+    d = str(tmp_path)
+    ps = hvd.add_process_set([0, 1], name="xpub")
+    try:
+        pub = ParameterPublisher(d, ps, every=2)
+        assert pub.committed_tip() == -1 and pub.poll() is None
+        checkpoint.save_chain(d, _flat(1), 0)
+        checkpoint.save_chain(d, _flat(2), 1, prev_epoch=0,
+                              prev_flat=_flat(1))
+        # First publish fires on ANY committed tip regardless of `every`.
+        assert pub.pending_epoch() == 1
+        out = pub.poll()
+        assert pub.last_published_epoch == 1
+        for k, v in _flat(2).items():
+            np.testing.assert_allclose(np.asarray(out[k]), v)
+        assert pub.poll() is None          # nothing new committed
+        # One epoch past the last publish < every=2 → not yet due.
+        checkpoint.save_chain(d, _flat(3), 2, prev_epoch=1,
+                              prev_flat=_flat(2))
+        assert pub.pending_epoch() == -1 and pub.poll() is None
+        checkpoint.save_chain(d, _flat(4), 3, prev_epoch=2,
+                              prev_flat=_flat(3))
+        out = pub.poll()
+        assert pub.last_published_epoch == 3
+        np.testing.assert_allclose(np.asarray(out["['b']"]),
+                                   np.full(2, 4.0))
+        snap = hvd.metrics()
+        assert snap["counters"]["publish.count"] >= 2
+        assert snap["counters"]["publish.bytes"] > 0
+        assert snap["gauges"]["publish.epoch#process_set=xpub"] == 3
+        assert "publish.latency_seconds" in snap["histograms"]
+        assert ("publish.latency_seconds#process_set=xpub"
+                in snap["histograms"])
+        assert ("publish.staleness_seconds#process_set=xpub"
+                in snap["histograms"])
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_publisher_only_sees_committed_epochs(hvd, tmp_path):
+    """A torn tip (a chain whose middle link vanished) must be skipped:
+    the publisher streams the newest RESTORABLE epoch, like recovery."""
+    import shutil
+    from horovod_tpu import checkpoint
+    from horovod_tpu.publish import ParameterPublisher
+    d = str(tmp_path)
+    ps = hvd.add_process_set([0, 1], name="xtorn")
+    try:
+        checkpoint.save_chain(d, _flat(1), 0)
+        checkpoint.save_chain(d, _flat(2), 1, prev_epoch=0,
+                              prev_flat=_flat(1))
+        checkpoint.save_chain(d, _flat(3), 2, prev_epoch=1,
+                              prev_flat=_flat(2))
+        # Tear the chain: epoch 2's replay needs link 1, which vanished.
+        shutil.rmtree(checkpoint.checkpoint_path(d, 1))
+        pub = ParameterPublisher(d, ps)
+        assert pub.committed_tip() == 0
+        out = pub.poll()
+        assert pub.last_published_epoch == 0
+        np.testing.assert_allclose(np.asarray(out["['b']"]),
+                                   np.full(2, 1.0))
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_publisher_validation(hvd, tmp_path):
+    from horovod_tpu.publish import ParameterPublisher
+    ps = hvd.add_process_set([0, 1], name="xval")
+    try:
+        with pytest.raises(ValueError, match="root rank"):
+            ParameterPublisher(str(tmp_path), ps, root_rank=2)
+        pub = ParameterPublisher(str(tmp_path), ps)
+        with pytest.raises(ValueError, match="no committed checkpoint"):
+            pub.publish()
+    finally:
+        hvd.remove_process_set(ps)
+
+
+@pytest.mark.slow
+def test_publish_while_training_drill():
+    """End-to-end serving-plane drill (bench.py PUBLEG leg): two
+    processes train on the world set over the TCP control plane while
+    committed chain tips stream to the ``serve`` set — training never
+    aborts, every publish is a committed epoch, and latency/staleness
+    are measured."""
+    import os
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    saved = sys.argv
+    sys.argv = ["bench.py"]
+    try:
+        import bench
+    finally:
+        sys.argv = saved
+    r = bench._publish_drill()
+    assert r["publishes"] >= 2
+    assert r["publish_bytes"] > 0
+    assert r["publish_epoch"] >= 1
+    assert r["publish_latency_s"] is not None and r["publish_latency_s"] > 0
+    assert r["staleness_s"] is not None and r["staleness_s"] > 0
+    assert r["step_seconds_publishing"] > 0
+
+
+def test_publish_knob_defaults(monkeypatch):
+    from horovod_tpu import publish
+    monkeypatch.delenv("HOROVOD_TPU_PUBLISH_EVERY", raising=False)
+    monkeypatch.delenv("HOROVOD_TPU_PUBLISH_TIMEOUT_S", raising=False)
+    assert publish.publish_every_default() == 1
+    assert publish.publish_timeout_default() == 60.0
+    monkeypatch.setenv("HOROVOD_TPU_PUBLISH_EVERY", "5")
+    monkeypatch.setenv("HOROVOD_TPU_PUBLISH_TIMEOUT_S", "2.5")
+    assert publish.publish_every_default() == 5
+    assert publish.publish_timeout_default() == 2.5
+    monkeypatch.setenv("HOROVOD_TPU_PUBLISH_EVERY", "0")
+    monkeypatch.setenv("HOROVOD_TPU_PUBLISH_TIMEOUT_S", "junk")
+    assert publish.publish_every_default() == 1
+    assert publish.publish_timeout_default() == 60.0

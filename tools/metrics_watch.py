@@ -229,6 +229,50 @@ def render_ckpt_summary(snap: dict, name_filter: str) -> list:
             f"  {'ckpt':<52} {text}"]
 
 
+def render_tenant_summary(snap: dict, name_filter: str) -> list[str]:
+    """Per-tenant digest: one line per process set, joining every series
+    tagged ``#process_set=<name>`` (docs/process-sets.md) — request
+    counts, negotiation/tick p50s, membership generation, and the
+    publish plane's epoch/staleness.  Present only on multi-tenant
+    jobs."""
+    tag = "#process_set="
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    tenants = sorted({k.split(tag, 1)[1]
+                      for d in (counters, gauges, hists)
+                      for k in d if tag in k})
+    if not tenants:
+        return []
+    lines = []
+    for t in tenants:
+        name = f"tenant[{t}]"
+        if name_filter and name_filter not in name:
+            continue
+        text = (f"requests="
+                f"{counters.get(f'control.set_requests{tag}{t}', 0):g}")
+        for label, series in (
+                ("negotiate", f"control.negotiate_seconds{tag}{t}"),
+                ("tick", f"control.tick_seconds{tag}{t}")):
+            med = hist_median(hists.get(series, {}))
+            if med is not None:
+                text += f" p50_{label}={med * 1e3:.3g}ms"
+        gen = gauges.get(f"elastic.set_generation{tag}{t}")
+        if gen is not None:
+            text += f" generation={int(gen)}"
+        epoch = gauges.get(f"publish.epoch{tag}{t}")
+        if epoch is not None:
+            text += f" publish_epoch={int(epoch)}"
+        stale = hists.get(f"publish.staleness_seconds{tag}{t}", {})
+        if stale.get("count"):
+            text += (f" staleness="
+                     f"{stale.get('sum', 0.0) / stale['count']:.3g}s")
+        lines.append(f"  {name:<52} {text}")
+    if lines:
+        lines.insert(0, "  -- tenants by process set --")
+    return lines
+
+
 def render_overlap_summary(snap: dict, name_filter: str) -> list[str]:
     """One-line overlap digest per rank: bucket count, p50 hidden
     fraction (share of each step's comm span that hid under backward
@@ -305,6 +349,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_elastic_summary(snap, name_filter))
     lines.extend(render_ckpt_summary(snap, name_filter))
     lines.extend(render_overlap_summary(snap, name_filter))
+    lines.extend(render_tenant_summary(snap, name_filter))
     return "\n".join(lines)
 
 
