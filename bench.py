@@ -1125,6 +1125,57 @@ def solo_worker():
         {"images_per_sec": round(batch * iters / dt, 2)}), flush=True)
 
 
+def xport_worker():
+    """One rank of the per-hop transport microbench (spawned under
+    ``horovod_tpu.run`` by the xport_sweep leg): eager allreduces of bare
+    numpy payloads across a sweep of sizes, each timed per call, so every
+    configured leg — shm fan-in, io_uring ring, classic TCP ring, UDS —
+    yields a latency/bandwidth curve with no model in the way.  Rank 0
+    prints one ``XPORTLEG`` JSON line with the curve and the transports
+    the native plane actually selected (a leg that silently fell back
+    must be visible in the artifact, not mislabeled)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+
+    hvd.init()
+    iters = int(os.environ.get("BENCH_XPORT_ITERS", "30"))
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_XPORT_SIZES",
+        "4096,65536,262144,1048576,4194304").split(",")]
+    curve = []
+    for nbytes in sizes:
+        buf = np.ones(nbytes // 4, np.float32)
+        for _ in range(3):   # negotiation + response-cache ramp
+            hvd.allreduce(buf, average=False, name=f"xp.{nbytes}")
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            hvd.allreduce(buf, average=False, name=f"xp.{nbytes}")
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        curve.append({"bytes": nbytes,
+                      "p50_us": round(p50 * 1e6, 1),
+                      "mbps": round(nbytes / p50 / 1e6, 1)})
+    if hvd.rank() == 0:
+        control = getattr(basics.controller(), "_control", None)
+        print("XPORTLEG " + json.dumps({
+            "data_transport": (control.data_transport()
+                               if control is not None
+                               and hasattr(control, "data_transport")
+                               else "none"),
+            "ring_transport": (control.ring_transport()
+                               if control is not None
+                               and hasattr(control, "ring_transport")
+                               else "none"),
+            "sizes": curve}), flush=True)
+    hvd.shutdown()
+
+
 def recovery_worker():
     """One rank of the chaos recovery drill (BENCH_RECOVERY_* env).
 
@@ -1865,6 +1916,105 @@ def bench_scaling_tcp():
             publish = {"error": f"{type(e).__name__}: {e}"}  # the leg
     else:
         publish = {"skipped": "BENCH_PUBLISH=0"}
+
+    def run_xport_leg(extra_env):
+        """One 2-process microbench leg (bare-payload allreduce sweep)
+        under a forced transport configuration; returns the XPORTLEG
+        curve printed by rank 0 of the child job."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("HOROVOD_TPU_WIRE_DTYPE", None)
+        env.pop("BENCH_TCP_PIN", None)
+        env.update(extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             "--", sys.executable, os.path.abspath(__file__),
+             "--xport-worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+            proc.wait()
+            raise
+        for line in stdout.splitlines():
+            if line.startswith("XPORTLEG "):
+                return json.loads(line[len("XPORTLEG "):])
+        raise RuntimeError(
+            f"xport leg produced no XPORTLEG line:\n"
+            f"{stdout[-2000:]}\n{stderr[-2000:]}")
+
+    # Per-hop transport microbench: the same bare-payload sweep under
+    # each data-plane configuration.  Both processes share this host, so
+    # `hier` forms one 2-process group — its intra-host leg IS the hop
+    # under test (UDS sockets vs the shm segment), while the `ring` legs
+    # compare the leader-ring hop (classic TCP vs io_uring).  Same
+    # windows policy as the throughput legs: best per size across
+    # BENCH_XPORT_WINDOWS runs, so the curves report transport
+    # capability, not scheduler luck on a shared host.
+    if os.environ.get("BENCH_XPORT", "1") == "1":
+        xwindows = max(1, int(os.environ.get("BENCH_XPORT_WINDOWS", "3")))
+        xlegs = (
+            ("uds", {"HOROVOD_TPU_ALLREDUCE_ALGO": "hier",
+                     "HOROVOD_TPU_TRANSPORT": "classic"}),
+            ("shm", {"HOROVOD_TPU_ALLREDUCE_ALGO": "hier",
+                     "HOROVOD_TPU_TRANSPORT": "shm"}),
+            ("classic", {"HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                         "HOROVOD_TPU_TRANSPORT": "classic",
+                         "HOROVOD_TPU_UDS": "0"}),
+            ("uring", {"HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                       "HOROVOD_TPU_TRANSPORT": "uring",
+                       "HOROVOD_TPU_UDS": "0"}))
+        # Interleave the windows across legs (uds shm classic uring, then
+        # again) rather than exhausting one leg's windows before the next:
+        # the legs being ratioed below then sample the SAME stretch of
+        # wall clock, so a transient stall on a shared host taxes them
+        # about equally instead of skewing whichever leg it landed on.
+        xruns = {label: [] for label, _ in xlegs}
+        xerrs = {}
+        for _ in range(xwindows):
+            for label, lenv in xlegs:
+                if label in xerrs and isinstance(
+                        xerrs[label], subprocess.TimeoutExpired):
+                    continue   # a wedged leg won't unwedge; save the budget
+                try:
+                    xruns[label].append(run_xport_leg(lenv))
+                except Exception as e:   # noqa: BLE001 — per-leg, not fatal
+                    xerrs[label] = e
+        xport = {}
+        for label, _ in xlegs:
+            runs = xruns[label]
+            if not runs:
+                e = xerrs[label]
+                xport[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                continue
+            merged = dict(runs[0])
+            merged["sizes"] = [
+                min((r["sizes"][i] for r in runs),
+                    key=lambda c: c["p50_us"])
+                for i in range(len(runs[0]["sizes"]))]
+            xport[label] = merged
+        # Headline ratio: shm fan-in bandwidth over the UDS fan-in
+        # baseline, worst case across the >= 256 KiB payloads (the
+        # zero-copy win must hold where it matters, not just at the top).
+        try:
+            shm_b = {c["bytes"]: c["mbps"]
+                     for c in xport["shm"]["sizes"] if c["bytes"] >= 1 << 18}
+            uds_b = {c["bytes"]: c["mbps"]
+                     for c in xport["uds"]["sizes"] if c["bytes"] >= 1 << 18}
+            xport["shm_vs_uds_speedup_256k_plus"] = round(
+                min(shm_b[b] / uds_b[b] for b in shm_b), 3)
+        except Exception:   # noqa: BLE001 — a failed leg has no curve
+            xport["shm_vs_uds_speedup_256k_plus"] = None
+    else:
+        xport = {"skipped": "BENCH_XPORT=0"}
     transport = two.get("ring_transport", "tcp")
     eff = round(two["images_per_sec_per_proc"]
                 / one["images_per_sec_per_proc"], 4)
@@ -1918,6 +2068,11 @@ def bench_scaling_tcp():
         # commit-to-serve staleness, and the training step-time delta.
         # BENCH_PUBLISH=0 skips it.
         "publish": publish,
+        # Per-hop transport curves (latency p50 + bandwidth per payload
+        # size) for the UDS fan-in, shm fan-in, classic TCP ring, and
+        # io_uring ring, plus the worst-case shm-over-UDS speedup at
+        # >= 256 KiB.  BENCH_XPORT=0 skips it.
+        "xport_sweep": xport,
     }
 
 
@@ -2128,6 +2283,8 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--solo-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--xport-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--recovery-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--policy-worker", action="store_true",
@@ -2141,6 +2298,9 @@ def main():
         return
     if args.solo_worker:
         solo_worker()
+        return
+    if args.xport_worker:
+        xport_worker()
         return
     if args.recovery_worker:
         recovery_worker()

@@ -486,6 +486,12 @@ HTPU_API const char* htpu_control_ring_transport(void* cp) {
   return static_cast<htpu::ControlPlane*>(cp)->ring_transport();
 }
 
+// Zero-copy transports active on the data plane: static string
+// "classic" / "shm" / "uring" / "shm+uring".
+HTPU_API const char* htpu_control_data_transport(void* cp) {
+  return static_cast<htpu::ControlPlane*>(cp)->data_transport();
+}
+
 // Attach a native Timeline (htpu_timeline_create) so the coordinator's
 // Tick loop emits negotiation spans; pass nullptr to detach.  The caller
 // must keep the timeline alive while attached (and detach before

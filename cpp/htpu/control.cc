@@ -21,8 +21,10 @@
 #include "htpu/metrics.h"
 #include "htpu/quantize.h"
 #include "htpu/reduce.h"
+#include "htpu/shm_ring.h"
 #include "htpu/timeline.h"
 #include "htpu/transport.h"
+#include "htpu/uring_transport.h"
 
 namespace htpu {
 
@@ -284,6 +286,39 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
     if (end && *end == '\0' && v >= 0) cache_cap = v;
   }
   cp->cache_capacity_ = cache_cap;
+  // Zero-copy data-plane selection.  auto probes both fast paths (shm
+  // intra-host, io_uring on the socket legs) with per-path runtime
+  // fallback; classic pins the PR 5 socket plane; shm / uring pin exactly
+  // one fast path for A/B benching.  The value is validated job-wide
+  // during SetupRing — a mismatch is a config error, not a silent
+  // asymmetric plane.
+  if (const char* e = getenv("HOROVOD_TPU_TRANSPORT")) {
+    const std::string m(e);
+    if (m.empty() || m == "auto") {
+      cp->xport_mode_ = 0;
+    } else if (m == "classic") {
+      cp->xport_mode_ = 1;
+    } else if (m == "shm") {
+      cp->xport_mode_ = 2;
+    } else if (m == "uring") {
+      cp->xport_mode_ = 3;
+    } else {
+      fprintf(stderr,
+              "htpu control: unknown HOROVOD_TPU_TRANSPORT=%s "
+              "(want auto|classic|shm|uring)\n", e);
+      return nullptr;
+    }
+  }
+  // Intra-host shm sub-slot size; the depth-2 pipeline maps two of these
+  // per member plus two for the result.  Must stay element-aligned for
+  // every dtype, hence the multiple-of-64 floor.
+  if (const char* e = getenv("HOROVOD_TPU_SHM_SLOT_BYTES")) {
+    char* end = nullptr;
+    long long v = strtoll(e, &end, 10);
+    if (end && *end == '\0' && v >= 4096 && v % 64 == 0) {
+      cp->shm_slot_bytes_ = v;
+    }
+  }
 
   if (process_index == 0) {
     cp->table_.reset(new MessageTable(nranks_total));
@@ -449,6 +484,14 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   if (elastic_ && failover_port_ > 0) {
     record += "\t" + std::to_string(failover_port_);
   }
+  // Non-default transport selection rides the book as a keyed extra field
+  // so mismatched HOROVOD_TPU_TRANSPORT values across ranks surface as
+  // one attributed bootstrap error instead of an asymmetric plane.
+  // Default-auto books keep their legacy byte shape exactly.
+  static const char* kXportNames[] = {"auto", "classic", "shm", "uring"};
+  if (xport_mode_ != 0) {
+    record += std::string("\txport=") + kXportNames[xport_mode_];
+  }
 
   auto cleanup = [&]() {
     CloseFd(ring_listen);
@@ -486,8 +529,10 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     }
   }
 
-  // 4. Parse the book (one tab-separated record per process).
-  std::vector<std::string> hosts, fps, uds_paths, fo_ports;
+  // 4. Parse the book (one tab-separated record per process).  Fields
+  // past the fixed five are recognised by shape: "xport=..." carries the
+  // transport selection, a bare number is the elastic failover port.
+  std::vector<std::string> hosts, fps, uds_paths, fo_ports, xports;
   std::vector<int> ports;
   all_first_ranks_.clear();
   size_t pos = 0;
@@ -513,13 +558,46 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     all_first_ranks_.push_back(std::stoi(fields[2]));
     fps.push_back(fields[3]);
     uds_paths.push_back(fields[4]);
-    fo_ports.push_back(fields.size() >= 6 ? fields[5] : std::string());
+    std::string fo, xp = "auto";
+    for (size_t fi = 5; fi < fields.size(); ++fi) {
+      if (fields[fi].rfind("xport=", 0) == 0) {
+        xp = fields[fi].substr(6);
+      } else {
+        fo = fields[fi];
+      }
+    }
+    fo_ports.push_back(fo);
+    xports.push_back(xp);
     if (nl == std::string::npos) break;
     pos = nl + 1;
   }
   if (int(hosts.size()) != process_count_) {
     cleanup();
     return false;
+  }
+
+  // Coordinated transport validation: every process must have been
+  // launched with the same HOROVOD_TPU_TRANSPORT, else intra-host peers
+  // would disagree on the shm handshake and ring peers on the socket
+  // protocol's pacing.  Attribute to the lowest-indexed divergent process.
+  for (int i = 1; i < process_count_; ++i) {
+    if (xports[size_t(i)] != xports[0]) {
+      const int32_t rank = all_first_ranks_[size_t(i)];
+      std::string err = "HOROVOD_TPU_TRANSPORT mismatch: process of rank " +
+                        std::to_string(rank) + " selected '" +
+                        xports[size_t(i)] + "' while rank " +
+                        std::to_string(all_first_ranks_[0]) + " selected '" +
+                        xports[0] + "' — the knob must agree job-wide";
+      fprintf(stderr, "htpu control: %s\n", err.c_str());
+      {
+        std::lock_guard<std::mutex> lock(err_mu_);
+        last_error_rank_ = rank;
+        last_error_ = err;
+      }
+      FlightRecorder::Get().Record("xport.mismatch", err.c_str(), 0, i);
+      cleanup();
+      return false;
+    }
   }
 
   // Harvest the failover rendezvous address book (elastic 6th field) —
@@ -562,7 +640,37 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   }
   ring_prev_fd_ = AcceptEither(ring_listen, uds_listen, timeout_ms_);
   cleanup();
-  return ring_prev_fd_ >= 0;
+  if (ring_prev_fd_ < 0) return false;
+  SetupUring();
+  return true;
+}
+
+void ControlPlane::SetupUring() {
+  uring_.reset();
+  uring_state_ = 0;
+  // classic pins the socket plane; shm pins the intra-host fast path ONLY
+  // (its A/B baseline is classic ring legs).
+  if (xport_mode_ == 1 || xport_mode_ == 2) return;
+  std::string err;
+  uring_ = UringTransport::Create(64, &err);
+  if (uring_) {
+    uring_state_ = 1;
+    return;
+  }
+  uring_state_ = -1;
+  Metrics::Get().Counter("ring.uring.fallbacks")
+      ->fetch_add(1, std::memory_order_relaxed);
+  FlightRecorder::Get().Record("uring.fallback", err.c_str(), 0,
+                               process_index_);
+  fprintf(stderr,
+          "htpu control: io_uring unavailable (%s); data plane staying on "
+          "the classic socket transport\n", err.c_str());
+}
+
+const char* ControlPlane::data_transport() const {
+  const bool s = shm_ != nullptr;
+  const bool u = uring_state_ == 1;
+  return s ? (u ? "shm+uring" : "shm") : (u ? "uring" : "classic");
 }
 
 ControlPlane::~ControlPlane() {
@@ -801,10 +909,39 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
                         int recv_fd, char* recv_buf, size_t recv_len,
                         int send_peer, int recv_peer) {
   int failed = -1;
-  if (DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
-                     recv_len, timeout_ms_, &failed)) {
-    return true;
+  bool ok;
+  if (uring_state_ == 1 && uring_) {
+    // io_uring leg: keep the scratch-pool slabs registered (RegisterBuffers
+    // early-outs when the spans are unchanged, so steady state re-registers
+    // only when a pool grows) and run the same duplex contract through the
+    // submission queue.  Counted next to data_bytes_* by the callers; the
+    // ring.uring.* family reconciles the uring share of that traffic.
+    uring_->RegisterBuffers({{rbuf_[0].data(), rbuf_[0].size()},
+                             {rbuf_[1].data(), rbuf_[1].size()},
+                             {sbuf_.data(), sbuf_.size()},
+                             {wseg_[0].data(), wseg_[0].size()},
+                             {wseg_[1].data(), wseg_[1].size()},
+                             {hier_buf_.data(), hier_buf_.size()}});
+    ok = uring_->Duplex(send_fd, send_buf, send_len, recv_fd, recv_buf,
+                        recv_len, timeout_ms_, &failed);
+    if (ok) {
+      static std::atomic<long long>* u_sent =
+          Metrics::Get().Counter("ring.uring.bytes_sent");
+      static std::atomic<long long>* u_recv =
+          Metrics::Get().Counter("ring.uring.bytes_recv");
+      static std::atomic<long long>* u_ops =
+          Metrics::Get().Counter("ring.uring.ops");
+      u_sent->fetch_add(static_cast<long long>(send_len),
+                        std::memory_order_relaxed);
+      u_recv->fetch_add(static_cast<long long>(recv_len),
+                        std::memory_order_relaxed);
+      u_ops->fetch_add(1, std::memory_order_relaxed);
+    }
+  } else {
+    ok = DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
+                        recv_len, timeout_ms_, &failed);
   }
+  if (ok) return true;
   // Attribute to the peer process whose fd died; a plain timeout most
   // often means upstream stopped feeding us, so default to the recv side.
   int peer = failed >= 0 ? (failed == send_fd ? send_peer : recv_peer)
@@ -2387,6 +2524,15 @@ bool ControlPlane::RebuildDataPlane() {
   my_leader_pos_ = -1;
   host_fps_.clear();
   all_first_ranks_.clear();
+  // Zero-copy transports are membership-generation-scoped: the shm
+  // segment's member layout and the uring's registered buffers both died
+  // with the old plane.  Dropping the ShmRing unmaps (the segment name was
+  // already unlinked at handshake commit); dropping the UringTransport
+  // reaps inflight SQEs and buffer pins via close().  SetupRing /
+  // EnsureHierarchy re-create both under the new membership.
+  shm_.reset();
+  uring_.reset();
+  uring_state_ = 0;
   if (process_count_ <= 1) return true;
   return SetupRing(coord_host_);
 }
@@ -3009,6 +3155,7 @@ bool ControlPlane::EnsureHierarchy() {
       return false;
     }
     cleanup();
+    if (!SetupShm()) return false;
     hier_state_ = 1;
     return true;
   }
@@ -3067,7 +3214,119 @@ bool ControlPlane::EnsureHierarchy() {
     return false;
   }
   cleanup();
+  if (!SetupShm()) return false;
   hier_state_ = 1;
+  return true;
+}
+
+// Coordinated shm handshake over the freshly established fan-in sockets.
+// The leader creates a generation-unique segment and offers it; members
+// map + confirm; the leader's go/no verdict commits every process of the
+// group to the same answer (an asymmetric group would deadlock the first
+// collective).  On commit the leader unlinks the name immediately — the
+// live mappings persist, /dev/shm holds nothing, and even a SIGKILLed job
+// leaks no segment.  Any shm-level failure degrades the whole group to
+// the socket fan-in coherently; only a dead SOCKET fails hierarchy setup.
+bool ControlPlane::SetupShm() {
+  shm_.reset();
+  // classic pins the socket plane; uring pins the socket-leg fast path
+  // ONLY (its A/B baseline is the UDS fan-in).
+  if (xport_mode_ == 1 || xport_mode_ == 3) return true;
+  if (group_.size() <= 1) return true;   // no intra-host legs to replace
+  static std::atomic<long long>* fallbacks =
+      Metrics::Get().Counter("ring.shm.fallbacks");
+  const int nmembers = int(group_.size()) - 1;
+
+  if (is_leader_) {
+    std::string err, name;
+    std::unique_ptr<ShmRing> ring;
+    for (int attempt = 0; attempt < 4 && !ring; ++attempt) {
+      // pid + membership generation + a monotonic rebuild counter: unique
+      // across elastic rebuilds AND across a name squatted by an unrelated
+      // process (O_EXCL collision just advances the counter).
+      name = "/htpu_shm_" + std::to_string(getpid()) + "_" +
+             std::to_string(generation_) + "_" + std::to_string(shm_gen_++);
+      ring = ShmRing::CreateLeader(name, nmembers, size_t(shm_slot_bytes_),
+                                   &err);
+    }
+    const std::string offer =
+        ring ? "SHM\t" + name + "\t" + std::to_string(shm_slot_bytes_) +
+                   "\t" + std::to_string(nmembers)
+             : std::string("SHMOFF");
+    for (int fd : member_fds_) {
+      if (!SendFrame(fd, offer)) return false;
+    }
+    if (!ring) {
+      fallbacks->fetch_add(1, std::memory_order_relaxed);
+      fprintf(stderr,
+              "htpu control: shm segment creation failed (%s); host group "
+              "staying on the socket fan-in\n", err.c_str());
+      return true;
+    }
+    bool all_mapped = true;
+    for (int fd : member_fds_) {
+      std::string resp;
+      if (!RecvFrame(fd, &resp, timeout_ms_)) return false;
+      if (resp != "ok") all_mapped = false;
+    }
+    const std::string verdict = all_mapped ? "go" : "no";
+    for (int fd : member_fds_) {
+      if (!SendFrame(fd, verdict)) return false;
+    }
+    if (!all_mapped) {
+      // ~ShmRing unlinks the never-committed segment.
+      fallbacks->fetch_add(1, std::memory_order_relaxed);
+      fprintf(stderr,
+              "htpu control: a member failed to map the shm segment; host "
+              "group staying on the socket fan-in\n");
+      return true;
+    }
+    ring->Unlink();
+    shm_ = std::move(ring);
+    FlightRecorder::Get().Record("shm.ready", name.c_str(),
+                                 shm_slot_bytes_, nmembers);
+    return true;
+  }
+
+  // Member half.
+  std::string offer;
+  if (!RecvFrame(leader_fd_, &offer, timeout_ms_)) return false;
+  if (offer == "SHMOFF") {
+    fallbacks->fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  std::vector<std::string> fields;
+  size_t fpos = 0;
+  while (fpos <= offer.size()) {
+    size_t tab = offer.find('\t', fpos);
+    fields.push_back(
+        offer.substr(fpos, tab == std::string::npos ? tab : tab - fpos));
+    if (tab == std::string::npos) break;
+    fpos = tab + 1;
+  }
+  std::unique_ptr<ShmRing> ring;
+  int member_pos = -1;
+  for (size_t gi = 1; gi < group_.size(); ++gi) {
+    if (group_[gi] == process_index_) member_pos = int(gi) - 1;
+  }
+  if (fields.size() == 4 && fields[0] == "SHM" && member_pos >= 0) {
+    // Geometry comes from the OFFER, not this process's own env — the
+    // leader's knobs win so a per-process HOROVOD_TPU_SHM_SLOT_BYTES skew
+    // cannot produce mismatched layouts.
+    std::string err;
+    ring = ShmRing::OpenMember(fields[1], atoi(fields[3].c_str()),
+                               size_t(strtoll(fields[2].c_str(), nullptr,
+                                              10)),
+                               member_pos, &err);
+  }
+  if (!SendFrame(leader_fd_, ring ? "ok" : "fail")) return false;
+  std::string verdict;
+  if (!RecvFrame(leader_fd_, &verdict, timeout_ms_)) return false;
+  if (verdict != "go" || !ring) {
+    fallbacks->fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  shm_ = std::move(ring);
   return true;
 }
 
@@ -3088,9 +3347,53 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
   Metrics& mx = Metrics::Get();
   std::atomic<long long>* l_sent = mx.Counter("ring.hier_local.bytes_sent");
   std::atomic<long long>* l_recv = mx.Counter("ring.hier_local.bytes_recv");
+  static std::atomic<long long>* s_sent =
+      Metrics::Get().Counter("ring.shm.bytes_sent");
+  static std::atomic<long long>* s_recv =
+      Metrics::Get().Counter("ring.shm.bytes_recv");
+  static std::atomic<long long>* s_ops =
+      Metrics::Get().Counter("ring.shm.ops");
   const int my_leader = group_.front();
 
+  // Shm-leg failure attribution: a push/pull/reduce timeout means the
+  // named group peer stopped consuming or producing — same shape as the
+  // Xfer attribution, minus any socket.
+  auto shm_fail = [&](int peer, const char* what) {
+    const int32_t rank =
+        (peer >= 0 && size_t(peer) < all_first_ranks_.size())
+            ? all_first_ranks_[size_t(peer)]
+            : first_rank_;
+    std::string err = std::string("hierarchical allreduce: shm ") + what +
+                      " timed out waiting on rank " + std::to_string(rank);
+    {
+      std::lock_guard<std::mutex> lock(err_mu_);
+      last_error_rank_ = rank;
+      last_error_ = err;
+    }
+    FlightRecorder::Get().Record("shm.fail", what, nbytes, peer, 0);
+    return false;
+  };
+
   if (!is_leader_) {
+    if (shm_) {
+      // Zero-copy fan-in/fan-out: one memcpy into the shared slot, none
+      // of the UDS frame copies.  Still feeds ring.hier_local.* — the
+      // leg's traffic contract is transport-independent.
+      if (!shm_->MemberPush(data, size_t(nbytes), timeout_ms_)) {
+        return shm_fail(my_leader, "fan-in");
+      }
+      data_bytes_sent_ += nbytes;
+      l_sent->fetch_add(nbytes, std::memory_order_relaxed);
+      s_sent->fetch_add(nbytes, std::memory_order_relaxed);
+      if (!shm_->MemberPull(data, size_t(nbytes), timeout_ms_)) {
+        return shm_fail(my_leader, "fan-out");
+      }
+      data_bytes_recv_ += nbytes;
+      l_recv->fetch_add(nbytes, std::memory_order_relaxed);
+      s_recv->fetch_add(nbytes, std::memory_order_relaxed);
+      s_ops->fetch_add(1, std::memory_order_relaxed);
+      return true;
+    }
     if (!Xfer(leader_fd_, data, size_t(nbytes), -1, nullptr, 0,
               my_leader, my_leader)) {
       return false;
@@ -3108,16 +3411,41 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
 
   // Leader: deterministic fan-in order (ascending member process index)
   // so every host computes the same partial-sum association.
-  if (hier_buf_.size() < size_t(nbytes)) hier_buf_.resize(size_t(nbytes));
-  for (size_t gi = 1; gi < group_.size(); ++gi) {
-    const int m = group_[gi];
-    if (!Xfer(-1, nullptr, 0, member_fds_[gi - 1], hier_buf_.data(),
-              size_t(nbytes), m, m)) {
-      return false;
+  if (shm_) {
+    // SumInto runs DIRECTLY over each member's slot memory, chunk by
+    // chunk, members ascending within every chunk — per element that is
+    // the identical association order to the socket loop below, so the
+    // two paths agree bit for bit.
+    int lag = -1;
+    if (!shm_->LeaderReduce(
+            size_t(nbytes),
+            [&](int /*mpos*/, const char* src, size_t off, size_t len) {
+              return SumInto(dtype, data + off, src, int64_t(len));
+            },
+            timeout_ms_, &lag)) {
+      if (lag == -2) return false;   // SumInto rejected the dtype
+      const int peer = (lag >= 0 && size_t(lag) + 1 < group_.size())
+                           ? group_[size_t(lag) + 1]
+                           : -1;
+      return shm_fail(peer, "fan-in");
     }
-    data_bytes_recv_ += nbytes;
-    l_recv->fetch_add(nbytes, std::memory_order_relaxed);
-    if (!SumInto(dtype, data, hier_buf_.data(), nbytes)) return false;
+    const long long in_bytes =
+        (long long)nbytes * (long long)(group_.size() - 1);
+    data_bytes_recv_ += in_bytes;
+    l_recv->fetch_add(in_bytes, std::memory_order_relaxed);
+    s_recv->fetch_add(in_bytes, std::memory_order_relaxed);
+  } else {
+    if (hier_buf_.size() < size_t(nbytes)) hier_buf_.resize(size_t(nbytes));
+    for (size_t gi = 1; gi < group_.size(); ++gi) {
+      const int m = group_[gi];
+      if (!Xfer(-1, nullptr, 0, member_fds_[gi - 1], hier_buf_.data(),
+                size_t(nbytes), m, m)) {
+        return false;
+      }
+      data_bytes_recv_ += nbytes;
+      l_recv->fetch_add(nbytes, std::memory_order_relaxed);
+      if (!SumInto(dtype, data, hier_buf_.data(), nbytes)) return false;
+    }
   }
 
   const int L = int(leaders_.size());
@@ -3128,6 +3456,23 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
                         leaders_[size_t((my_leader_pos_ - 1 + L) % L)])) {
       return false;
     }
+  }
+
+  if (shm_) {
+    int lag = -1;
+    if (!shm_->LeaderBroadcast(data, size_t(nbytes), timeout_ms_, &lag)) {
+      const int peer = (lag >= 0 && size_t(lag) + 1 < group_.size())
+                           ? group_[size_t(lag) + 1]
+                           : -1;
+      return shm_fail(peer, "fan-out");
+    }
+    const long long out_bytes =
+        (long long)nbytes * (long long)(group_.size() - 1);
+    data_bytes_sent_ += out_bytes;
+    l_sent->fetch_add(out_bytes, std::memory_order_relaxed);
+    s_sent->fetch_add(out_bytes, std::memory_order_relaxed);
+    s_ops->fetch_add(1, std::memory_order_relaxed);
+    return true;
   }
 
   for (size_t gi = 1; gi < group_.size(); ++gi) {

@@ -40,7 +40,9 @@
 namespace htpu {
 
 class FleetPolicy;
+class ShmRing;
 class Timeline;
+class UringTransport;
 
 class ControlPlane {
  public:
@@ -122,6 +124,11 @@ class ControlPlane {
   // Transport the ring-next hop rides: "uds" (co-located peer, on-host
   // fast path), "tcp", or "none" (single process).
   const char* ring_transport() const { return ring_transport_; }
+
+  // Zero-copy transports currently active on the data plane: "classic",
+  // "shm", "uring", or "shm+uring" (HOROVOD_TPU_TRANSPORT and runtime
+  // fallbacks both reflected here).
+  const char* data_transport() const;
 
   // Per-rank trace hooks driven from the Tick loop.  On the coordinator:
   // negotiation spans (NEGOTIATE_* with per-rank ready instants — the
@@ -250,6 +257,17 @@ class ControlPlane {
   // ring-setup host fingerprints + leader fan-in connections).  Sticky:
   // a setup failure fails every later hier/small collective.
   bool EnsureHierarchy();
+  // Coordinated intra-host shm-ring handshake at the tail of
+  // EnsureHierarchy (leader offers a segment over the member sockets,
+  // members map + confirm, leader unlinks on commit).  A socket failure
+  // fails hierarchy setup; an shm-specific failure degrades every process
+  // of the group to the socket path coherently.  True unless a SOCKET
+  // died mid-handshake.
+  bool SetupShm();
+  // Eager io_uring ring creation at the tail of SetupRing; failure is
+  // recorded (uring_state_ = -1, ring.uring.fallbacks) and the classic
+  // DuplexTransfer path stays in charge.
+  void SetupUring();
   bool HierarchicalAllreduce(const std::string& dtype, char* data,
                              int64_t nbytes, int wire);
   bool SmallAllreduce(const std::string& dtype, char* data, int64_t nbytes,
@@ -392,6 +410,18 @@ class ControlPlane {
   std::vector<char> sbuf_;              // wire-encode staging
   std::vector<char> wseg_[2];           // compressed allgather images
   std::vector<char> hier_buf_;          // raw intra-host fan-in staging
+
+  // ---- zero-copy data plane (HOROVOD_TPU_TRANSPORT) ----
+  int xport_mode_ = 0;                  // 0 auto / 1 classic / 2 shm / 3 uring
+  // Intra-host shm ring (leader and member ends both live here); torn
+  // down with the hierarchy on every rebuild.
+  std::unique_ptr<ShmRing> shm_;
+  uint64_t shm_gen_ = 0;                // unique segment names across rebuilds
+  long long shm_slot_bytes_ = 1 << 18;  // HOROVOD_TPU_SHM_SLOT_BYTES
+  // io_uring transfer engine for every socket leg; null or state -1 means
+  // classic DuplexTransfer.
+  std::unique_ptr<UringTransport> uring_;
+  int uring_state_ = 0;                 // 0 unset / 1 active / -1 fell back
 
   // Clock-sync state.  Worker: wall stamp of the last response receipt
   // (t4', echoed in the next trailer).  Coordinator: wall stamp of the

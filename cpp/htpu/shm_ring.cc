@@ -1,0 +1,348 @@
+#include "htpu/shm_ring.h"
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <climits>
+#include <cstring>
+#include <ctime>
+
+namespace htpu {
+
+namespace {
+
+constexpr uint64_t kMagic = 0x48545055534d5231ull;   // "HTPUSMR1"
+constexpr uint32_t kVersion = 1;
+constexpr size_t kLine = 64;
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t nmembers;
+  uint64_t slot_bytes;
+};
+static_assert(sizeof(Header) <= kLine, "header must fit one cache line");
+
+// Control region: header line, then one line per member counter word.
+//   line 0:                    Header
+//   lines 1 .. n:              ready[m]
+//   lines n+1 .. 2n:           ack[m]
+//   line 2n+1:                 result ready
+//   lines 2n+2 .. 3n+1:        rack[m]
+size_t CtlBytes(int nmembers) { return kLine * (3 * size_t(nmembers) + 2); }
+
+// Each counter line holds the cumulative chunk counter at offset 0 and a
+// waiter count at offset 8 (both zero in the fresh mapping).  Sleeping
+// waiters park on the counter's low 32 bits with a SHARED futex (no
+// FUTEX_PRIVATE: the words live in a MAP_SHARED segment crossing
+// processes); publishers wake them only when the waiter count is nonzero,
+// so the uncontended fast path stays syscall-free.
+std::atomic<uint32_t>* WaitersOf(const std::atomic<uint64_t>* v) {
+  return reinterpret_cast<std::atomic<uint32_t>*>(
+      reinterpret_cast<char*>(const_cast<std::atomic<uint64_t>*>(v)) + 8);
+}
+
+uint32_t* FutexWordOf(const std::atomic<uint64_t>* v) {
+  // Low half of the little-endian counter: cumulative chunk counts never
+  // get near 2^32, so the low word changes on every publish.
+  return reinterpret_cast<uint32_t*>(
+      const_cast<std::atomic<uint64_t>*>(v));
+}
+
+// Publish a new counter value and wake any parked waiter.  seq_cst pairs
+// with the waiter-side seq_cst re-check: either the publisher sees the
+// waiter registration and wakes, or the waiter's re-read sees the new
+// value and never sleeps — a plain release store could miss both.
+void Publish(std::atomic<uint64_t>* v, uint64_t val) {
+  v->store(val, std::memory_order_seq_cst);
+  if (WaitersOf(v)->load(std::memory_order_seq_cst) != 0) {
+    syscall(SYS_futex, FutexWordOf(v), FUTEX_WAKE, INT_MAX, nullptr,
+            nullptr, 0);
+  }
+}
+
+// Wait for a shared cumulative counter to reach `target`.  A short spin
+// catches publishers mid-memcpy on their own core; a few yields hand a
+// shared core to the peer; then the waiter parks in FUTEX_WAIT and
+// leaves the runqueue entirely.  That last step is what makes the ring
+// behave on oversubscribed hosts: a yield-looping waiter stays runnable
+// and the scheduler round-robins it against the producer at arbitrary
+// points, while a parked waiter gives the producer an unbroken quantum
+// to stream every in-flight sub-slot — the same block/wake pattern a
+// socket read gets from the kernel, minus the data copies.
+bool WaitGe(const std::atomic<uint64_t>* v, uint64_t target,
+            int timeout_ms) {
+  for (int s = 0; s < 4096; ++s) {
+    if (v->load(std::memory_order_acquire) >= target) return true;
+  }
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+  for (int y = 0; y < 4; ++y) {
+    sched_yield();
+    if (v->load(std::memory_order_acquire) >= target) return true;
+  }
+  std::atomic<uint32_t>* waiters = WaitersOf(v);
+  for (;;) {
+    uint64_t cur = v->load(std::memory_order_acquire);
+    if (cur >= target) return true;
+    const auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) return false;
+    waiters->fetch_add(1, std::memory_order_seq_cst);
+    cur = v->load(std::memory_order_seq_cst);
+    if (cur >= target) {
+      waiters->fetch_sub(1, std::memory_order_relaxed);
+      return true;
+    }
+    // Cap each sleep so a (theoretical) lost wake degrades to a 50ms
+    // hiccup instead of eating the whole timeout budget.
+    auto left = std::chrono::duration_cast<std::chrono::nanoseconds>(
+        deadline - now);
+    const int64_t cap = 50 * 1000 * 1000;
+    if (left.count() > cap) left = std::chrono::nanoseconds(cap);
+    struct timespec ts;
+    ts.tv_sec = left.count() / 1000000000;
+    ts.tv_nsec = left.count() % 1000000000;
+    syscall(SYS_futex, FutexWordOf(v), FUTEX_WAIT, uint32_t(cur), &ts,
+            nullptr, 0);
+    waiters->fetch_sub(1, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+size_t ShmRing::SegmentBytes(int nmembers, size_t slot_bytes) {
+  return CtlBytes(nmembers) +
+         size_t(kDepth) * slot_bytes * (size_t(nmembers) + 1);
+}
+
+std::atomic<uint64_t>* ShmRing::ReadyOf(int m) const {
+  return reinterpret_cast<std::atomic<uint64_t>*>(
+      base_ + kLine * (1 + size_t(m)));
+}
+
+std::atomic<uint64_t>* ShmRing::AckOf(int m) const {
+  return reinterpret_cast<std::atomic<uint64_t>*>(
+      base_ + kLine * (1 + size_t(nmembers_) + size_t(m)));
+}
+
+std::atomic<uint64_t>* ShmRing::ResultReady() const {
+  return reinterpret_cast<std::atomic<uint64_t>*>(
+      base_ + kLine * (1 + 2 * size_t(nmembers_)));
+}
+
+std::atomic<uint64_t>* ShmRing::ResultAckOf(int m) const {
+  return reinterpret_cast<std::atomic<uint64_t>*>(
+      base_ + kLine * (2 + 2 * size_t(nmembers_) + size_t(m)));
+}
+
+char* ShmRing::SlotData(int m, int sub) const {
+  return base_ + CtlBytes(nmembers_) +
+         size_t(kDepth) * slot_bytes_ * size_t(m) +
+         slot_bytes_ * size_t(sub);
+}
+
+char* ShmRing::ResultData(int sub) const {
+  return base_ + CtlBytes(nmembers_) +
+         size_t(kDepth) * slot_bytes_ * size_t(nmembers_) +
+         slot_bytes_ * size_t(sub);
+}
+
+std::unique_ptr<ShmRing> ShmRing::CreateLeader(const std::string& name,
+                                               int nmembers,
+                                               size_t slot_bytes,
+                                               std::string* err) {
+  if (nmembers <= 0 || slot_bytes == 0 || slot_bytes % kLine != 0) {
+    if (err) *err = "invalid shm ring geometry";
+    return nullptr;
+  }
+  int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    if (err) *err = "shm_open(" + name + "): " + strerror(errno);
+    return nullptr;
+  }
+  const size_t bytes = SegmentBytes(nmembers, slot_bytes);
+  if (ftruncate(fd, off_t(bytes)) != 0) {
+    if (err) *err = std::string("ftruncate: ") + strerror(errno);
+    close(fd);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    if (err) *err = std::string("mmap: ") + strerror(errno);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  std::unique_ptr<ShmRing> ring(new ShmRing());
+  ring->name_ = name;
+  ring->base_ = static_cast<char*>(base);
+  ring->map_bytes_ = bytes;
+  ring->nmembers_ = nmembers;
+  ring->slot_bytes_ = slot_bytes;
+  ring->is_leader_ = true;
+  // The fresh mapping is zero-filled; publish the header LAST (release)
+  // so a member that maps early never sees a magic over garbage counters.
+  Header h{kMagic, kVersion, uint32_t(nmembers), uint64_t(slot_bytes)};
+  std::memcpy(ring->base_ + sizeof(uint64_t),
+              reinterpret_cast<const char*>(&h) + sizeof(uint64_t),
+              sizeof(Header) - sizeof(uint64_t));
+  reinterpret_cast<std::atomic<uint64_t>*>(ring->base_)
+      ->store(kMagic, std::memory_order_release);
+  return ring;
+}
+
+std::unique_ptr<ShmRing> ShmRing::OpenMember(const std::string& name,
+                                             int nmembers, size_t slot_bytes,
+                                             int member_pos,
+                                             std::string* err) {
+  if (nmembers <= 0 || member_pos < 0 || member_pos >= nmembers ||
+      slot_bytes == 0 || slot_bytes % kLine != 0) {
+    if (err) *err = "invalid shm ring geometry";
+    return nullptr;
+  }
+  int fd = shm_open(name.c_str(), O_RDWR, 0);
+  if (fd < 0) {
+    if (err) *err = "shm_open(" + name + "): " + strerror(errno);
+    return nullptr;
+  }
+  const size_t bytes = SegmentBytes(nmembers, slot_bytes);
+  struct stat st;
+  if (fstat(fd, &st) != 0 || size_t(st.st_size) < bytes) {
+    if (err) *err = "shm segment smaller than the offered geometry";
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    if (err) *err = std::string("mmap: ") + strerror(errno);
+    return nullptr;
+  }
+  std::unique_ptr<ShmRing> ring(new ShmRing());
+  ring->name_ = name;
+  ring->base_ = static_cast<char*>(base);
+  ring->map_bytes_ = bytes;
+  ring->nmembers_ = nmembers;
+  ring->slot_bytes_ = slot_bytes;
+  ring->member_pos_ = member_pos;
+  if (reinterpret_cast<std::atomic<uint64_t>*>(ring->base_)
+              ->load(std::memory_order_acquire) != kMagic) {
+    if (err) *err = "shm segment header mismatch";
+    return nullptr;   // ~ShmRing munmaps
+  }
+  Header h;
+  std::memcpy(&h, ring->base_, sizeof(h));
+  if (h.version != kVersion || h.nmembers != uint32_t(nmembers) ||
+      h.slot_bytes != uint64_t(slot_bytes)) {
+    if (err) *err = "shm segment geometry mismatch";
+    return nullptr;
+  }
+  return ring;
+}
+
+ShmRing::~ShmRing() {
+  if (base_) munmap(base_, map_bytes_);
+  // A leader that never reached the commit point (member mapping failed,
+  // handshake torn) must still leave /dev/shm clean.
+  if (is_leader_ && !unlinked_) shm_unlink(name_.c_str());
+}
+
+void ShmRing::Unlink() {
+  if (is_leader_ && !unlinked_) {
+    shm_unlink(name_.c_str());
+    unlinked_ = true;
+  }
+}
+
+bool ShmRing::MemberPush(const char* data, size_t nbytes, int timeout_ms) {
+  std::atomic<uint64_t>* ready = ReadyOf(member_pos_);
+  std::atomic<uint64_t>* ack = AckOf(member_pos_);
+  for (size_t off = 0; off < nbytes; off += slot_bytes_) {
+    const size_t len = std::min(slot_bytes_, nbytes - off);
+    const uint64_t i = pushed_;
+    // Sub-slot i % kDepth is reusable once the leader consumed chunk
+    // i - kDepth.
+    if (i >= uint64_t(kDepth) &&
+        !WaitGe(ack, i - kDepth + 1, timeout_ms)) {
+      return false;
+    }
+    std::memcpy(SlotData(member_pos_, int(i % kDepth)), data + off, len);
+    Publish(ready, i + 1);
+    ++pushed_;
+  }
+  return true;
+}
+
+bool ShmRing::MemberPull(char* data, size_t nbytes, int timeout_ms) {
+  std::atomic<uint64_t>* ready = ResultReady();
+  std::atomic<uint64_t>* rack = ResultAckOf(member_pos_);
+  for (size_t off = 0; off < nbytes; off += slot_bytes_) {
+    const size_t len = std::min(slot_bytes_, nbytes - off);
+    const uint64_t i = pulled_;
+    if (!WaitGe(ready, i + 1, timeout_ms)) return false;
+    std::memcpy(data + off, ResultData(int(i % kDepth)), len);
+    Publish(rack, i + 1);
+    ++pulled_;
+  }
+  return true;
+}
+
+bool ShmRing::LeaderReduce(size_t nbytes,
+                           const std::function<bool(int, const char*, size_t,
+                                                    size_t)>& reduce,
+                           int timeout_ms, int* lagging_member) {
+  if (lagging_member) *lagging_member = -1;
+  for (size_t off = 0; off < nbytes; off += slot_bytes_) {
+    const size_t len = std::min(slot_bytes_, nbytes - off);
+    const uint64_t i = reduced_;
+    for (int m = 0; m < nmembers_; ++m) {
+      if (!WaitGe(ReadyOf(m), i + 1, timeout_ms)) {
+        if (lagging_member) *lagging_member = m;
+        return false;
+      }
+      if (!reduce(m, SlotData(m, int(i % kDepth)), off, len)) {
+        if (lagging_member) *lagging_member = -2;
+        return false;
+      }
+    }
+    for (int m = 0; m < nmembers_; ++m) Publish(AckOf(m), i + 1);
+    ++reduced_;
+  }
+  return true;
+}
+
+bool ShmRing::LeaderBroadcast(const char* data, size_t nbytes,
+                              int timeout_ms, int* lagging_member) {
+  if (lagging_member) *lagging_member = -1;
+  std::atomic<uint64_t>* ready = ResultReady();
+  for (size_t off = 0; off < nbytes; off += slot_bytes_) {
+    const size_t len = std::min(slot_bytes_, nbytes - off);
+    const uint64_t i = bcast_;
+    if (i >= uint64_t(kDepth)) {
+      // The result sub-slot is reusable once EVERY member consumed
+      // chunk i - kDepth.
+      for (int m = 0; m < nmembers_; ++m) {
+        if (!WaitGe(ResultAckOf(m), i - kDepth + 1, timeout_ms)) {
+          if (lagging_member) *lagging_member = m;
+          return false;
+        }
+      }
+    }
+    std::memcpy(ResultData(int(i % kDepth)), data + off, len);
+    Publish(ready, i + 1);
+    ++bcast_;
+  }
+  return true;
+}
+
+}  // namespace htpu

@@ -1,0 +1,138 @@
+// Per-host shared-memory fan-in/fan-out ring for the hierarchical
+// data plane's intra-host legs.
+//
+// The socket path moves every member payload through two full copies
+// (member send buffer -> kernel -> leader's hier_buf_) before the leader
+// can SumInto it.  Here the member writes straight into a per-member slot
+// of one host-wide POSIX shm segment and the leader reduces DIRECTLY over
+// that slot memory — the multiple-processes-per-device leader pattern
+// (PAPERS.md #4) with zero socket copies on the hot path.
+//
+// Layout (all control words on their own cache lines):
+//
+//   Header        magic / version / nmembers / slot_bytes
+//   per member m  ready[m]  cumulative chunks written by member m
+//                 ack[m]    cumulative chunks consumed by the leader
+//   result        ready     cumulative result chunks written by the leader
+//                 rack[m]   cumulative result chunks consumed by member m
+//   data          per member: kDepth sub-slots of slot_bytes (fan-in)
+//                 result:     kDepth sub-slots of slot_bytes (fan-out)
+//
+// (Each counter line also carries a waiter count at offset 8 — see
+// below.)
+//
+// Synchronization is seqlock-style: a producer copies payload bytes into
+// sub-slot (i % kDepth) and then publishes chunk i by storing the
+// cumulative counter; the consumer acquires the counter before touching
+// the bytes.  Counters are CUMULATIVE across collectives (collective
+// calls are lockstep on every process, so both sides always agree on
+// chunk boundaries), which makes the sub-slots a depth-kDepth pipeline:
+// chunk i may be overwritten once the consumer has acknowledged chunk
+// i - kDepth.  A consumer that runs dry spins briefly, then parks on the
+// counter word with a shared futex; the publisher wakes it only when the
+// line's waiter count is nonzero.  Parking (rather than yield-looping)
+// is what keeps the ring fast on oversubscribed hosts: the waiter leaves
+// the runqueue, so the producer gets an unbroken quantum to stream every
+// in-flight sub-slot — socket-style block/wake scheduling without the
+// kernel data copies.
+//
+// Lifecycle: the leader creates the segment (O_EXCL, generation-unique
+// name), members map it, and the leader shm_unlinks it the moment every
+// member has confirmed its mapping — /dev/shm holds no entry while the
+// ring is live, so even a SIGKILLed job leaks nothing.
+#ifndef HTPU_SHM_RING_H_
+#define HTPU_SHM_RING_H_
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+
+namespace htpu {
+
+class ShmRing {
+ public:
+  // Sub-slots per direction: how many chunks may be in flight before the
+  // producer must wait for the consumer's acknowledgement.
+  static constexpr int kDepth = 8;
+
+  // Leader side: create + map a fresh segment for `nmembers` non-leader
+  // processes with `slot_bytes` per sub-slot (must be a multiple of 64 so
+  // chunk boundaries stay element-aligned for every dtype).  nullptr on
+  // failure with *err describing why (name collision, no /dev/shm, ...).
+  static std::unique_ptr<ShmRing> CreateLeader(const std::string& name,
+                                               int nmembers,
+                                               size_t slot_bytes,
+                                               std::string* err);
+  // Member side: map an existing segment and validate its header against
+  // the offered geometry.  member_pos is this process's index in the
+  // leader's ascending member order (0-based, leader excluded).
+  static std::unique_ptr<ShmRing> OpenMember(const std::string& name,
+                                             int nmembers, size_t slot_bytes,
+                                             int member_pos,
+                                             std::string* err);
+  ~ShmRing();
+
+  // Leader: remove the /dev/shm name (existing mappings live on).  Called
+  // once every member confirmed its mapping; idempotent.
+  void Unlink();
+
+  // Member fan-in / fan-out of one whole payload (chunked internally).
+  // False on timeout (the leader stopped consuming / producing).
+  bool MemberPush(const char* data, size_t nbytes, int timeout_ms);
+  bool MemberPull(char* data, size_t nbytes, int timeout_ms);
+
+  // Leader fan-in: for every payload chunk, wait for each member's copy
+  // and invoke reduce(member_pos, src, payload_off, len) in ascending
+  // member order — the caller SumIntos straight over slot memory, so the
+  // association order matches the socket path bit for bit.  On failure
+  // *lagging_member is the member that timed out, or -2 when the reduce
+  // callback itself returned false.
+  bool LeaderReduce(size_t nbytes,
+                    const std::function<bool(int, const char*, size_t,
+                                             size_t)>& reduce,
+                    int timeout_ms, int* lagging_member);
+  // Leader fan-out of the reduced payload to every member.
+  bool LeaderBroadcast(const char* data, size_t nbytes, int timeout_ms,
+                       int* lagging_member);
+
+  size_t slot_bytes() const { return slot_bytes_; }
+  int nmembers() const { return nmembers_; }
+  const std::string& name() const { return name_; }
+
+  // Total mapping size for the given geometry.
+  static size_t SegmentBytes(int nmembers, size_t slot_bytes);
+
+ private:
+  ShmRing() = default;
+  ShmRing(const ShmRing&) = delete;
+  ShmRing& operator=(const ShmRing&) = delete;
+
+  std::atomic<uint64_t>* ReadyOf(int m) const;
+  std::atomic<uint64_t>* AckOf(int m) const;
+  std::atomic<uint64_t>* ResultReady() const;
+  std::atomic<uint64_t>* ResultAckOf(int m) const;
+  char* SlotData(int m, int sub) const;
+  char* ResultData(int sub) const;
+
+  std::string name_;
+  char* base_ = nullptr;
+  size_t map_bytes_ = 0;
+  int nmembers_ = 0;
+  size_t slot_bytes_ = 0;
+  int member_pos_ = -1;        // -1 on the leader
+  bool is_leader_ = false;
+  bool unlinked_ = false;
+
+  // Process-local cumulative chunk counters mirroring the shared words.
+  uint64_t pushed_ = 0;        // member: fan-in chunks written
+  uint64_t pulled_ = 0;        // member: fan-out chunks consumed
+  uint64_t reduced_ = 0;       // leader: fan-in chunks consumed
+  uint64_t bcast_ = 0;         // leader: fan-out chunks written
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_SHM_RING_H_

@@ -226,6 +226,21 @@ bool SendFrame(int fd, const std::string& payload) {
     ssize_t w = sendmsg(fd, &msg, MSG_NOSIGNAL);
     if (w <= 0) {
       if (w < 0 && errno == EINTR) continue;
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        // A nonblocking peer with a full socket buffer is not an error;
+        // wait for writability and resume from `done`.  Failing here tore
+        // frames whenever a caller handed in an O_NONBLOCK fd.
+        struct pollfd p;
+        p.fd = fd;
+        p.events = POLLOUT;
+        int rc = poll(&p, 1, 100);
+        if (rc > 0 && (p.revents & (POLLERR | POLLHUP | POLLNVAL))) {
+          FlightRecorder::Get().Record("frame.send_fail", "peer error",
+                                       int64_t(payload.size()), fd, 0);
+          return false;
+        }
+        continue;
+      }
       FlightRecorder::Get().Record("frame.send_fail", "",
                                    int64_t(payload.size()), fd, errno);
       return false;

@@ -1,0 +1,320 @@
+#include "htpu/uring_transport.h"
+
+#include <errno.h>
+#include <linux/io_uring.h>
+#include <linux/time_types.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+
+#include "htpu/flight_recorder.h"
+#include "htpu/metrics.h"
+
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+#ifndef __NR_io_uring_register
+#define __NR_io_uring_register 427
+#endif
+
+namespace htpu {
+
+namespace {
+
+constexpr size_t kSliceBytes = 1 << 20;  // match DuplexTransfer's slicing
+
+// user_data layout: low 2 bits tag the direction (1 = send, 2 = recv),
+// the rest carry the Duplex-call generation.
+constexpr uint64_t kTagSend = 1;
+constexpr uint64_t kTagRecv = 2;
+
+int SysSetup(unsigned entries, struct io_uring_params* p) {
+  return int(syscall(__NR_io_uring_setup, entries, p));
+}
+
+int SysEnter(int fd, unsigned to_submit, unsigned min_complete,
+             unsigned flags, const void* arg, size_t argsz) {
+  return int(syscall(__NR_io_uring_enter, fd, to_submit, min_complete,
+                     flags, arg, argsz));
+}
+
+int SysRegister(int fd, unsigned opcode, const void* arg,
+                unsigned nr_args) {
+  return int(syscall(__NR_io_uring_register, fd, opcode, arg, nr_args));
+}
+
+}  // namespace
+
+std::unique_ptr<UringTransport> UringTransport::Create(unsigned entries,
+                                                       std::string* err) {
+  const char* seam = std::getenv("HOROVOD_TPU_URING_TEST_FAIL");
+  if (seam && seam[0] == '1') {
+    if (err) *err = "io_uring_setup failure forced by test seam";
+    return nullptr;
+  }
+  struct io_uring_params p;
+  std::memset(&p, 0, sizeof(p));
+  int fd = SysSetup(entries, &p);
+  if (fd < 0) {
+    if (err) *err = std::string("io_uring_setup: ") + strerror(errno);
+    return nullptr;
+  }
+  // SINGLE_MMAP keeps the mapping logic simple; EXT_ARG is what gives
+  // io_uring_enter a timeout without a dedicated timeout SQE.  Both ship
+  // in 5.11+; older kernels take the classic path.
+  if (!(p.features & IORING_FEAT_SINGLE_MMAP) ||
+      !(p.features & IORING_FEAT_EXT_ARG)) {
+    close(fd);
+    if (err) *err = "kernel io_uring lacks SINGLE_MMAP/EXT_ARG";
+    return nullptr;
+  }
+  std::unique_ptr<UringTransport> t(new UringTransport());
+  t->ring_fd_ = fd;
+  t->sq_entries_ = p.sq_entries;
+  t->cq_entries_ = p.cq_entries;
+  size_t sq_bytes = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+  size_t cq_bytes =
+      p.cq_off.cqes + size_t(p.cq_entries) * sizeof(struct io_uring_cqe);
+  t->sq_bytes_ = sq_bytes > cq_bytes ? sq_bytes : cq_bytes;
+  t->sq_ptr_ = mmap(nullptr, t->sq_bytes_, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+  if (t->sq_ptr_ == MAP_FAILED) {
+    t->sq_ptr_ = nullptr;
+    if (err) *err = std::string("mmap sq ring: ") + strerror(errno);
+    return nullptr;  // destructor closes ring_fd_
+  }
+  t->sqes_bytes_ = size_t(p.sq_entries) * sizeof(struct io_uring_sqe);
+  t->sqes_ptr_ = mmap(nullptr, t->sqes_bytes_, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+  if (t->sqes_ptr_ == MAP_FAILED) {
+    t->sqes_ptr_ = nullptr;
+    if (err) *err = std::string("mmap sqes: ") + strerror(errno);
+    return nullptr;
+  }
+  char* sq = static_cast<char*>(t->sq_ptr_);
+  t->sq_head_ = reinterpret_cast<unsigned*>(sq + p.sq_off.head);
+  t->sq_tail_ = reinterpret_cast<unsigned*>(sq + p.sq_off.tail);
+  t->sq_mask_ = reinterpret_cast<unsigned*>(sq + p.sq_off.ring_mask);
+  t->sq_array_ = reinterpret_cast<unsigned*>(sq + p.sq_off.array);
+  t->cq_head_ = reinterpret_cast<unsigned*>(sq + p.cq_off.head);
+  t->cq_tail_ = reinterpret_cast<unsigned*>(sq + p.cq_off.tail);
+  t->cq_mask_ = reinterpret_cast<unsigned*>(sq + p.cq_off.ring_mask);
+  t->cqes_ = sq + p.cq_off.cqes;
+  return t;
+}
+
+UringTransport::~UringTransport() {
+  // close() reaps inflight submissions and releases registered-buffer
+  // page pins; no explicit UNREGISTER needed on teardown.
+  if (sqes_ptr_) munmap(sqes_ptr_, sqes_bytes_);
+  if (sq_ptr_) munmap(sq_ptr_, sq_bytes_);
+  if (ring_fd_ >= 0) close(ring_fd_);
+}
+
+void UringTransport::RegisterBuffers(
+    const std::vector<std::pair<char*, size_t>>& slabs) {
+  std::vector<std::pair<char*, size_t>> want;
+  for (const auto& s : slabs) {
+    if (s.first != nullptr && s.second != 0) want.push_back(s);
+  }
+  if (buffers_registered_ && want == registered_) return;
+  if (buffers_registered_) {
+    SysRegister(ring_fd_, IORING_UNREGISTER_BUFFERS, nullptr, 0);
+    buffers_registered_ = false;
+    registered_.clear();
+  }
+  if (want.empty()) return;
+  std::vector<struct iovec> iovs(want.size());
+  for (size_t i = 0; i < want.size(); ++i) {
+    iovs[i].iov_base = want[i].first;
+    iovs[i].iov_len = want[i].second;
+  }
+  if (SysRegister(ring_fd_, IORING_REGISTER_BUFFERS, iovs.data(),
+                  unsigned(iovs.size())) == 0) {
+    registered_ = want;
+    buffers_registered_ = true;
+  }
+  // On failure (RLIMIT_MEMLOCK, huge slabs) receives run as plain
+  // OP_RECV — slower, still correct.
+}
+
+int UringTransport::FixedIndexOf(const char* p, size_t len) const {
+  if (!buffers_registered_) return -1;
+  for (size_t i = 0; i < registered_.size(); ++i) {
+    const char* lo = registered_[i].first;
+    if (p >= lo && p + len <= lo + registered_[i].second) return int(i);
+  }
+  return -1;
+}
+
+void* UringTransport::SqeAt(unsigned idx) const {
+  return static_cast<char*>(sqes_ptr_) +
+         size_t(idx) * sizeof(struct io_uring_sqe);
+}
+
+void UringTransport::PrepSqe(unsigned idx, uint8_t opcode, int fd,
+                             const void* addr, unsigned len,
+                             uint64_t user_data, int buf_index) {
+  auto* sqe = static_cast<struct io_uring_sqe*>(SqeAt(idx));
+  std::memset(sqe, 0, sizeof(*sqe));
+  sqe->opcode = opcode;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(addr);
+  sqe->len = len;
+  sqe->user_data = user_data;
+  if (opcode == IORING_OP_SEND) sqe->msg_flags = MSG_NOSIGNAL;
+  if (buf_index >= 0) sqe->buf_index = uint16_t(buf_index);
+}
+
+int UringTransport::Enter(unsigned to_submit, unsigned min_complete,
+                          int timeout_ms) {
+  struct __kernel_timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = (long long)(timeout_ms % 1000) * 1000000ll;
+  struct io_uring_getevents_arg arg;
+  std::memset(&arg, 0, sizeof(arg));
+  arg.ts = reinterpret_cast<uint64_t>(&ts);
+  return SysEnter(ring_fd_, to_submit, min_complete,
+                  IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
+                  sizeof(arg));
+}
+
+void UringTransport::DrainCqes(std::vector<std::pair<uint64_t, int>>* out) {
+  unsigned head = *cq_head_;
+  unsigned tail = __atomic_load_n(cq_tail_, __ATOMIC_ACQUIRE);
+  while (head != tail) {
+    const auto* cqe = reinterpret_cast<const struct io_uring_cqe*>(
+        static_cast<const char*>(cqes_) +
+        size_t(head & *cq_mask_) * sizeof(struct io_uring_cqe));
+    out->emplace_back(cqe->user_data, cqe->res);
+    ++head;
+  }
+  __atomic_store_n(cq_head_, head, __ATOMIC_RELEASE);
+}
+
+bool UringTransport::Duplex(int send_fd, const char* send_buf,
+                            size_t send_len, int recv_fd, char* recv_buf,
+                            size_t recv_len, int timeout_ms,
+                            int* failed_fd) {
+  if (failed_fd) *failed_fd = -1;
+  const uint64_t gen = ++gen_;
+  size_t sent = 0, rcvd = 0;
+  // Same accounting contract as DuplexTransfer: whatever moved is counted
+  // on every exit path.
+  struct ByteGuard {
+    const size_t& s;
+    const size_t& r;
+    ~ByteGuard() {
+      static std::atomic<long long>* ds =
+          Metrics::Get().Counter("transport.duplex_bytes_sent");
+      static std::atomic<long long>* dr =
+          Metrics::Get().Counter("transport.duplex_bytes_recv");
+      ds->fetch_add(static_cast<long long>(s), std::memory_order_relaxed);
+      dr->fetch_add(static_cast<long long>(r), std::memory_order_relaxed);
+    }
+  } byte_guard{sent, rcvd};
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  bool send_inflight = false, recv_inflight = false;
+  std::vector<std::pair<uint64_t, int>> cqes;
+  while (sent < send_len || rcvd < recv_len) {
+    // Submit one SQE per idle direction.
+    unsigned to_submit = 0;
+    unsigned tail = *sq_tail_;
+    const unsigned mask = *sq_mask_;
+    if (sent < send_len && !send_inflight) {
+      size_t want = send_len - sent;
+      if (want > kSliceBytes) want = kSliceBytes;
+      unsigned idx = tail & mask;
+      PrepSqe(idx, IORING_OP_SEND, send_fd, send_buf + sent,
+              unsigned(want), (gen << 2) | kTagSend, -1);
+      sq_array_[idx] = idx;
+      ++tail;
+      ++to_submit;
+      send_inflight = true;
+    }
+    if (rcvd < recv_len && !recv_inflight) {
+      size_t want = recv_len - rcvd;
+      if (want > kSliceBytes) want = kSliceBytes;
+      unsigned idx = tail & mask;
+      int fixed = FixedIndexOf(recv_buf + rcvd, want);
+      PrepSqe(idx, fixed >= 0 ? IORING_OP_READ_FIXED : IORING_OP_RECV,
+              recv_fd, recv_buf + rcvd, unsigned(want),
+              (gen << 2) | kTagRecv, fixed);
+      sq_array_[idx] = idx;
+      ++tail;
+      ++to_submit;
+      recv_inflight = true;
+    }
+    if (to_submit)
+      __atomic_store_n(sq_tail_, tail, __ATOMIC_RELEASE);
+    int remain = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count());
+    if (remain <= 0) {
+      FlightRecorder::Get().Record("duplex.timeout", "uring",
+                                   int64_t(send_len + recv_len), send_fd,
+                                   recv_fd);
+      return false;
+    }
+    int rc = Enter(to_submit, 1, remain);
+    if (rc < 0 && errno != ETIME && errno != EINTR && errno != EAGAIN &&
+        errno != EBUSY) {
+      if (failed_fd) *failed_fd = send_fd;
+      FlightRecorder::Get().Record("duplex.send_fail", "uring enter",
+                                   int64_t(send_len + recv_len), send_fd,
+                                   errno);
+      return false;
+    }
+    cqes.clear();
+    DrainCqes(&cqes);
+    for (const auto& c : cqes) {
+      if ((c.first >> 2) != gen) continue;  // stale, from a torn transfer
+      const uint64_t tag = c.first & 3;
+      const int res = c.second;
+      if (tag == kTagSend) {
+        send_inflight = false;
+        if (res < 0) {
+          if (res == -EINTR || res == -EAGAIN) continue;  // resubmit
+          if (failed_fd) *failed_fd = send_fd;
+          FlightRecorder::Get().Record("duplex.send_fail", "uring",
+                                       int64_t(send_len - sent), send_fd,
+                                       -res);
+          return false;
+        }
+        sent += size_t(res);
+      } else if (tag == kTagRecv) {
+        recv_inflight = false;
+        if (res < 0) {
+          if (res == -EINTR || res == -EAGAIN) continue;
+          if (failed_fd) *failed_fd = recv_fd;
+          FlightRecorder::Get().Record("duplex.recv_fail", "uring",
+                                       int64_t(recv_len - rcvd), recv_fd,
+                                       -res);
+          return false;
+        }
+        if (res == 0) {
+          if (failed_fd) *failed_fd = recv_fd;
+          FlightRecorder::Get().Record("duplex.recv_fail",
+                                       "peer closed (uring)",
+                                       int64_t(recv_len - rcvd), recv_fd,
+                                       0);
+          return false;
+        }
+        rcvd += size_t(res);
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace htpu

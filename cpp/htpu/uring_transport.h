@@ -1,0 +1,99 @@
+// io_uring data-plane transport for the leader TCP ring.
+//
+// The classic ring step is poll + send/recv per 1MiB slice: four syscalls
+// per slice per direction.  Here both directions of a ring step are
+// submitted as SQEs on one io_uring and reaped from its completion queue —
+// one io_uring_enter per batch — with receive buffers pre-registered
+// (IORING_REGISTER_BUFFERS over the control plane's scratch-pool slabs) so
+// the kernel pins the pages once per membership generation instead of per
+// transfer (IORING_OP_READ_FIXED).
+//
+// Built on raw syscalls (no liburing dependency); requires
+// IORING_FEAT_SINGLE_MMAP and IORING_FEAT_EXT_ARG, i.e. kernel >= 5.11.
+// Create() returns nullptr when io_uring is unavailable (old kernel,
+// seccomp, RLIMIT_MEMLOCK) and the caller stays on the classic
+// DuplexTransfer path — the fallback ladder in docs/concepts.md.
+#ifndef HTPU_URING_TRANSPORT_H_
+#define HTPU_URING_TRANSPORT_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace htpu {
+
+class UringTransport {
+ public:
+  // Set up a ring with ~`entries` SQ slots.  nullptr (with *err) when the
+  // kernel refuses or lacks the required features; the test seam
+  // HOROVOD_TPU_URING_TEST_FAIL=1 forces this outcome.
+  static std::unique_ptr<UringTransport> Create(unsigned entries,
+                                                std::string* err);
+  // Tears the ring down: munmap + close(ring_fd) reaps any inflight
+  // submissions and drops registered-buffer pins kernel-side, so
+  // destruction is safe even right after a timed-out Duplex left a
+  // receive SQE pending.
+  ~UringTransport();
+
+  // (Re-)register the receive-side buffer slabs.  A no-op when the spans
+  // match the currently registered set; otherwise unregisters and
+  // re-registers (the ring is quiescent between Duplex calls, so this is
+  // safe).  Failure leaves the transport usable — receives simply fall
+  // back to non-fixed OP_RECV.
+  void RegisterBuffers(const std::vector<std::pair<char*, size_t>>& slabs);
+
+  // Same contract as DuplexTransfer: send exactly send_len on send_fd
+  // while receiving exactly recv_len on recv_fd, in 1MiB slices, both
+  // directions inflight at once.  False on timeout or peer failure with
+  // `failed_fd` attribution (-1 for a plain timeout).  Bumps the same
+  // transport.duplex_bytes_* counters as the classic path.
+  bool Duplex(int send_fd, const char* send_buf, size_t send_len,
+              int recv_fd, char* recv_buf, size_t recv_len, int timeout_ms,
+              int* failed_fd);
+
+ private:
+  UringTransport() = default;
+  UringTransport(const UringTransport&) = delete;
+  UringTransport& operator=(const UringTransport&) = delete;
+
+  // Index of the registered slab fully containing [p, p+len), or -1.
+  int FixedIndexOf(const char* p, size_t len) const;
+  void* SqeAt(unsigned idx) const;
+  void PrepSqe(unsigned idx, uint8_t opcode, int fd, const void* addr,
+               unsigned len, uint64_t user_data, int buf_index);
+  // Pushes `count` freshly prepared SQEs and waits for >= 1 completion
+  // (bounded by timeout_ms); returns completions via DrainCqes.
+  int Enter(unsigned to_submit, unsigned min_complete, int timeout_ms);
+  // Drains available CQEs into (user_data, res) pairs.
+  void DrainCqes(std::vector<std::pair<uint64_t, int>>* out);
+
+  int ring_fd_ = -1;
+  unsigned sq_entries_ = 0;
+  unsigned cq_entries_ = 0;
+  void* sq_ptr_ = nullptr;       // shared SQ+CQ mapping (SINGLE_MMAP)
+  size_t sq_bytes_ = 0;
+  void* sqes_ptr_ = nullptr;     // SQE array mapping
+  size_t sqes_bytes_ = 0;
+  // Ring pointers into the shared mapping.
+  unsigned* sq_head_ = nullptr;
+  unsigned* sq_tail_ = nullptr;
+  unsigned* sq_mask_ = nullptr;
+  unsigned* sq_array_ = nullptr;
+  unsigned* cq_head_ = nullptr;
+  unsigned* cq_tail_ = nullptr;
+  unsigned* cq_mask_ = nullptr;
+  void* cqes_ = nullptr;
+
+  std::vector<std::pair<char*, size_t>> registered_;
+  bool buffers_registered_ = false;
+  // Per-Duplex generation folded into user_data so a CQE from a
+  // timed-out earlier transfer can never be mistaken for this one's.
+  uint64_t gen_ = 0;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_URING_TRANSPORT_H_

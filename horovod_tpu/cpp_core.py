@@ -172,6 +172,8 @@ def _configure(lib) -> None:
         lib.htpu_control_elastic.argtypes = [ctypes.c_void_p]
     lib.htpu_control_ring_transport.restype = ctypes.c_char_p
     lib.htpu_control_ring_transport.argtypes = [ctypes.c_void_p]
+    lib.htpu_control_data_transport.restype = ctypes.c_char_p
+    lib.htpu_control_data_transport.argtypes = [ctypes.c_void_p]
     lib.htpu_control_set_timeline.restype = None
     lib.htpu_control_set_timeline.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p]
@@ -1002,6 +1004,13 @@ class CppControlPlane:
         co-located on-host fast path), 'tcp' across hosts, 'none' when
         single-process."""
         return self._lib.htpu_control_ring_transport(
+            self._ptr).decode("ascii")
+
+    def data_transport(self) -> str:
+        """Zero-copy transports active on the data plane: 'classic',
+        'shm', 'uring', or 'shm+uring' (HOROVOD_TPU_TRANSPORT and any
+        runtime fallbacks both reflected)."""
+        return self._lib.htpu_control_data_transport(
             self._ptr).decode("ascii")
 
     def stalled(self, age_s: float):

@@ -117,6 +117,38 @@ class TestTenantDigest:
         assert "tenants by process set" in out
 
 
+class TestXportDigest:
+    """Zero-copy transport digest (PR: zero-copy data plane)."""
+
+    def _snap(self):
+        return {"rank": 0, "ts": 100,
+                "counters": {"ring.shm.ops": 10,
+                             "ring.shm.bytes_sent": 2621440,
+                             "ring.shm.bytes_recv": 2621440,
+                             "ring.uring.fallbacks": 1},
+                "gauges": {}, "histograms": {}}
+
+    def test_one_line_per_engaged_leg(self):
+        lines = metrics_watch.render_xport_summary(self._snap(), "")
+        text = "\n".join(lines)
+        assert "zero-copy transports" in text
+        shm = next(ln for ln in lines if "xport[shm]" in ln)
+        assert "ops=10" in shm and "sent=2.5MiB" in shm \
+            and "recv=2.5MiB" in shm
+        # A leg that only fell back still surfaces, loudly.
+        uring = next(ln for ln in lines if "xport[uring]" in ln)
+        assert "FALLBACKS=1" in uring
+
+    def test_absent_on_classic_transport(self):
+        snap = {"counters": {"ring.allreduce.ops": 5}, "gauges": {},
+                "histograms": {}}
+        assert metrics_watch.render_xport_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(), None, "")
+        assert "zero-copy transports" in out
+
+
 class TestBadInputs:
     """Missing/empty inputs produce a one-line error, not a traceback or
     silence (PR: static analysis)."""

@@ -1,0 +1,269 @@
+"""Zero-copy data plane (PR: shm intra-host rings + io_uring leader ring).
+
+All slow multi-process tests over the native control plane:
+
+* ``HOROVOD_TPU_TRANSPORT=shm`` — the hierarchical fan-in/fan-out rides
+  the per-host shared-memory segment, bit-identical to classic, with the
+  ``ring.shm.*`` counters reconciling exactly against the payload math
+  and no ``/dev/shm`` entry surviving the run;
+* ``HOROVOD_TPU_TRANSPORT=uring`` — the flat ring rides io_uring,
+  bit-identical to classic, with ``ring.uring.*`` counters moving;
+* the int8 wire format stays bit-identical across transports (quantized
+  leader-ring legs over raw shm intra-host legs);
+* ``HOROVOD_TPU_URING_TEST_FAIL=1`` — a job that cannot set up io_uring
+  falls back to the classic sockets, bit-identical, with exactly one
+  ``ring.uring.fallbacks`` tick per process;
+* a job-wide ``HOROVOD_TPU_TRANSPORT`` disagreement dies with ONE
+  attributed error naming the divergent rank, and an unknown value is
+  rejected at init;
+* elastic kill-one-rank and coordinator failover drills keep working
+  with the zero-copy transports live, leaking no shm segment.
+"""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import cpp_core
+
+from test_elastic import finish, start_elastic_procs
+from test_hierarchical import WORKER, free_port, launch, parse, run_ok
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not cpp_core.available(),
+                       reason="native core not built"),
+]
+
+
+def assert_devshm_clean():
+    left = glob.glob("/dev/shm/htpu_shm_*")
+    assert not left, f"leaked shm segments: {left}"
+
+
+def shm_counters(counters):
+    return {k: v for k, v in counters.items() if k.startswith("ring.shm.")}
+
+
+# Same payload schedule as test_hierarchical.WORKER: 4 payloads of
+# TEST_ELEMS fp32 plus 6 cache-replay rounds — 10 collectives total.
+ELEMS = 65536
+COLLECTIVES = 10
+PAYLOAD = ELEMS * 4  # fp32
+
+
+# WORKER plus a data-transport assertion against EXPECT_DATA_TRANSPORT.
+XPORT_WORKER = WORKER.replace(
+    'print("DIGEST',
+    textwrap.dedent("""\
+    from horovod_tpu import basics
+    dt = basics.controller()._control.data_transport()
+    expect = os.environ.get("EXPECT_DATA_TRANSPORT")
+    if expect and dt != expect:
+        raise AssertionError(f"data_transport {dt!r} != {expect!r}")
+    print("XPORT", dt, flush=True)
+    print("DIGEST"""))
+
+
+class TestShmFanIn:
+    def test_shm_hier_bit_identical_and_reconciles(self):
+        """Two 2-proc host groups under ``shm``: digests must match the
+        classic transport bit for bit, and every process must have moved
+        exactly COLLECTIVES payloads through the segment each way."""
+        fps = ["hostA", "hostA", "hostB", "hostB"]
+        classic = run_ok(fps, "hier",
+                         extra_env={"HOROVOD_TPU_TRANSPORT": "classic"})
+        shm = run_ok(fps, "hier", script=XPORT_WORKER,
+                     extra_env={"HOROVOD_TPU_TRANSPORT": "shm",
+                                "EXPECT_DATA_TRANSPORT": "shm"})
+        assert classic[0][0] == shm[0][0]
+        for _, c in shm:
+            # Each proc is leader or member of a 2-proc group: one
+            # payload in and one payload out per collective, both ways.
+            want = COLLECTIVES * PAYLOAD
+            assert c.get("ring.shm.bytes_sent") == want, shm_counters(c)
+            assert c.get("ring.shm.bytes_recv") == want, shm_counters(c)
+            assert c.get("ring.shm.ops") == COLLECTIVES, shm_counters(c)
+            assert c.get("ring.shm.fallbacks", 0) == 0, shm_counters(c)
+            # The shm legs are accounted as hier-local traffic too, so
+            # the observability story stays comparable across transports.
+            local = sum(v for k, v in c.items()
+                        if k.startswith("ring.hier_local."))
+            assert local == 2 * want, c
+        for _, c in classic:
+            assert c.get("ring.shm.bytes_sent", 0) == 0
+            assert c.get("ring.shm.ops", 0) == 0
+        assert_devshm_clean()
+
+    def test_int8_wire_bit_identical_over_shm_uring(self):
+        """The quantized leader ring over raw shm intra-host legs must
+        produce exactly the classic path's bytes.  int8's range-scaled
+        quantization is lossy on random payloads, so the oracle check is
+        dropped — bit-identity ACROSS transports is the contract."""
+        fps = ["hostA", "hostA", "hostB", "hostB"]
+        env = {"HOROVOD_TPU_WIRE_DTYPE": "int8"}
+        worker = WORKER.replace(
+            'raise AssertionError(f"rank {rank} payload {i}: wrong sum")',
+            "pass")
+        # Quantization noise makes RANKS diverge from each other (the
+        # segment owner keeps full precision; receivers dequantize), so
+        # the assertion is per-rank across transports, not cross-rank.
+        classic = [parse(out) for rc, out in launch(
+            fps, "hier", script=worker,
+            extra_env={**env, "HOROVOD_TPU_TRANSPORT": "classic"})
+            if rc == 0 or pytest.fail(out)]
+        auto = [parse(out) for rc, out in launch(
+            fps, "hier", script=worker, extra_env=env)
+            if rc == 0 or pytest.fail(out)]
+        for i, ((dc, _), (da, ca)) in enumerate(zip(classic, auto)):
+            assert dc == da, f"rank {i} diverged across transports"
+            assert ca is not None
+        # int8 actually rode the leader wire, and shm the local legs.
+        assert any(k.startswith("ring.allreduce.bytes_sent#wire=int8")
+                   for k in auto[0][1]), auto[0][1]
+        assert auto[0][1].get("ring.shm.ops", 0) > 0, auto[0][1]
+        assert_devshm_clean()
+
+
+class TestUringRing:
+    def test_uring_flat_ring_bit_identical(self):
+        fps = ["hostA", "hostB"]   # distinct hosts: pure flat ring
+        classic = run_ok(fps, "ring",
+                         extra_env={"HOROVOD_TPU_TRANSPORT": "classic"})
+        uring = run_ok(fps, "ring", script=XPORT_WORKER,
+                       extra_env={"HOROVOD_TPU_TRANSPORT": "uring",
+                                  "EXPECT_DATA_TRANSPORT": "uring"})
+        assert classic[0][0] == uring[0][0]
+        for _, c in uring:
+            assert c.get("ring.uring.ops", 0) > 0, c
+            assert c.get("ring.uring.bytes_sent", 0) > COLLECTIVES * PAYLOAD
+            assert c.get("ring.uring.fallbacks", 0) == 0
+        for _, c in classic:
+            assert c.get("ring.uring.ops", 0) == 0
+
+    def test_forced_uring_failure_falls_back_bit_identical(self):
+        """The HOROVOD_TPU_URING_TEST_FAIL seam models a kernel without
+        io_uring: the job must land on classic sockets with the identical
+        digest and exactly one fallback tick per process."""
+        fps = ["hostA", "hostB"]
+        classic = run_ok(fps, "ring",
+                         extra_env={"HOROVOD_TPU_TRANSPORT": "classic"})
+        fell = run_ok(fps, "ring", script=XPORT_WORKER,
+                      extra_env={"HOROVOD_TPU_TRANSPORT": "uring",
+                                 "HOROVOD_TPU_URING_TEST_FAIL": "1",
+                                 "EXPECT_DATA_TRANSPORT": "classic"})
+        assert classic[0][0] == fell[0][0]
+        for _, c in fell:
+            assert c.get("ring.uring.fallbacks") == 1, c
+            assert c.get("ring.uring.ops", 0) == 0, c
+
+
+class TestKnobValidation:
+    def _launch_mixed(self, transports):
+        """test_hierarchical.launch, but with a per-process transport."""
+        nprocs = len(transports)
+        port = free_port()
+        procs = []
+        for i, tr in enumerate(transports):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+                "HOROVOD_TPU_PROCESS_INDEX": str(i),
+                "HOROVOD_TPU_PROCESS_COUNT": str(nprocs),
+                "HOROVOD_TPU_SIZE": str(nprocs),
+                "HOROVOD_TPU_RANK": str(i),
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S": "30",
+                "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+                "HOROVOD_TPU_TRANSPORT": tr,
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            env.pop("HOROVOD_TPU_TIMELINE", None)
+            env.pop("HOROVOD_TPU_FAULT", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append((p.returncode, out))
+        return outs
+
+    def test_transport_mismatch_is_one_attributed_error(self):
+        outs = self._launch_mixed(["uring", "classic"])
+        assert all(rc != 0 for rc, _ in outs), outs
+        blob = "\n".join(out for _, out in outs)
+        assert "HOROVOD_TPU_TRANSPORT mismatch" in blob, blob
+        assert "selected 'classic'" in blob and "selected 'uring'" in blob, \
+            blob
+
+    def test_unknown_transport_rejected_at_init(self):
+        outs = self._launch_mixed(["bogus", "bogus"])
+        assert all(rc != 0 for rc, _ in outs), outs
+        blob = "\n".join(out for _, out in outs)
+        assert "unknown HOROVOD_TPU_TRANSPORT" in blob, blob
+
+
+class TestElasticWithZeroCopy:
+    # All test processes share the real host fingerprint, so `hier` forms
+    # one host group: proc with the lowest index leads, the rest ride the
+    # shm segment.  The drills reuse the elastic harness unchanged — the
+    # point is that teardown/rebuild carries the transports across
+    # generations without wedging or leaking.
+
+    def test_kill_one_rank_reconfigures_with_shm(self, tmp_path):
+        procs = start_elastic_procs(
+            3, tmp_path, {"TEST_DIE_RANK": "2",
+                          "HOROVOD_TPU_ALLREDUCE_ALGO": "hier",
+                          "TEST_EXPECT_SIZE": "2"})
+        results = [finish(p) for p in procs]
+        assert results[2][0] == -signal.SIGKILL
+        for rc, out in results[:2]:
+            assert rc == 0, out
+            assert "ABORTED" not in out, out
+            assert "RESUMED" in out and "state_ok=True" in out, out
+        assert_devshm_clean()
+
+    def test_rank0_failover_with_shm(self, tmp_path):
+        procs = start_elastic_procs(
+            3, tmp_path,
+            {"HOROVOD_TPU_FAULT": "crash:rank=0:tick=60",
+             "HOROVOD_TPU_RENDEZVOUS_S": "20",
+             "HOROVOD_TPU_ALLREDUCE_ALGO": "hier",
+             "TEST_EXPECT_SIZE": "2"})
+        results = [finish(p) for p in procs]
+        rc0, out0 = results[0]
+        assert rc0 == 42, out0
+        rc1, out1 = results[1]
+        assert rc1 == 0, out1
+        assert "took over as coordinator" in out1, out1
+        assert "RESUMED rank=0 size=2 gen=1" in out1, out1
+        rc2, out2 = results[2]
+        assert rc2 == 0, out2
+        assert "RESUMED rank=1 size=2 gen=1" in out2, out2
+        assert_devshm_clean()
+
+    def test_kill_one_rank_reconfigures_with_uring(self, tmp_path):
+        procs = start_elastic_procs(
+            3, tmp_path, {"TEST_DIE_RANK": "2",
+                          "HOROVOD_TPU_TRANSPORT": "uring",
+                          "HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                          "HOROVOD_TPU_UDS": "0",
+                          "TEST_EXPECT_SIZE": "2"})
+        results = [finish(p) for p in procs]
+        assert results[2][0] == -signal.SIGKILL
+        for rc, out in results[:2]:
+            assert rc == 0, out
+            assert "ABORTED" not in out, out
+            assert "RESUMED" in out and "state_ok=True" in out, out

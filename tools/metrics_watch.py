@@ -299,6 +299,35 @@ def render_overlap_summary(snap: dict, name_filter: str) -> list[str]:
             f"  {'overlap':<52} {text}"]
 
 
+def render_xport_summary(snap: dict, name_filter: str) -> list[str]:
+    """One-line digest per zero-copy transport leg: payloads and bytes
+    each way through the per-host shm segment and the io_uring leader
+    ring, plus fallback ticks (``ring.shm.*`` / ``ring.uring.*``,
+    docs/concepts.md "Transports").  A leg that never engaged — classic
+    transport, or uring that fell back at setup — shows only its
+    fallback count, so a silent downgrade is visible at a glance."""
+    counters = snap.get("counters", {})
+    lines = []
+    for leg in ("shm", "uring"):
+        prefix = f"ring.{leg}."
+        name = f"xport[{leg}]"
+        if name_filter and name_filter not in name:
+            continue
+        ops = counters.get(prefix + "ops", 0)
+        falls = counters.get(prefix + "fallbacks", 0)
+        if not ops and not falls:
+            continue
+        text = (f"ops={ops:g}"
+                f" sent={human_bytes(counters.get(prefix + 'bytes_sent', 0))}"
+                f" recv={human_bytes(counters.get(prefix + 'bytes_recv', 0))}")
+        if falls:
+            text += f" FALLBACKS={falls:g}"
+        lines.append(f"  {name:<52} {text}")
+    if lines:
+        lines.insert(0, "  -- zero-copy transports --")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -344,6 +373,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
         lines.append(f"  {name:<52} {text}")
 
     lines.extend(render_algo_summary(snap, name_filter))
+    lines.extend(render_xport_summary(snap, name_filter))
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
     lines.extend(render_elastic_summary(snap, name_filter))
