@@ -1926,6 +1926,7 @@ def bench_scaling_tcp():
         env.pop("XLA_FLAGS", None)
         env.pop("HOROVOD_TPU_WIRE_DTYPE", None)
         env.pop("BENCH_TCP_PIN", None)
+        env.pop("HOROVOD_TPU_INTEGRITY", None)
         env.update(extra_env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
@@ -1971,7 +1972,21 @@ def bench_scaling_tcp():
                          "HOROVOD_TPU_UDS": "0"}),
             ("uring", {"HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
                        "HOROVOD_TPU_TRANSPORT": "uring",
-                       "HOROVOD_TPU_UDS": "0"}))
+                       "HOROVOD_TPU_UDS": "0"}),
+            # CRC A/B twins: the same three data-plane legs with the
+            # end-to-end integrity trailer on — the off/on ratio is the
+            # measured cost of checksumming every frame/chunk.
+            ("classic+crc", {"HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                             "HOROVOD_TPU_TRANSPORT": "classic",
+                             "HOROVOD_TPU_UDS": "0",
+                             "HOROVOD_TPU_INTEGRITY": "1"}),
+            ("shm+crc", {"HOROVOD_TPU_ALLREDUCE_ALGO": "hier",
+                         "HOROVOD_TPU_TRANSPORT": "shm",
+                         "HOROVOD_TPU_INTEGRITY": "1"}),
+            ("uring+crc", {"HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                           "HOROVOD_TPU_TRANSPORT": "uring",
+                           "HOROVOD_TPU_UDS": "0",
+                           "HOROVOD_TPU_INTEGRITY": "1"}))
         # Interleave the windows across legs (uds shm classic uring, then
         # again) rather than exhausting one leg's windows before the next:
         # the legs being ratioed below then sample the SAME stretch of
@@ -2013,6 +2028,26 @@ def bench_scaling_tcp():
                 min(shm_b[b] / uds_b[b] for b in shm_b), 3)
         except Exception:   # noqa: BLE001 — a failed leg has no curve
             xport["shm_vs_uds_speedup_256k_plus"] = None
+        # Headline CRC cost: per-leg worst-case p50 inflation with the
+        # integrity trailer on, across the >= 256 KiB payloads (small
+        # payloads are latency-dominated; the acceptance bound — checksum
+        # overhead under 5% — is a bandwidth-regime claim).
+        crc_over = {}
+        for label in ("classic", "shm", "uring"):
+            try:
+                off = {c["bytes"]: c["p50_us"]
+                       for c in xport[label]["sizes"]
+                       if c["bytes"] >= 1 << 18}
+                on = {c["bytes"]: c["p50_us"]
+                      for c in xport[label + "+crc"]["sizes"]
+                      if c["bytes"] >= 1 << 18}
+                crc_over[label] = round(
+                    max(on[b] / off[b] - 1.0 for b in off), 4)
+            except Exception:   # noqa: BLE001 — a failed leg has no curve
+                crc_over[label] = None
+        measured = [v for v in crc_over.values() if v is not None]
+        crc_over["max"] = round(max(measured), 4) if measured else None
+        xport["crc_overhead_256k_plus"] = crc_over
     else:
         xport = {"skipped": "BENCH_XPORT=0"}
     transport = two.get("ring_transport", "tcp")

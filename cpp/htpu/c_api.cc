@@ -16,6 +16,7 @@
 
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
+#include "htpu/integrity.h"
 #include "htpu/scheduler.h"
 #include "htpu/message_table.h"
 #include "htpu/metrics.h"
@@ -519,6 +520,33 @@ HTPU_API int htpu_control_last_error(void* cp, int* rank, void** out) {
 HTPU_API int htpu_control_stalled(void* cp, double age_s, void** out) {
   auto stalled = static_cast<htpu::ControlPlane*>(cp)->Stalled(age_s);
   return CopyOut(SerializeStallRecords(stalled), out);
+}
+
+// ---------------------------------------------------------- integrity
+
+// CRC32C (Castagnoli) over [data, data+len) — the checksum the integrity
+// layer stamps on frames/chunks; exported so the Python mirror
+// (horovod_tpu.wire.crc32c) can delegate to the dispatched native path.
+HTPU_API unsigned htpu_crc32c(const void* data, long long len) {
+  return htpu::Crc32c(data, size_t(len));
+}
+
+// Table-driven software path, always taken — the hw/sw parity tests pin
+// both implementations against each other through this pair.
+HTPU_API unsigned htpu_crc32c_sw(const void* data, long long len) {
+  return htpu::Crc32cSoftware(0, data, size_t(len));
+}
+
+// 1 when the dispatcher selected the SSE4.2 hardware path on this CPU.
+HTPU_API int htpu_crc32c_hw(void) { return htpu::Crc32cHardware() ? 1 : 0; }
+
+// Tensor names of the collective about to run — folded into the
+// attributed error when a checked transfer exhausts its retransmit
+// budget, so "corruption persisted" names the tensor, not just the peer.
+HTPU_API void htpu_control_set_xfer_context(void* cp, const char* tensors) {
+  if (!cp) return;
+  static_cast<htpu::ControlPlane*>(cp)->SetXferContext(tensors ? tensors
+                                                               : "");
 }
 
 // ------------------------------------------------------------------ metrics

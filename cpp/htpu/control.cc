@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "htpu/flight_recorder.h"
+#include "htpu/integrity.h"
 #include "htpu/policy.h"
 #include "htpu/scheduler.h"
 #include "htpu/metrics.h"
@@ -593,6 +594,7 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
         std::lock_guard<std::mutex> lock(err_mu_);
         last_error_rank_ = rank;
         last_error_ = err;
+        last_error_gen_ = generation_;
       }
       FlightRecorder::Get().Record("xport.mismatch", err.c_str(), 0, i);
       cleanup();
@@ -723,8 +725,12 @@ ControlPlane::~ControlPlane() {
 
 void ControlPlane::ParseFaultEnv() {
   // HOROVOD_TPU_FAULT=mode:rank=R:tick=T[;mode:rank=R:tick=T...] with
-  // mode one of crash/hang/drop_conn/rejoin/slow; R matches a process's
-  // FIRST global rank (at injection time — elastic re-ranking applies).
+  // mode one of crash/hang/drop_conn/rejoin/slow/corrupt; R matches a
+  // process's FIRST global rank (at injection time — elastic re-ranking
+  // applies).  `corrupt` takes optional leg= (classic|shm|uring|ctrl,
+  // default classic) and count= (default 1) and arms that many
+  // byte-flips on the leg at tick T — the corruption-chaos half of the
+  // integrity layer (integrity.h).
   // `slow` takes ms= instead of a one-shot tick (slow:rank=R:ms=M[:tick=T])
   // and sleeps M ms on every tick from T on — the deterministic planted
   // straggler the fleet-policy eviction drills feed on.  The
@@ -745,7 +751,8 @@ void ControlPlane::ParseFaultEnv() {
     if (!s.empty()) {
       size_t c = s.find(':');
       std::string mode = s.substr(0, c);
-      long long rank = -1, tick = -1, ms = 0;
+      long long rank = -1, tick = -1, ms = 0, count = 1;
+      std::string leg = "classic";
       while (c != std::string::npos) {
         size_t next = s.find(':', c + 1);
         std::string kv = s.substr(
@@ -754,15 +761,19 @@ void ControlPlane::ParseFaultEnv() {
         if (kv.rfind("rank=", 0) == 0) rank = atoll(kv.c_str() + 5);
         else if (kv.rfind("tick=", 0) == 0) tick = atoll(kv.c_str() + 5);
         else if (kv.rfind("ms=", 0) == 0) ms = atoll(kv.c_str() + 3);
+        else if (kv.rfind("count=", 0) == 0) count = atoll(kv.c_str() + 6);
+        else if (kv.rfind("leg=", 0) == 0) leg = kv.substr(4);
         c = next;
       }
       int m = mode == "crash" ? 1 : mode == "hang" ? 2
               : mode == "drop_conn" ? 3 : mode == "rejoin" ? 4
-              : mode == "slow" ? 5 : 0;
-      if (mode == "crash_in_save") {
-        // Python-owned fault: the checkpoint writer thread
-        // (ckpt_stream.py) fires it mid-commit; not a tick fault and
-        // not malformed — nothing for the native plane to arm.
+              : mode == "slow" ? 5 : mode == "corrupt" ? 6 : 0;
+      const int leg_id = leg == "classic" ? 0 : leg == "shm" ? 1
+                         : leg == "uring" ? 2 : leg == "ctrl" ? 3 : -1;
+      if (mode == "crash_in_save" || mode == "corrupt_ckpt") {
+        // Python-owned faults: the checkpoint writer thread
+        // (ckpt_stream.py) fires them around its commit; not tick faults
+        // and not malformed — nothing for the native plane to arm.
       } else if (m == 4 && rank >= 0 && tick > 0) {
         if (int(rank) == first_rank_) rejoin_tick_ = tick;
       } else if (m == 5 && rank >= 0 && ms > 0) {
@@ -772,7 +783,16 @@ void ControlPlane::ParseFaultEnv() {
         fs.tick = tick;   // optional: -1 = from the first tick
         fs.ms = ms;
         faults_.push_back(fs);
-      } else if (m && m != 5 && rank >= 0 && tick > 0) {
+      } else if (m == 6 && rank >= 0 && tick > 0 && leg_id >= 0 &&
+                 count > 0) {
+        FaultSpec fs;
+        fs.mode = m;
+        fs.rank = int(rank);
+        fs.tick = tick;
+        fs.leg = leg_id;
+        fs.count = int(count);
+        faults_.push_back(fs);
+      } else if (m && m != 5 && m != 6 && rank >= 0 && tick > 0) {
         FaultSpec fs;
         fs.mode = m;
         fs.rank = int(rank);
@@ -781,8 +801,10 @@ void ControlPlane::ParseFaultEnv() {
       } else {
         fprintf(stderr,
                 "htpu control: ignoring malformed HOROVOD_TPU_FAULT "
-                "spec '%s' (want crash|hang|drop_conn|rejoin:rank=R:tick=T"
-                " or slow:rank=R:ms=M[:tick=T][;...])\n", s.c_str());
+                "spec '%s' (want crash|hang|drop_conn|rejoin:rank=R:tick=T,"
+                " slow:rank=R:ms=M[:tick=T], or corrupt:rank=R:tick=T"
+                "[:leg=classic|shm|uring|ctrl][:count=N][;...])\n",
+                s.c_str());
       }
     }
     if (semi == std::string::npos) break;
@@ -814,6 +836,23 @@ void ControlPlane::MaybeInjectFault() {
       continue;
     }
     if (tick_count_ != uint64_t(fs.tick)) continue;
+    if (fs.mode == 6) {
+      // Arm the corruption-chaos engine: the next fs.count sends on the
+      // named leg each flip one byte post-checksum, pre-send
+      // (integrity.cc ConsumeCorrupt at the transport sites).
+      fprintf(stderr,
+              "htpu fault injection: arming %d byte-flip(s) on the %s leg "
+              "of rank %d at tick %llu\n", fs.count,
+              LegName(Leg(fs.leg)), first_rank_,
+              (unsigned long long)tick_count_);
+      fflush(stderr);
+      FlightRecorder::Get().Record("fault.corrupt_armed",
+                                   LegName(Leg(fs.leg)), fs.count,
+                                   first_rank_);
+      ArmCorrupt(Leg(fs.leg), fs.count);
+      fs.mode = 0;  // fires once
+      continue;
+    }
     if (fs.mode == 1) {
       fprintf(stderr, "htpu fault injection: crashing rank %d at tick %llu\n",
               first_rank_, (unsigned long long)tick_count_);
@@ -902,12 +941,24 @@ bool ControlPlane::AbortedFailFast() {
   std::lock_guard<std::mutex> lock(err_mu_);
   last_error_rank_ = abort_rank_;
   last_error_ = "job aborted: " + abort_reason_;
+  last_error_gen_ = generation_;
   return true;
 }
 
-bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
-                        int recv_fd, char* recv_buf, size_t recv_len,
-                        int send_peer, int recv_peer) {
+int32_t ControlPlane::PeerRank(int peer) const {
+  return (peer >= 0 && size_t(peer) < all_first_ranks_.size())
+             ? all_first_ranks_[size_t(peer)]
+             : -1;
+}
+
+bool ControlPlane::XferOnce(int send_fd, const char* send_buf,
+                            size_t send_len, int recv_fd, char* recv_buf,
+                            size_t recv_len, int send_peer, int recv_peer,
+                            const char* send_tr, char* recv_tr) {
+  // Any failure below belongs to the membership this transfer STARTED
+  // under — a reconfigure racing on the tick thread must not let the
+  // stale attribution leak into the new generation's reports.
+  const int32_t entry_gen = GenerationNow();
   int failed = -1;
   bool ok;
   if (uring_state_ == 1 && uring_) {
@@ -923,7 +974,7 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
                              {wseg_[1].data(), wseg_[1].size()},
                              {hier_buf_.data(), hier_buf_.size()}});
     ok = uring_->Duplex(send_fd, send_buf, send_len, recv_fd, recv_buf,
-                        recv_len, timeout_ms_, &failed);
+                        recv_len, timeout_ms_, &failed, send_tr, recv_tr);
     if (ok) {
       static std::atomic<long long>* u_sent =
           Metrics::Get().Counter("ring.uring.bytes_sent");
@@ -939,7 +990,7 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
     }
   } else {
     ok = DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
-                        recv_len, timeout_ms_, &failed);
+                        recv_len, timeout_ms_, &failed, send_tr, recv_tr);
   }
   if (ok) return true;
   // Attribute to the peer process whose fd died; a plain timeout most
@@ -960,10 +1011,147 @@ bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
     std::lock_guard<std::mutex> lock(err_mu_);
     last_error_rank_ = err_rank;
     last_error_ = err;
+    last_error_gen_ = entry_gen;
   }
   FlightRecorder::Get().Record("xfer.fail", err.c_str(),
                                int64_t(send_len + recv_len), peer, errno);
   return false;
+}
+
+bool ControlPlane::Xfer(int send_fd, const char* send_buf, size_t send_len,
+                        int recv_fd, char* recv_buf, size_t recv_len,
+                        int send_peer, int recv_peer) {
+  if (!IntegrityEnabled()) {
+    return XferOnce(send_fd, send_buf, send_len, recv_fd, recv_buf,
+                    recv_len, send_peer, recv_peer);
+  }
+  // Checked transfer: payload round with the CRC32C of each direction
+  // fused as a 4-byte trailer (each side ships the checksum of what it
+  // SENT alongside the payload — no extra round trip), then a
+  // direction-REVERSED verdict exchange (the receiver's verdict travels
+  // back to the sender on the same full-duplex socket).  After the
+  // verdict round BOTH sides know BOTH outcomes, so they retransmit the
+  // corrupted directions in lockstep — no extra negotiation — up to
+  // HOROVOD_TPU_XFER_RETRIES times under a jittered backoff.  Exhausted
+  // retries degrade exactly like a torn socket: attributed last_error_,
+  // CRC_FAIL flight event, elastic reconfigure / non-elastic abort.
+  const Leg leg = (uring_state_ == 1 && uring_) ? Leg::kUring
+                                                : Leg::kClassic;
+  const int32_t entry_gen = GenerationNow();
+  bool need_send = send_len > 0;
+  bool need_recv = recv_len > 0;
+  const int retries = XferRetries();
+  int backoff_ms = 10;
+  const int backoff_cap_ms =
+      std::max(1, int(connect_backoff_max_s_ * 1000.0));
+  unsigned jitter_seed = unsigned(first_rank_) * 2654435761u + 12345u;
+  // CRC of the CALLER's send buffer — computed before the chaos engine
+  // can flip a byte of the outgoing copy, and reused verbatim for
+  // retransmits (which send the pristine buffer again).
+  const uint32_t send_crc =
+      need_send ? Crc32c(send_buf, send_len) : 0;
+  for (int attempt = 0;; ++attempt) {
+    // Payload round.  A planted corruption sends a mangled COPY so the
+    // caller's buffer — and therefore every retransmit — stays pristine.
+    const char* wire_send = send_buf;
+    std::string mangled;
+    if (need_send && ConsumeCorrupt(leg)) {
+      mangled.assign(send_buf, send_len);
+      mangled[mangled.size() / 2] = char(mangled[mangled.size() / 2] ^ 0x5A);
+      wire_send = mangled.data();
+      FlightRecorder::Get().Record("fault.corrupt", LegName(leg),
+                                   int64_t(send_len), send_peer);
+    }
+    char crc_out[4], crc_in[4] = {0, 0, 0, 0};
+    for (int i = 0; i < 4; ++i)
+      crc_out[i] = char((send_crc >> (8 * i)) & 0xff);
+    if (!XferOnce(send_fd, wire_send, need_send ? send_len : 0, recv_fd,
+                  recv_buf, need_recv ? recv_len : 0, send_peer, recv_peer,
+                  need_send ? crc_out : nullptr,
+                  need_recv ? crc_in : nullptr)) {
+      return false;
+    }
+    bool recv_ok = true;
+    if (need_recv) {
+      uint32_t want = 0;
+      for (int i = 0; i < 4; ++i)
+        want |= uint32_t(uint8_t(crc_in[i])) << (8 * i);
+      CountBytesChecked(recv_len);
+      recv_ok = Crc32c(recv_buf, recv_len) == want;
+      if (!recv_ok) {
+        CountCrcError(leg);
+        std::string d = std::string("leg=") + LegName(leg) + " from rank " +
+                        std::to_string(PeerRank(recv_peer)) + " tick " +
+                        std::to_string(tick_count_);
+        FlightRecorder::Get().Record("CRC_FAIL", d.c_str(),
+                                     int64_t(recv_len), recv_peer);
+      }
+    }
+    // Verdict exchange, direction-reversed: the verdict on the bytes I
+    // received goes back to their sender on recv_fd; the verdict on my
+    // own send comes back on send_fd.
+    char v_out = recv_ok ? 1 : 0;
+    char v_in = 1;
+    if (!XferOnce(need_recv ? recv_fd : -1, &v_out, need_recv ? 1 : 0,
+                  need_send ? send_fd : -1, &v_in, need_send ? 1 : 0,
+                  recv_peer, send_peer)) {
+      return false;
+    }
+    const bool send_ok = !need_send || v_in == 1;
+    if (recv_ok && send_ok) return true;
+    if (!send_ok) {
+      // The downstream peer saw OUR bytes corrupted: CRC_FAIL on both
+      // ends, so the flight recorders tell the same story.
+      std::string d = std::string("leg=") + LegName(leg) +
+                      " reported by rank " +
+                      std::to_string(PeerRank(send_peer)) + " tick " +
+                      std::to_string(tick_count_);
+      FlightRecorder::Get().Record("CRC_FAIL", d.c_str(),
+                                   int64_t(send_len), send_peer);
+    }
+    if (attempt >= retries) {
+      const int peer = recv_ok ? send_peer : recv_peer;
+      const int32_t peer_rank = PeerRank(peer);
+      std::string err =
+          "ring data-plane corruption persisted after " +
+          std::to_string(retries) + " retransmit(s) on the " +
+          LegName(leg) + " leg (peer rank " + std::to_string(peer_rank) +
+          ", tick " + std::to_string(tick_count_) + ")";
+      {
+        std::lock_guard<std::mutex> lock(err_mu_);
+        if (!xfer_context_.empty()) err += ", tensor " + xfer_context_;
+        // Blame the rank that PRODUCED the corrupt bytes: the sender
+        // when our receives kept failing, OURSELVES when the peer kept
+        // rejecting our sends.  Both ends of the transfer then attribute
+        // the same rank, so the elastic coordinator evicts the corruptor
+        // — never the innocent reporter.
+        last_error_rank_ =
+            (!recv_ok && peer_rank >= 0) ? peer_rank : first_rank_;
+        last_error_ = err;
+        last_error_gen_ = entry_gen;
+      }
+      FlightRecorder::Get().Record("CRC_FAIL", err.c_str(),
+                                   int64_t(send_len + recv_len), peer);
+      // Degrade like a torn socket for the REST of the ring too: ranks
+      // not party to this transfer are still blocked mid-collective on
+      // us, and on the coordinator the control plane is wedged behind
+      // this very collective.  Shutting the sockets fails them fast —
+      // within a tick instead of a heartbeat/failover timeout.
+      if (send_fd >= 0) shutdown(send_fd, SHUT_RDWR);
+      if (recv_fd >= 0 && recv_fd != send_fd) shutdown(recv_fd, SHUT_RDWR);
+      return false;
+    }
+    if (!send_ok) CountRetransmit(leg);
+    // Jittered backoff before the lockstep retransmit round (same ±25%
+    // schedule as run.py's Backoff, bounded by the connect cap).
+    const int jitter_ms =
+        backoff_ms * (75 + int(rand_r(&jitter_seed) % 51)) / 100;
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(std::max(1, jitter_ms)));
+    backoff_ms = std::min(backoff_ms * 2, backoff_cap_ms);
+    need_send = need_send && !send_ok;
+    need_recv = need_recv && !recv_ok;
+  }
 }
 
 bool ControlPlane::RingXfer(int send_fd, const char* send_buf,
@@ -1255,6 +1443,18 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   // rank timing out separately with no cause.  Frames are kept per process
   // (not merged) so the response cache can expand each process's slot bits
   // against that process's stored requests.
+  //
+  // Report precedence within one gather: a corruption-exhaustion report
+  // names the rank that PRODUCED bad bytes (both ends of the checked
+  // transfer attribute the same rank), while a connection report only
+  // names the rank whose socket died — a symptom that cascades to
+  // innocent bystanders when the failing pair tears its sockets down.
+  // A root-cause report therefore UPGRADES over an earlier symptom
+  // report (including the coordinator's own), so the elastic path
+  // evicts the corruptor, never the neighbour that reported it.
+  auto is_root_cause = [](const std::string& reason) {
+    return reason.find("corruption persisted") != std::string::npos;
+  };
   bool shutdown = false;
   int32_t abort_rank = -1;
   std::string abort_reason;
@@ -1342,7 +1542,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
         }
       }
       shutdown = shutdown || frames[size_t(i)].shutdown;
-      if (frames[size_t(i)].abort_rank >= 0 && abort_rank < 0) {
+      if (frames[size_t(i)].abort_rank >= 0 &&
+          (abort_rank < 0 ||
+           (is_root_cause(frames[size_t(i)].abort_reason) &&
+            !is_root_cause(abort_reason)))) {
         // A worker reported a local transport/executor failure.
         abort_rank = frames[size_t(i)].abort_rank;
         abort_reason = frames[size_t(i)].abort_reason;
@@ -1404,9 +1607,21 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       for (int p : dead_procs) seen = seen || p == reported;
       if (!seen) dead_procs.push_back(reported);
     }
+    if (reported < 0 && dead_procs.empty() &&
+        abort_rank != worker_first_rank_[0]) {
+      // The blamed rank maps to no live worker and nothing failed at the
+      // gather itself: the report is cross-generation garbage — a
+      // failure attributed under a membership that a reconfigure already
+      // replaced, straggling in on a new-generation frame.  Discard it
+      // and keep ticking; escalating it would abort (or re-evict) ranks
+      // that survived the failure it describes.
+      FlightRecorder::Get().Record("elastic.stale_report",
+                                   abort_reason.c_str(), 0, abort_rank);
+      abort_rank = -1;
+      abort_reason.clear();
+    }
     // Only a non-coordinator process can be reconfigured away: the
-    // coordinator IS the control plane, and an unmappable rank means the
-    // attribution is already cross-generation garbage.
+    // coordinator IS the control plane.
     if (dead_procs.empty() || abort_rank == worker_first_rank_[0]) {
       reconfigurable = false;
     }
@@ -3342,6 +3557,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
     std::lock_guard<std::mutex> lock(err_mu_);
     last_error_rank_ = first_rank_;
     last_error_ = "hierarchical allreduce: host-group topology setup failed";
+    last_error_gen_ = generation_;
     return false;
   }
   Metrics& mx = Metrics::Get();
@@ -3369,6 +3585,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
       std::lock_guard<std::mutex> lock(err_mu_);
       last_error_rank_ = rank;
       last_error_ = err;
+      last_error_gen_ = generation_;
     }
     FlightRecorder::Get().Record("shm.fail", what, nbytes, peer, 0);
     return false;
@@ -3498,6 +3715,7 @@ bool ControlPlane::SmallAllreduce(const std::string& dtype, char* data,
     std::lock_guard<std::mutex> lock(err_mu_);
     last_error_rank_ = first_rank_;
     last_error_ = "small allreduce: host-group topology setup failed";
+    last_error_gen_ = generation_;
     return false;
   }
   const int elem = DtypeSize(dtype);

@@ -115,10 +115,30 @@ class ControlPlane {
   // failed.  Read by the Python executor to build its abort report —
   // possibly from a different thread than the one that failed, hence
   // err_mu_.
+  // Errors are stamped with the membership generation of the transfer
+  // that produced them; once a reconfigure moves the generation on, the
+  // stale attribution is hidden (rank -1) rather than reported — its
+  // rank numbers describe a membership that no longer exists, and
+  // re-reporting them under the new generation would evict whichever
+  // innocent process inherited the rank after the re-rank.
   void LastError(int32_t* rank, std::string* reason) const {
     std::lock_guard<std::mutex> lock(err_mu_);
+    if (last_error_gen_ != generation_) {
+      *rank = -1;
+      reason->clear();
+      return;
+    }
     *rank = last_error_rank_;
     *reason = last_error_;
+  }
+
+  // Names of the tensors the next data-plane transfers move (the fused
+  // response's tensor list), set by the executor before each collective
+  // so an integrity abort can name the payload it lost.  Rides err_mu_:
+  // written from the executor thread, read by the Xfer failure path.
+  void SetXferContext(const std::string& tensors) {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    xfer_context_ = tensors;
   }
 
   // Transport the ring-next hop rides: "uds" (co-located peer, on-host
@@ -237,10 +257,29 @@ class ControlPlane {
   bool AbortedFailFast();
   // DuplexTransfer wrapper that attributes a failure to the peer PROCESS
   // whose fd died (recorded in last_error_*).  send_peer / recv_peer are
-  // process indices; RingXfer delegates with the ring neighbours.
+  // process indices; RingXfer delegates with the ring neighbours.  With
+  // HOROVOD_TPU_INTEGRITY on, Xfer runs the checked protocol — payload
+  // with a fused CRC32C trailer per direction, then a direction-reversed
+  // verdict exchange — and retransmits corrupted directions up to
+  // HOROVOD_TPU_XFER_RETRIES times before failing like a torn socket;
+  // XferOnce is the raw single-shot transfer under it (send_tr / recv_tr
+  // forward the optional 4-byte trailers to the transport).
   bool Xfer(int send_fd, const char* send_buf, size_t send_len,
             int recv_fd, char* recv_buf, size_t recv_len,
             int send_peer, int recv_peer);
+  bool XferOnce(int send_fd, const char* send_buf, size_t send_len,
+                int recv_fd, char* recv_buf, size_t recv_len,
+                int send_peer, int recv_peer,
+                const char* send_tr = nullptr, char* recv_tr = nullptr);
+  // First global rank of the process at index `peer`, or -1.
+  int32_t PeerRank(int peer) const;
+  // Membership generation under err_mu_ — captured at transfer entry so
+  // a failure latched after a concurrent reconfigure is stamped with the
+  // generation it actually belongs to.
+  int32_t GenerationNow() const {
+    std::lock_guard<std::mutex> lock(err_mu_);
+    return generation_;
+  }
   bool RingXfer(int send_fd, const char* send_buf, size_t send_len,
                 int recv_fd, char* recv_buf, size_t recv_len);
 
@@ -342,15 +381,20 @@ class ControlPlane {
   // against first_rank_): 1 = crash, 2 = hang, 3 = drop_conn, 4 = rejoin
   // (coordinator-side: admit parked standbys at tick >= T), 5 = slow
   // (slow:rank=R:ms=M[:tick=T] — sleep M ms on EVERY tick from T on, the
-  // deterministic planted straggler the fleet-policy drills evict).
-  // Multiple semicolon-separated specs are allowed so elastic scenarios
-  // can script a kill and a later readmit in one env var.
+  // deterministic planted straggler the fleet-policy drills evict), 6 =
+  // corrupt (corrupt:rank=R:tick=T[:leg=classic|shm|uring|ctrl][:count=N]
+  // — arm N byte-flips on the named leg at tick T; each subsequent send
+  // on that leg flips one byte post-checksum, pre-send).  Multiple
+  // semicolon-separated specs are allowed so elastic scenarios can
+  // script a kill and a later readmit in one env var.
   struct FaultSpec {
     int mode = 0;
     int rank = -1;
     long long tick = -1;
     long long ms = 0;    // slow only: injected per-tick delay
     bool announced = false;   // slow only: stderr/flight once, first fire
+    int leg = 0;         // corrupt only: integrity.h Leg enum value
+    int count = 1;       // corrupt only: armed byte-flips
   };
   std::vector<FaultSpec> faults_;
   // Armed rejoin action (mode 4): fires on the coordinator once per arm,
@@ -367,6 +411,14 @@ class ControlPlane {
   std::string abort_reason_;
   int32_t last_error_rank_ = -1;
   std::string last_error_;
+  // Membership generation the latched error belongs to — captured at the
+  // ENTRY of the transfer that failed (a reconfigure can complete on the
+  // tick thread while the executor thread is still inside a doomed
+  // transfer of the old world).  LastError() hides mismatched entries.
+  int32_t last_error_gen_ = 0;
+  // Tensor names of the in-flight collective (SetXferContext), empty
+  // between collectives; under err_mu_.
+  std::string xfer_context_;
 
   // Coordinator: connection fd per worker process (index 1..n-1), ordered
   // by process index; worker: single fd to the coordinator.  Carries

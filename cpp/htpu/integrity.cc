@@ -1,0 +1,193 @@
+#include "htpu/integrity.h"
+
+#include <atomic>
+#include <cstdlib>
+
+#include "htpu/metrics.h"
+
+namespace htpu {
+
+namespace {
+
+// ---------------------------------------------------------------- software
+// Table-driven CRC32C: reflected Castagnoli polynomial 0x82F63B78, the
+// same bit order the SSE4.2 instruction uses, so both paths produce
+// identical digests.  Table built once, lazily, under C++11 static-init
+// locking.
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      }
+      t[i] = c;
+    }
+  }
+};
+
+const uint32_t* Table() {
+  static const Crc32cTable table;
+  return table.t;
+}
+
+// ---------------------------------------------------------------- hardware
+#if (defined(__x86_64__) || defined(__i386__)) && \
+    (defined(__GNUC__) || defined(__clang__))
+#define HTPU_CRC32C_HW 1
+
+__attribute__((target("sse4.2")))
+uint32_t Crc32cHw(uint32_t crc, const void* data, size_t len) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  crc = ~crc;
+#if defined(__x86_64__)
+  uint64_t c64 = crc;
+  while (len >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    c64 = __builtin_ia32_crc32di(c64, v);
+    p += 8;
+    len -= 8;
+  }
+  crc = uint32_t(c64);
+#endif
+  while (len >= 4) {
+    uint32_t v;
+    __builtin_memcpy(&v, p, 4);
+    crc = __builtin_ia32_crc32si(crc, v);
+    p += 4;
+    len -= 4;
+  }
+  while (len--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return ~crc;
+}
+
+bool DetectHw() { return __builtin_cpu_supports("sse4.2") != 0; }
+#else
+#define HTPU_CRC32C_HW 0
+bool DetectHw() { return false; }
+#endif
+
+bool HwSelected() {
+  static const bool hw = DetectHw();
+  return hw;
+}
+
+// ------------------------------------------------------------------ knobs
+
+bool EnvFlag(const char* name, bool dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  return !(v[0] == '0' || v[0] == 'f' || v[0] == 'F' || v[0] == 'n' ||
+           v[0] == 'N');
+}
+
+// --------------------------------------------------------- chaos registry
+// One armed-flip budget per leg; sends ConsumeCorrupt with a CAS loop so
+// concurrent producer threads never double-spend the last flip.
+std::atomic<int> g_armed[4] = {{0}, {0}, {0}, {0}};
+
+}  // namespace
+
+uint32_t Crc32cSoftware(uint32_t crc, const void* data, size_t len) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  const uint32_t* t = Table();
+  crc = ~crc;
+  while (len--) crc = t[(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t Crc32cExtend(uint32_t crc, const void* data, size_t len) {
+#if HTPU_CRC32C_HW
+  if (HwSelected()) return Crc32cHw(crc, data, len);
+#endif
+  return Crc32cSoftware(crc, data, len);
+}
+
+uint32_t Crc32c(const void* data, size_t len) {
+  return Crc32cExtend(0, data, len);
+}
+
+bool Crc32cHardware() { return HwSelected(); }
+
+bool IntegrityEnabled() {
+  static const bool on = EnvFlag("HOROVOD_TPU_INTEGRITY", false);
+  return on;
+}
+
+int XferRetries() {
+  static const int retries = [] {
+    const char* v = getenv("HOROVOD_TPU_XFER_RETRIES");
+    if (!v || !*v) return 2;
+    int n = atoi(v);
+    return n < 0 ? 0 : n;
+  }();
+  return retries;
+}
+
+const char* LegName(Leg leg) {
+  switch (leg) {
+    case Leg::kClassic: return "classic";
+    case Leg::kShm: return "shm";
+    case Leg::kUring: return "uring";
+    case Leg::kCtrl: return "ctrl";
+  }
+  return "?";
+}
+
+void CountCrcError(Leg leg) {
+  // Name prefix + leg value, matching the per-label counter convention
+  // (ring.allreduce.bytes_sent#wire=...): one counter per leg, resolved
+  // once and cached in the static array.
+  static std::atomic<long long>* c[4] = {
+      Metrics::Get().Counter("integrity.crc_errors#leg=" +
+                             std::string(LegName(Leg::kClassic))),
+      Metrics::Get().Counter("integrity.crc_errors#leg=" +
+                             std::string(LegName(Leg::kShm))),
+      Metrics::Get().Counter("integrity.crc_errors#leg=" +
+                             std::string(LegName(Leg::kUring))),
+      Metrics::Get().Counter("integrity.crc_errors#leg=" +
+                             std::string(LegName(Leg::kCtrl)))};
+  c[int(leg)]->fetch_add(1, std::memory_order_relaxed);
+}
+
+void CountRetransmit(Leg leg) {
+  static std::atomic<long long>* c[4] = {
+      Metrics::Get().Counter("integrity.retransmits#leg=" +
+                             std::string(LegName(Leg::kClassic))),
+      Metrics::Get().Counter("integrity.retransmits#leg=" +
+                             std::string(LegName(Leg::kShm))),
+      Metrics::Get().Counter("integrity.retransmits#leg=" +
+                             std::string(LegName(Leg::kUring))),
+      Metrics::Get().Counter("integrity.retransmits#leg=" +
+                             std::string(LegName(Leg::kCtrl)))};
+  c[int(leg)]->fetch_add(1, std::memory_order_relaxed);
+}
+
+void CountBytesChecked(size_t nbytes) {
+  static std::atomic<long long>* c =
+      Metrics::Get().Counter("integrity.bytes_checked");
+  c->fetch_add(static_cast<long long>(nbytes), std::memory_order_relaxed);
+}
+
+void ArmCorrupt(Leg leg, int count) {
+  g_armed[int(leg)].fetch_add(count, std::memory_order_relaxed);
+}
+
+bool ConsumeCorrupt(Leg leg) {
+  std::atomic<int>& a = g_armed[int(leg)];
+  int cur = a.load(std::memory_order_relaxed);
+  while (cur > 0) {
+    if (a.compare_exchange_weak(cur, cur - 1, std::memory_order_relaxed)) {
+      return true;
+    }
+  }
+  return false;
+}
+
+int ArmedCorrupt(Leg leg) {
+  return g_armed[int(leg)].load(std::memory_order_relaxed);
+}
+
+}  // namespace htpu

@@ -1,0 +1,78 @@
+// End-to-end integrity layer for the data plane: CRC32C (Castagnoli)
+// with an SSE4.2 hardware path and a table-driven software fallback,
+// runtime-dispatched, plus the process-wide state the checksum layer
+// shares across transports — the retransmit budget, the per-leg
+// integrity counters, and the corruption-chaos arm/consume registry
+// that HOROVOD_TPU_FAULT's `corrupt:` action drives.
+//
+// CRC32C (not the zlib/IEEE CRC32) because the Castagnoli polynomial is
+// what the SSE4.2 `crc32` instruction computes — the hardware path runs
+// at memory bandwidth, which is what makes a checksum on every frame,
+// shm chunk and uring slab affordable.  The software table and the
+// Python mirror (horovod_tpu/wire.py crc32c) are bit-parity tested
+// against it.
+#ifndef HTPU_INTEGRITY_H_
+#define HTPU_INTEGRITY_H_
+
+#include <cstddef>
+#include <cstdint>
+
+namespace htpu {
+
+// One-shot CRC32C over [data, data+len).  Uses the SSE4.2 instruction
+// when the CPU has it, the software table otherwise.
+uint32_t Crc32c(const void* data, size_t len);
+
+// Incremental form: feed chunks with crc carried between calls, seeded
+// with 0.  Crc32c(p, n) == Crc32cExtend(0, p, n).
+uint32_t Crc32cExtend(uint32_t crc, const void* data, size_t len);
+
+// The table-driven path, always taken regardless of CPU support —
+// exposed so the parity test can pin hardware == software on the same
+// inputs.
+uint32_t Crc32cSoftware(uint32_t crc, const void* data, size_t len);
+
+// True when the dispatcher selected the SSE4.2 path on this CPU.
+bool Crc32cHardware();
+
+// HOROVOD_TPU_INTEGRITY=1 turns the checksum + retransmit layer on for
+// every leg (classic sockets, shm rings, uring duplexes, control
+// frames).  Default off: legacy frames stay byte-identical.  Read once.
+bool IntegrityEnabled();
+
+// HOROVOD_TPU_XFER_RETRIES: retransmit budget per transfer after a CRC
+// mismatch (default 2).  Read once.
+int XferRetries();
+
+// ------------------------------------------------------------------ legs
+
+enum class Leg { kClassic = 0, kShm = 1, kUring = 2, kCtrl = 3 };
+
+// "classic" | "shm" | "uring" | "ctrl" — the spelling the fault grammar
+// (corrupt:...:leg=) and the #leg= metric tags share.
+const char* LegName(Leg leg);
+
+// Per-leg integrity counters (integrity.crc_errors#leg=...,
+// integrity.retransmits#leg=..., integrity.bytes_checked).
+void CountCrcError(Leg leg);
+void CountRetransmit(Leg leg);
+void CountBytesChecked(size_t nbytes);
+
+// ------------------------------------- corruption-chaos arm/consume
+
+// Arm `count` byte-flips on `leg` for this process: each following send
+// on that leg consumes one flip (post-checksum, pre-send) until the
+// count runs dry.  Called by the fault engine when a
+// corrupt:rank=R:tick=T[:leg=L][:count=N] spec fires.
+void ArmCorrupt(Leg leg, int count);
+
+// True when a send on `leg` should flip a byte now (consumes one armed
+// flip).  Thread-safe: concurrent sends never double-spend a flip.
+bool ConsumeCorrupt(Leg leg);
+
+// Armed flips left on `leg` (test/diagnostic visibility).
+int ArmedCorrupt(Leg leg);
+
+}  // namespace htpu
+
+#endif  // HTPU_INTEGRITY_H_

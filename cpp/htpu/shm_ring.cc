@@ -14,6 +14,9 @@
 #include <cstring>
 #include <ctime>
 
+#include "htpu/flight_recorder.h"
+#include "htpu/integrity.h"
+
 namespace htpu {
 
 namespace {
@@ -54,6 +57,84 @@ uint32_t* FutexWordOf(const std::atomic<uint64_t>* v) {
   // get near 2^32, so the low word changes on every publish.
   return reinterpret_cast<uint32_t*>(
       const_cast<std::atomic<uint64_t>*>(v));
+}
+
+// Integrity plane (HOROVOD_TPU_INTEGRITY=1): the remaining bytes of each
+// counter line carry the checked-transfer state, so the layout — and
+// therefore integrity-off segments — is unchanged (the words simply stay
+// zero).  A CONSUMER-owned line (ack[m] / rack[m]) holds a NACK word at
+// offset 16: chunk index + 1 of a sub-slot whose CRC failed, 0 = none
+// (consumers process chunks serially, so one outstanding NACK suffices).
+// A PRODUCER-owned line (ready[m] / result ready) holds one CRC32C per
+// in-flight sub-slot at offset 24, written before the counter publish so
+// the consumer's acquire covers both bytes and checksum.
+std::atomic<uint64_t>* NackOf(const std::atomic<uint64_t>* v) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(
+      reinterpret_cast<char*>(const_cast<std::atomic<uint64_t>*>(v)) + 16);
+}
+
+std::atomic<uint32_t>* CrcOf(const std::atomic<uint64_t>* v, int sub) {
+  return reinterpret_cast<std::atomic<uint32_t>*>(
+      reinterpret_cast<char*>(const_cast<std::atomic<uint64_t>*>(v)) + 24 +
+      4 * size_t(sub));
+}
+
+static_assert(24 + 4 * size_t(ShmRing::kDepth) <= kLine,
+              "per-sub-slot CRCs must fit the counter line");
+
+// Copy one chunk into its sub-slot.  The CRC is computed over the SOURCE
+// bytes and a chaos-engine flip lands in the slot afterwards, so a
+// planted corruption is detected exactly like real memory corruption —
+// and a republish from the same pristine source heals it.
+void FillSlot(std::atomic<uint64_t>* ctr, char* slot, const char* src,
+              size_t len, uint64_t i, bool integrity) {
+  std::memcpy(slot, src, len);
+  if (integrity) {
+    if (len > 0 && ConsumeCorrupt(Leg::kShm)) {
+      slot[len / 2] = char(slot[len / 2] ^ 0x5A);
+      FlightRecorder::Get().Record("fault.corrupt", LegName(Leg::kShm),
+                                   int64_t(len), int(i));
+    }
+    CrcOf(ctr, int(i % ShmRing::kDepth))
+        ->store(Crc32c(src, len), std::memory_order_relaxed);
+  }
+}
+
+// Consumer side of the checked transfer: verify chunk i of the producer
+// line `ctr` in `slot`; on mismatch publish a NACK in the consumer-owned
+// word and wait for the producer to republish (it clears the word), up
+// to HOROVOD_TPU_XFER_RETRIES rounds.  False when the corruption
+// persists or the producer stops servicing — the caller fails exactly
+// like a lagging-peer timeout.
+bool VerifyChunk(const std::atomic<uint64_t>* ctr,
+                 std::atomic<uint64_t>* nack, const char* slot, size_t len,
+                 uint64_t i, int timeout_ms) {
+  CountBytesChecked(len);
+  if (Crc32c(slot, len) ==
+      CrcOf(ctr, int(i % ShmRing::kDepth))->load(std::memory_order_relaxed))
+    return true;
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+  const int retries = XferRetries();
+  for (int r = 0;; ++r) {
+    CountCrcError(Leg::kShm);
+    FlightRecorder::Get().Record("CRC_FAIL", "shm chunk checksum mismatch",
+                                 int64_t(len), int(i), r);
+    if (r >= retries) return false;
+    nack->store(i + 1, std::memory_order_seq_cst);
+    // Republishes are rare (one per planted/real corruption), so a plain
+    // short-sleep poll beats wiring another futex word into the line.
+    while (nack->load(std::memory_order_seq_cst) != 0) {
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+      struct timespec ts{0, 200 * 1000};  // 200us
+      nanosleep(&ts, nullptr);
+    }
+    CountBytesChecked(len);
+    if (Crc32c(slot, len) ==
+        CrcOf(ctr, int(i % ShmRing::kDepth))
+            ->load(std::memory_order_relaxed))
+      return true;
+  }
 }
 
 // Publish a new counter value and wake any parked waiter.  seq_cst pairs
@@ -267,30 +348,69 @@ void ShmRing::Unlink() {
 bool ShmRing::MemberPush(const char* data, size_t nbytes, int timeout_ms) {
   std::atomic<uint64_t>* ready = ReadyOf(member_pos_);
   std::atomic<uint64_t>* ack = AckOf(member_pos_);
+  const bool integrity = IntegrityEnabled();
+  const uint64_t base = pushed_;
+  // Producer half of the checked transfer: rewrite a NACKed chunk from
+  // the caller's pristine buffer, restore its CRC, clear the word.  The
+  // seq_cst clear pairs with the consumer's seq_cst poll, so the rewrite
+  // happens-before the re-verify.
+  auto service_nack = [&]() {
+    const uint64_t n = NackOf(ack)->load(std::memory_order_seq_cst);
+    if (n == 0) return;
+    const uint64_t idx = n - 1;
+    const size_t off = size_t(idx - base) * slot_bytes_;
+    FillSlot(ready, SlotData(member_pos_, int(idx % kDepth)), data + off,
+             std::min(slot_bytes_, nbytes - off), idx, true);
+    CountRetransmit(Leg::kShm);
+    NackOf(ack)->store(0, std::memory_order_seq_cst);
+  };
+  // With integrity on, waits are sliced so a NACK arriving while this
+  // producer is parked (leader refuses to ack the bad chunk, producer
+  // waits on that very ack word) is serviced instead of deadlocking.
+  auto wait_ack = [&](uint64_t target) {
+    if (!integrity) return WaitGe(ack, target, timeout_ms);
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      if (WaitGe(ack, target, 5)) return true;
+      service_nack();
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+    }
+  };
   for (size_t off = 0; off < nbytes; off += slot_bytes_) {
     const size_t len = std::min(slot_bytes_, nbytes - off);
     const uint64_t i = pushed_;
     // Sub-slot i % kDepth is reusable once the leader consumed chunk
     // i - kDepth.
-    if (i >= uint64_t(kDepth) &&
-        !WaitGe(ack, i - kDepth + 1, timeout_ms)) {
+    if (i >= uint64_t(kDepth) && !wait_ack(i - kDepth + 1)) {
       return false;
     }
-    std::memcpy(SlotData(member_pos_, int(i % kDepth)), data + off, len);
+    FillSlot(ready, SlotData(member_pos_, int(i % kDepth)), data + off, len,
+             i, integrity);
     Publish(ready, i + 1);
     ++pushed_;
   }
+  // Drain barrier (integrity only): a NACKed chunk can only be rewritten
+  // from `data`, which dies with this call frame — stay until the leader
+  // consumed every chunk.
+  if (integrity && !wait_ack(pushed_)) return false;
   return true;
 }
 
 bool ShmRing::MemberPull(char* data, size_t nbytes, int timeout_ms) {
   std::atomic<uint64_t>* ready = ResultReady();
   std::atomic<uint64_t>* rack = ResultAckOf(member_pos_);
+  const bool integrity = IntegrityEnabled();
   for (size_t off = 0; off < nbytes; off += slot_bytes_) {
     const size_t len = std::min(slot_bytes_, nbytes - off);
     const uint64_t i = pulled_;
     if (!WaitGe(ready, i + 1, timeout_ms)) return false;
-    std::memcpy(data + off, ResultData(int(i % kDepth)), len);
+    const char* slot = ResultData(int(i % kDepth));
+    if (integrity &&
+        !VerifyChunk(ready, NackOf(rack), slot, len, i, timeout_ms)) {
+      return false;
+    }
+    std::memcpy(data + off, slot, len);
     Publish(rack, i + 1);
     ++pulled_;
   }
@@ -302,6 +422,7 @@ bool ShmRing::LeaderReduce(size_t nbytes,
                                                     size_t)>& reduce,
                            int timeout_ms, int* lagging_member) {
   if (lagging_member) *lagging_member = -1;
+  const bool integrity = IntegrityEnabled();
   for (size_t off = 0; off < nbytes; off += slot_bytes_) {
     const size_t len = std::min(slot_bytes_, nbytes - off);
     const uint64_t i = reduced_;
@@ -310,7 +431,15 @@ bool ShmRing::LeaderReduce(size_t nbytes,
         if (lagging_member) *lagging_member = m;
         return false;
       }
-      if (!reduce(m, SlotData(m, int(i % kDepth)), off, len)) {
+      const char* slot = SlotData(m, int(i % kDepth));
+      // Verify BEFORE SumInto: a corrupted chunk must never reach the
+      // accumulator, and the member republishes into the same slot.
+      if (integrity && !VerifyChunk(ReadyOf(m), NackOf(AckOf(m)), slot,
+                                    len, i, timeout_ms)) {
+        if (lagging_member) *lagging_member = m;
+        return false;
+      }
+      if (!reduce(m, slot, off, len)) {
         if (lagging_member) *lagging_member = -2;
         return false;
       }
@@ -325,6 +454,35 @@ bool ShmRing::LeaderBroadcast(const char* data, size_t nbytes,
                               int timeout_ms, int* lagging_member) {
   if (lagging_member) *lagging_member = -1;
   std::atomic<uint64_t>* ready = ResultReady();
+  const bool integrity = IntegrityEnabled();
+  const uint64_t base = bcast_;
+  // Producer half of the checked transfer, fanned out: any member may
+  // NACK a result chunk via its own rack line; the rewrite from the
+  // pristine source is idempotent, so concurrent NACKs of the same chunk
+  // just republish twice.
+  auto service_nacks = [&]() {
+    for (int m = 0; m < nmembers_; ++m) {
+      std::atomic<uint64_t>* nack = NackOf(ResultAckOf(m));
+      const uint64_t n = nack->load(std::memory_order_seq_cst);
+      if (n == 0) continue;
+      const uint64_t idx = n - 1;
+      const size_t off = size_t(idx - base) * slot_bytes_;
+      FillSlot(ready, ResultData(int(idx % kDepth)), data + off,
+               std::min(slot_bytes_, nbytes - off), idx, true);
+      CountRetransmit(Leg::kShm);
+      nack->store(0, std::memory_order_seq_cst);
+    }
+  };
+  auto wait_rack = [&](int m, uint64_t target) {
+    if (!integrity) return WaitGe(ResultAckOf(m), target, timeout_ms);
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      if (WaitGe(ResultAckOf(m), target, 5)) return true;
+      service_nacks();
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+    }
+  };
   for (size_t off = 0; off < nbytes; off += slot_bytes_) {
     const size_t len = std::min(slot_bytes_, nbytes - off);
     const uint64_t i = bcast_;
@@ -332,15 +490,26 @@ bool ShmRing::LeaderBroadcast(const char* data, size_t nbytes,
       // The result sub-slot is reusable once EVERY member consumed
       // chunk i - kDepth.
       for (int m = 0; m < nmembers_; ++m) {
-        if (!WaitGe(ResultAckOf(m), i - kDepth + 1, timeout_ms)) {
+        if (!wait_rack(m, i - kDepth + 1)) {
           if (lagging_member) *lagging_member = m;
           return false;
         }
       }
     }
-    std::memcpy(ResultData(int(i % kDepth)), data + off, len);
+    FillSlot(ready, ResultData(int(i % kDepth)), data + off, len, i,
+             integrity);
     Publish(ready, i + 1);
     ++bcast_;
+  }
+  // Drain barrier (integrity only): stay until every member consumed
+  // every result chunk, servicing republish requests on the way out.
+  if (integrity) {
+    for (int m = 0; m < nmembers_; ++m) {
+      if (!wait_rack(m, bcast_)) {
+        if (lagging_member) *lagging_member = m;
+        return false;
+      }
+    }
   }
   return true;
 }

@@ -31,6 +31,7 @@
 
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
+#include "htpu/integrity.h"
 #include "htpu/metrics.h"
 #include "htpu/policy.h"
 #include "htpu/process_set.h"
@@ -1046,9 +1047,187 @@ int RunTransportPhase() {
   return 0;
 }
 
+// Integrity phase (run in a forked child: HOROVOD_TPU_INTEGRITY is
+// latched on first use, so it must be set before ANY checksum code runs
+// in the process, and must not leak into the other phases, whose
+// legacy-byte-identity expectations assume it off).
+//
+//  (a) CRC32C pins: the published Castagnoli vector, hardware ==
+//      software on a pseudo-random buffer, incremental == one-shot;
+//  (b) the shm fan-in/fan-out ring streamed with a CRC verify on every
+//      chunk, concurrently (leader + two member threads), across two
+//      generations — TSan proves the CRC lines and NACK words race-free
+//      against live seqlock publishes;
+//  (c) a planted corruption round: one armed byte-flip on the shm leg
+//      must be detected by a consumer, NACKed, rewritten from pristine
+//      source and re-verified — exact sums after the retransmit, and
+//      the integrity counters must own up to exactly what happened.
+int RunIntegrityPhase() {
+  setenv("HOROVOD_TPU_INTEGRITY", "1", 1);
+
+  // --- (a) CRC32C parity pins.
+  if (htpu::Crc32c("123456789", 9) != 0xE3069283u) {
+    fprintf(stderr, "smoke: CRC32C check vector mismatch\n");
+    return 1;
+  }
+  {
+    std::vector<unsigned char> buf(1 << 16);
+    uint32_t x = 0x12345678u;
+    for (auto& b : buf) {
+      x = x * 1664525u + 1013904223u;
+      b = static_cast<unsigned char>(x >> 24);
+    }
+    const uint32_t sw = htpu::Crc32cSoftware(0, buf.data(), buf.size());
+    if (htpu::Crc32c(buf.data(), buf.size()) != sw) {
+      fprintf(stderr, "smoke: CRC32C hw/sw parity mismatch (hw=%d)\n",
+              int(htpu::Crc32cHardware()));
+      return 1;
+    }
+    uint32_t inc = htpu::Crc32cExtend(0, buf.data(), 999);
+    inc = htpu::Crc32cExtend(inc, buf.data() + 999, buf.size() - 999);
+    if (inc != sw) {
+      fprintf(stderr, "smoke: CRC32C incremental mismatch\n");
+      return 1;
+    }
+  }
+  if (!htpu::IntegrityEnabled()) {
+    fprintf(stderr, "smoke: HOROVOD_TPU_INTEGRITY=1 did not latch\n");
+    return 1;
+  }
+
+  // --- (b)+(c) shm ring under checksum: gen 0 and 1 stream clean, gen 2
+  // runs with one armed byte-flip that must be retransmitted away.
+  constexpr size_t kSlot = 4096;
+  constexpr size_t kElems = (3 * kSlot + 512) / sizeof(float);
+  constexpr size_t kBytes = kElems * sizeof(float);
+  for (int gen = 0; gen < 3; ++gen) {
+    if (gen == 2) htpu::ArmCorrupt(htpu::Leg::kShm, 1);
+    const std::string name = "/htpu_smokei_" + std::to_string(getpid()) +
+                             "_" + std::to_string(gen);
+    std::string err;
+    auto leader = htpu::ShmRing::CreateLeader(name, 2, kSlot, &err);
+    if (!leader) {
+      fprintf(stderr, "smoke: integrity CreateLeader: %s\n", err.c_str());
+      return 1;
+    }
+    std::unique_ptr<htpu::ShmRing> members[2];
+    for (int m = 0; m < 2; ++m) {
+      members[m] = htpu::ShmRing::OpenMember(name, 2, kSlot, m, &err);
+      if (!members[m]) {
+        fprintf(stderr, "smoke: integrity OpenMember %d: %s\n", m,
+                err.c_str());
+        return 1;
+      }
+    }
+    leader->Unlink();
+    std::atomic<bool> bad{false};
+    std::thread movers[2];
+    for (int m = 0; m < 2; ++m) {
+      movers[m] = std::thread([&, m] {
+        for (int round = 0; round < 2; ++round) {
+          std::vector<float> mine(kElems, float(m + 1) * (round + 1));
+          if (!members[m]->MemberPush(
+                  reinterpret_cast<const char*>(mine.data()), kBytes,
+                  10000)) {
+            bad.store(true);
+            return;
+          }
+          std::vector<float> out(kElems, 0.0f);
+          if (!members[m]->MemberPull(reinterpret_cast<char*>(out.data()),
+                                      kBytes, 10000)) {
+            bad.store(true);
+            return;
+          }
+          const float want = 0.5f + 3.0f * (round + 1);
+          for (float v : out) {
+            if (v != want) {
+              bad.store(true);
+              return;
+            }
+          }
+        }
+      });
+    }
+    for (int round = 0; round < 2; ++round) {
+      std::vector<float> acc(kElems, 0.5f);
+      int lag = -1;
+      const bool red = leader->LeaderReduce(
+          kBytes,
+          [&](int, const char* src, size_t off, size_t len) {
+            const float* s = reinterpret_cast<const float*>(src);
+            float* d = acc.data() + off / sizeof(float);
+            for (size_t i = 0; i < len / sizeof(float); ++i) d[i] += s[i];
+            return true;
+          },
+          10000, &lag);
+      if (!red ||
+          !leader->LeaderBroadcast(reinterpret_cast<const char*>(acc.data()),
+                                   kBytes, 10000, &lag)) {
+        fprintf(stderr, "smoke: integrity shm leader round %d failed "
+                "(lag=%d)\n", round, lag);
+        bad.store(true);
+        break;
+      }
+    }
+    movers[0].join();
+    movers[1].join();
+    if (bad.load()) {
+      fprintf(stderr, "smoke: integrity shm gen %d produced wrong sums\n",
+              gen);
+      return 1;
+    }
+    if (gen == 2 && htpu::ArmedCorrupt(htpu::Leg::kShm) != 0) {
+      fprintf(stderr, "smoke: planted corruption never fired\n");
+      return 1;
+    }
+  }
+
+  // --- counters: every chunk was checked, and the planted flip shows up
+  // as exactly-detected (>= 1 error, >= 1 retransmit on the shm leg).
+  {
+    void* buf = nullptr;
+    int len = htpu_metrics_snapshot(&buf);
+    if (len <= 0 || !buf) return 1;
+    std::string js(static_cast<const char*>(buf), size_t(len));
+    htpu_free(buf);
+    for (const char* key : {"\"integrity.bytes_checked\":",
+                            "\"integrity.crc_errors#leg=shm\":",
+                            "\"integrity.retransmits#leg=shm\":"}) {
+      size_t at = js.find(key);
+      if (at == std::string::npos ||
+          atoll(js.c_str() + at + strlen(key)) < 1) {
+        fprintf(stderr, "smoke: integrity counter %s missing or zero\n", key);
+        return 1;
+      }
+    }
+  }
+  fprintf(stderr,
+          "smoke: integrity OK (crc parity, shm x3 gens, 1 flip healed)\n");
+  return 0;
+}
+
 }  // namespace
 
 int main() {
+  // Integrity phase FIRST, in a forked child: IntegrityEnabled() is
+  // latched on first use anywhere in the process, so the child must set
+  // HOROVOD_TPU_INTEGRITY=1 before any other phase touches checksum
+  // code — and the flag must not leak into the rounds below, whose
+  // frames are expected byte-identical to the legacy wire format.
+  {
+    pid_t ipid = fork();
+    if (ipid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (ipid == 0) _exit(RunIntegrityPhase());
+    int st = 0;
+    waitpid(ipid, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr, "smoke: integrity phase failed (status %d)\n", st);
+      return 1;
+    }
+  }
   if (RunOverlapPlannerPhase() != 0) return 1;
   if (RunFleetPolicyPhase() != 0) return 1;
   if (RunProcessSetPhase() != 0) return 1;

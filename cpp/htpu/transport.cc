@@ -17,6 +17,7 @@
 #include <thread>
 
 #include "htpu/flight_recorder.h"
+#include "htpu/integrity.h"
 #include "htpu/metrics.h"
 
 namespace htpu {
@@ -199,14 +200,38 @@ bool SendFrame(int fd, const std::string& payload) {
   uint32_t len = uint32_t(payload.size());
   char hdr[4];
   for (int i = 0; i < 4; ++i) hdr[i] = char((len >> (8 * i)) & 0xff);
-  // Header + payload leave in one gathered sendmsg: a control frame costs
-  // a single syscall (and, under TCP_NODELAY, a single segment) instead of
-  // the old header-then-payload pair.  Partial writes resume from `done`
-  // across both iovecs.
-  const size_t total = 4 + payload.size();
+  // Integrity trailer: CRC32C of the payload rides after it (the length
+  // header still counts payload bytes only; both ends key the extra 4
+  // bytes off the same HOROVOD_TPU_INTEGRITY knob).  Computed BEFORE the
+  // chaos engine gets a chance to flip a byte, so a planted corruption is
+  // guaranteed to disagree with the trailer — exactly like a real flip
+  // between the sender's buffer and the receiver's.
+  const bool crc_on = IntegrityEnabled();
+  char trailer[4];
+  const std::string* body = &payload;
+  std::string corrupted;
+  if (crc_on) {
+    const uint32_t crc = Crc32c(payload.data(), payload.size());
+    for (int i = 0; i < 4; ++i) trailer[i] = char((crc >> (8 * i)) & 0xff);
+    if (!payload.empty() && ConsumeCorrupt(Leg::kCtrl)) {
+      corrupted = payload;
+      corrupted[corrupted.size() / 2] =
+          char(corrupted[corrupted.size() / 2] ^ 0x5A);
+      body = &corrupted;
+      FlightRecorder::Get().Record("fault.corrupt",
+                                   "flipped a byte on the ctrl leg",
+                                   int64_t(payload.size()), fd, 0);
+    }
+  }
+  // Header + payload (+ trailer) leave in one gathered sendmsg: a control
+  // frame costs a single syscall (and, under TCP_NODELAY, a single
+  // segment) instead of the old header-then-payload pair.  Partial writes
+  // resume from `done` across all iovecs.
+  const size_t body_end = 4 + body->size();
+  const size_t total = body_end + (crc_on ? 4 : 0);
   size_t done = 0;
   while (done < total) {
-    struct iovec iov[2];
+    struct iovec iov[3];
     int niov = 0;
     if (done < 4) {
       iov[niov].iov_base = hdr + done;
@@ -214,9 +239,15 @@ bool SendFrame(int fd, const std::string& payload) {
       ++niov;
     }
     const size_t poff = done < 4 ? 0 : done - 4;
-    if (poff < payload.size()) {
-      iov[niov].iov_base = const_cast<char*>(payload.data()) + poff;
-      iov[niov].iov_len = payload.size() - poff;
+    if (poff < body->size()) {
+      iov[niov].iov_base = const_cast<char*>(body->data()) + poff;
+      iov[niov].iov_len = body->size() - poff;
+      ++niov;
+    }
+    if (crc_on) {
+      const size_t toff = done < body_end ? 0 : done - body_end;
+      iov[niov].iov_base = trailer + toff;
+      iov[niov].iov_len = 4 - toff;
       ++niov;
     }
     struct msghdr msg;
@@ -283,6 +314,26 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
                                  int64_t(len), fd, errno);
     return false;
   }
+  if (IntegrityEnabled()) {
+    uint8_t tr[4];
+    if (!RecvAll(fd, tr, 4, timeout_ms)) {
+      FlightRecorder::Get().Record("frame.recv_fail", "truncated trailer",
+                                   int64_t(len), fd, errno);
+      return false;
+    }
+    uint32_t wire_crc = 0;
+    for (int i = 0; i < 4; ++i) wire_crc |= uint32_t(tr[i]) << (8 * i);
+    CountBytesChecked(len);
+    if (wire_crc != Crc32c(payload->data(), payload->size())) {
+      // Frames carry whole control messages; a mismatch is handled like a
+      // torn frame (no frame-level retransmit) so the corruption surfaces
+      // through the existing attributed-abort / reconfigure paths.
+      CountCrcError(Leg::kCtrl);
+      FlightRecorder::Get().Record("CRC_FAIL", "control frame checksum "
+                                   "mismatch", int64_t(len), fd, 0);
+      return false;
+    }
+  }
   static std::atomic<long long>* frames =
       Metrics::Get().Counter("transport.frames_recv");
   static std::atomic<long long>* bytes =
@@ -295,9 +346,12 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
 
 bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
                     int recv_fd, char* recv_buf, size_t recv_len,
-                    int timeout_ms, int* failed_fd) {
+                    int timeout_ms, int* failed_fd, const char* send_tr,
+                    char* recv_tr) {
   constexpr size_t kSliceBytes = 1 << 20;
   if (failed_fd) *failed_fd = -1;
+  const size_t total_send = send_len + (send_tr ? kTrailerBytes : 0);
+  const size_t total_recv = recv_len + (recv_tr ? kTrailerBytes : 0);
   size_t sent = 0, rcvd = 0;
   // Count whatever actually moved on every exit path (success, timeout,
   // peer death) — a torn transfer's bytes still crossed the wire.
@@ -315,16 +369,16 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
   } byte_guard{sent, rcvd};
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
-  while (sent < send_len || rcvd < recv_len) {
+  while (sent < total_send || rcvd < total_recv) {
     struct pollfd fds[2];
     int nfds = 0, send_slot = -1, recv_slot = -1;
-    if (sent < send_len) {
+    if (sent < total_send) {
       fds[nfds].fd = send_fd;
       fds[nfds].events = POLLOUT;
       fds[nfds].revents = 0;
       send_slot = nfds++;
     }
-    if (rcvd < recv_len) {
+    if (rcvd < total_recv) {
       fds[nfds].fd = recv_fd;
       fds[nfds].events = POLLIN;
       fds[nfds].revents = 0;
@@ -355,15 +409,22 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
     // instead of failing the step the moment the kernel knew.
     if (send_slot >= 0 &&
         (fds[send_slot].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      size_t want = send_len - sent;
-      if (want > kSliceBytes) want = kSliceBytes;
-      ssize_t n = send(send_fd, send_buf + sent, want,
-                       MSG_NOSIGNAL | MSG_DONTWAIT);
+      const char* sp;
+      size_t want;
+      if (sent < send_len) {
+        sp = send_buf + sent;
+        want = send_len - sent;
+        if (want > kSliceBytes) want = kSliceBytes;
+      } else {
+        sp = send_tr + (sent - send_len);
+        want = total_send - sent;
+      }
+      ssize_t n = send(send_fd, sp, want, MSG_NOSIGNAL | MSG_DONTWAIT);
       if (n < 0) {
         if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
           if (failed_fd) *failed_fd = send_fd;
           FlightRecorder::Get().Record("duplex.send_fail", "",
-                                       int64_t(send_len - sent), send_fd,
+                                       int64_t(total_send - sent), send_fd,
                                        errno);
           return false;
         }
@@ -373,20 +434,28 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
     }
     if (recv_slot >= 0 &&
         (fds[recv_slot].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t n =
-          recv(recv_fd, recv_buf + rcvd, recv_len - rcvd, MSG_DONTWAIT);
+      char* rp;
+      size_t want;
+      if (rcvd < recv_len) {
+        rp = recv_buf + rcvd;
+        want = recv_len - rcvd;
+      } else {
+        rp = recv_tr + (rcvd - recv_len);
+        want = total_recv - rcvd;
+      }
+      ssize_t n = recv(recv_fd, rp, want, MSG_DONTWAIT);
       if (n < 0) {
         if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK) {
           if (failed_fd) *failed_fd = recv_fd;
           FlightRecorder::Get().Record("duplex.recv_fail", "",
-                                       int64_t(recv_len - rcvd), recv_fd,
+                                       int64_t(total_recv - rcvd), recv_fd,
                                        errno);
           return false;
         }
       } else if (n == 0) {
         if (failed_fd) *failed_fd = recv_fd;
         FlightRecorder::Get().Record("duplex.recv_fail", "peer closed",
-                                     int64_t(recv_len - rcvd), recv_fd, 0);
+                                     int64_t(total_recv - rcvd), recv_fd, 0);
         return false;  // peer closed mid-transfer
       } else {
         rcvd += size_t(n);

@@ -70,9 +70,16 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms);
 // On failure, `failed_fd` (optional) receives the fd whose peer died or
 // errored (-1 for a plain timeout) so the caller can attribute the
 // failure to a ring neighbour.
+//
+// `send_tr` / `recv_tr` (optional, exactly kTrailerBytes each when
+// non-null) append an out-of-band trailer after the payload in each
+// direction — the integrity plane's CRC32C rides the payload round this
+// way instead of costing a second round trip per transfer.
+constexpr size_t kTrailerBytes = 4;
 bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
                     int recv_fd, char* recv_buf, size_t recv_len,
-                    int timeout_ms, int* failed_fd = nullptr);
+                    int timeout_ms, int* failed_fd = nullptr,
+                    const char* send_tr = nullptr, char* recv_tr = nullptr);
 
 // Local (own-side) IPv4 address of a connected socket — the address this
 // host uses on the route to the peer; empty string on failure.

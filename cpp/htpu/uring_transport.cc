@@ -204,9 +204,12 @@ void UringTransport::DrainCqes(std::vector<std::pair<uint64_t, int>>* out) {
 bool UringTransport::Duplex(int send_fd, const char* send_buf,
                             size_t send_len, int recv_fd, char* recv_buf,
                             size_t recv_len, int timeout_ms,
-                            int* failed_fd) {
+                            int* failed_fd, const char* send_tr,
+                            char* recv_tr) {
   if (failed_fd) *failed_fd = -1;
   const uint64_t gen = ++gen_;
+  const size_t total_send = send_len + (send_tr ? 4 : 0);
+  const size_t total_recv = recv_len + (recv_tr ? 4 : 0);
   size_t sent = 0, rcvd = 0;
   // Same accounting contract as DuplexTransfer: whatever moved is counted
   // on every exit path.
@@ -226,30 +229,45 @@ bool UringTransport::Duplex(int send_fd, const char* send_buf,
                   std::chrono::milliseconds(timeout_ms);
   bool send_inflight = false, recv_inflight = false;
   std::vector<std::pair<uint64_t, int>> cqes;
-  while (sent < send_len || rcvd < recv_len) {
+  while (sent < total_send || rcvd < total_recv) {
     // Submit one SQE per idle direction.
     unsigned to_submit = 0;
     unsigned tail = *sq_tail_;
     const unsigned mask = *sq_mask_;
-    if (sent < send_len && !send_inflight) {
-      size_t want = send_len - sent;
-      if (want > kSliceBytes) want = kSliceBytes;
+    if (sent < total_send && !send_inflight) {
+      const void* sp;
+      size_t want;
+      if (sent < send_len) {
+        sp = send_buf + sent;
+        want = send_len - sent;
+        if (want > kSliceBytes) want = kSliceBytes;
+      } else {
+        sp = send_tr + (sent - send_len);
+        want = total_send - sent;
+      }
       unsigned idx = tail & mask;
-      PrepSqe(idx, IORING_OP_SEND, send_fd, send_buf + sent,
-              unsigned(want), (gen << 2) | kTagSend, -1);
+      PrepSqe(idx, IORING_OP_SEND, send_fd, sp, unsigned(want),
+              (gen << 2) | kTagSend, -1);
       sq_array_[idx] = idx;
       ++tail;
       ++to_submit;
       send_inflight = true;
     }
-    if (rcvd < recv_len && !recv_inflight) {
-      size_t want = recv_len - rcvd;
-      if (want > kSliceBytes) want = kSliceBytes;
+    if (rcvd < total_recv && !recv_inflight) {
+      char* rp;
+      size_t want;
+      if (rcvd < recv_len) {
+        rp = recv_buf + rcvd;
+        want = recv_len - rcvd;
+        if (want > kSliceBytes) want = kSliceBytes;
+      } else {
+        rp = recv_tr + (rcvd - recv_len);
+        want = total_recv - rcvd;
+      }
       unsigned idx = tail & mask;
-      int fixed = FixedIndexOf(recv_buf + rcvd, want);
+      int fixed = FixedIndexOf(rp, want);
       PrepSqe(idx, fixed >= 0 ? IORING_OP_READ_FIXED : IORING_OP_RECV,
-              recv_fd, recv_buf + rcvd, unsigned(want),
-              (gen << 2) | kTagRecv, fixed);
+              recv_fd, rp, unsigned(want), (gen << 2) | kTagRecv, fixed);
       sq_array_[idx] = idx;
       ++tail;
       ++to_submit;
@@ -287,7 +305,7 @@ bool UringTransport::Duplex(int send_fd, const char* send_buf,
           if (res == -EINTR || res == -EAGAIN) continue;  // resubmit
           if (failed_fd) *failed_fd = send_fd;
           FlightRecorder::Get().Record("duplex.send_fail", "uring",
-                                       int64_t(send_len - sent), send_fd,
+                                       int64_t(total_send - sent), send_fd,
                                        -res);
           return false;
         }
@@ -298,7 +316,7 @@ bool UringTransport::Duplex(int send_fd, const char* send_buf,
           if (res == -EINTR || res == -EAGAIN) continue;
           if (failed_fd) *failed_fd = recv_fd;
           FlightRecorder::Get().Record("duplex.recv_fail", "uring",
-                                       int64_t(recv_len - rcvd), recv_fd,
+                                       int64_t(total_recv - rcvd), recv_fd,
                                        -res);
           return false;
         }
@@ -306,7 +324,7 @@ bool UringTransport::Duplex(int send_fd, const char* send_buf,
           if (failed_fd) *failed_fd = recv_fd;
           FlightRecorder::Get().Record("duplex.recv_fail",
                                        "peer closed (uring)",
-                                       int64_t(recv_len - rcvd), recv_fd,
+                                       int64_t(total_recv - rcvd), recv_fd,
                                        0);
           return false;
         }

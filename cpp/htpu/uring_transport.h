@@ -49,10 +49,13 @@ class UringTransport {
   // while receiving exactly recv_len on recv_fd, in 1MiB slices, both
   // directions inflight at once.  False on timeout or peer failure with
   // `failed_fd` attribution (-1 for a plain timeout).  Bumps the same
-  // transport.duplex_bytes_* counters as the classic path.
+  // transport.duplex_bytes_* counters as the classic path.  `send_tr` /
+  // `recv_tr` (optional, 4 bytes each) append the integrity-plane CRC
+  // trailer after the payload, mirroring DuplexTransfer.
   bool Duplex(int send_fd, const char* send_buf, size_t send_len,
               int recv_fd, char* recv_buf, size_t recv_len, int timeout_ms,
-              int* failed_fd);
+              int* failed_fd, const char* send_tr = nullptr,
+              char* recv_tr = nullptr);
 
  private:
   UringTransport() = default;

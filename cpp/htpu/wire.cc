@@ -2,6 +2,8 @@
 
 #include <cstring>
 
+#include "htpu/integrity.h"
+
 namespace htpu {
 
 const char* RequestTypeName(RequestType t) {
@@ -81,6 +83,28 @@ bool AnySet(const Vec& msgs) {
   for (const auto& m : msgs)
     if (m.process_set != 0) return true;
   return false;
+}
+
+// CRC trailer over every byte serialized so far (flags byte included).
+// Appended LAST, after every extension.
+void PutCrcTrailer(std::string* out) {
+  PutI32(out, int32_t(Crc32c(out->data(), out->size())));
+}
+
+// Consume + verify the trailer; the CRC covers data[0, pos-at-entry).
+// False (frame rejected, like any truncation) on mismatch, with the
+// ctrl-leg error counter bumped — the control plane treats a corrupt
+// frame exactly like a torn one.
+bool CheckCrcTrailer(const uint8_t* d, size_t len, size_t* pos) {
+  const size_t body = *pos;
+  int32_t wire_crc;
+  if (!GetI32(d, len, pos, &wire_crc)) return false;
+  CountBytesChecked(body);
+  if (uint32_t(wire_crc) != Crc32c(d, body)) {
+    CountCrcError(Leg::kCtrl);
+    return false;
+  }
+  return true;
 }
 
 }  // namespace
@@ -171,11 +195,13 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
   out->clear();
   const bool with_algo = AnyAlgo(l.requests);
   const bool with_set = AnySet(l.requests);
+  const bool with_crc = IntegrityEnabled();
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
                 | (with_algo ? kFlagAlgoExt : 0)
                 | (l.has_elastic_ext ? kFlagElasticExt : 0)
-                | (with_set ? kFlagSetExt : 0);
+                | (with_set ? kFlagSetExt : 0)
+                | (with_crc ? kFlagCrcExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
@@ -187,6 +213,7 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
     PutStr(out, l.cache_bits);
   }
   if (l.has_elastic_ext) PutI32(out, l.generation);
+  if (with_crc) PutCrcTrailer(out);
 }
 
 bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
@@ -218,6 +245,8 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   if (out->has_elastic_ext) {
     if (!GetI32(data, len, &pos, &out->generation)) return false;
   }
+  if ((flags & kFlagCrcExt) && !CheckCrcTrailer(data, len, &pos))
+    return false;
   return pos == len;
 }
 
@@ -225,11 +254,13 @@ void SerializeResponseList(const ResponseList& l, std::string* out) {
   out->clear();  // whole frame — see SerializeRequestList
   const bool with_algo = AnyAlgo(l.responses);
   const bool with_set = AnySet(l.responses);
+  const bool with_crc = IntegrityEnabled();
   uint8_t flags = (l.shutdown ? kFlagShutdown : 0)
                 | (l.has_cache_ext ? kFlagCacheExt : 0)
                 | (with_algo ? kFlagAlgoExt : 0)
                 | (l.has_elastic_ext ? kFlagElasticExt : 0)
-                | (with_set ? kFlagSetExt : 0);
+                | (with_set ? kFlagSetExt : 0)
+                | (with_crc ? kFlagCrcExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
@@ -273,6 +304,7 @@ void SerializeResponseList(const ResponseList& l, std::string* out) {
       for (int32_t s : l.digest_standbys) PutI32(out, s);
     }
   }
+  if (with_crc) PutCrcTrailer(out);
 }
 
 bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
@@ -360,6 +392,8 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
           return false;
     }
   }
+  if ((flags & kFlagCrcExt) && !CheckCrcTrailer(data, len, &pos))
+    return false;
   return pos == len;
 }
 

@@ -67,8 +67,13 @@ constexpr uint8_t kFlagElasticExt = 0x08;
 // process_set:i32 (set only when some message targets a non-default set,
 // so default-set-only traffic stays byte-identical to the pre-set wire).
 constexpr uint8_t kFlagSetExt = 0x10;
+// Integrity extension (HOROVOD_TPU_INTEGRITY=1 only): the frame ends with
+// a CRC32C trailer over every preceding byte, verified at parse.  Frames
+// with integrity off never set the bit, so legacy control traffic stays
+// byte-identical (golden-frame guarded like kFlagSetExt).
+constexpr uint8_t kFlagCrcExt = 0x20;
 constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt | kFlagAlgoExt |
-                                kFlagElasticExt | kFlagSetExt;
+                                kFlagElasticExt | kFlagSetExt | kFlagCrcExt;
 constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
 constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
 constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits

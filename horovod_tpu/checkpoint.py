@@ -135,14 +135,40 @@ def chain_links(directory: str, epoch: int) -> Optional[List[int]]:
         e = prev
 
 
+def _link_crc_ok(directory: str, epoch: int) -> bool:
+    """Verify one chain link's shard file against the CRC32C its manifest
+    recorded at commit.  Links from before the integrity trailer (no
+    ``crc32c`` key) pass — there is nothing to check them against."""
+    m = _chain_manifest(directory, epoch)
+    want = None if m is None else m.get("crc32c")
+    if want is None:
+        return True
+    from horovod_tpu import metrics, wire
+    try:
+        with open(os.path.join(checkpoint_path(directory, epoch),
+                               CHAIN_SHARDS), "rb") as f:
+            got = wire.crc32c(f.read())
+    except OSError:
+        return False
+    if got != (want & 0xFFFFFFFF):
+        metrics.registry.inc("ckpt.corrupt_links")
+        return False
+    return True
+
+
 def _is_committed(directory: str, epoch: int) -> bool:
     """True when ``checkpoint-{epoch}`` is restorable: a legacy orbax dir
     (atomic-replace committed, hence complete) or a chain dir whose links
-    are all intact."""
+    are all intact AND whose shard bytes still match the CRC32C recorded
+    at commit (a corrupt link makes the whole chain torn — the resume
+    pivots to the prior committed chain, never loads flipped bits)."""
     if not os.path.isdir(checkpoint_path(directory, epoch)):
         return False
     if is_chain(directory, epoch):
-        return chain_links(directory, epoch) is not None
+        links = chain_links(directory, epoch)
+        if links is None:
+            return False
+        return all(_link_crc_ok(directory, e) for e in links)
     return True
 
 
@@ -197,11 +223,15 @@ def save_chain(directory: str, flat: Dict[str, Any], epoch: int, *,
         os.makedirs(staging)
         np.savez(os.path.join(staging, CHAIN_SHARDS),
                  **{k: np.asarray(flat[k]) for k in changed})
+        from horovod_tpu import wire
+        with open(os.path.join(staging, CHAIN_SHARDS), "rb") as f:
+            shard_crc = wire.crc32c(f.read())
         if fault_hook is not None:
             fault_hook()
         manifest = {"format": 1, "kind": kind, "epoch": epoch,
                     "prev": prev_epoch if kind == "delta" else -1,
-                    "keys": sorted(flat), "shards": changed}
+                    "keys": sorted(flat), "shards": changed,
+                    "crc32c": shard_crc}
         _write_atomic(os.path.join(staging, CHAIN_MANIFEST),
                       json.dumps(manifest))
         if os.path.isdir(path):
@@ -228,6 +258,16 @@ def read_chain_state(directory: str, epoch: int) -> Dict[str, Any]:
     for e in links:
         shard_path = os.path.join(checkpoint_path(directory, e),
                                   CHAIN_SHARDS)
+        # End-to-end integrity: the manifest carries a CRC32C of the
+        # shard file taken at commit; a mismatch (bit rot, a torn write
+        # the rename discipline couldn't see, a chaos drill) makes the
+        # whole chain torn — the caller falls back to the prior
+        # committed chain instead of loading silently wrong numbers.
+        if not _link_crc_ok(directory, e):
+            raise TornChainError(
+                f"checkpoint-{e} (link of chain {epoch}) in "
+                f"{directory!r} is corrupt: shard CRC32C does not match "
+                f"the manifest recorded at commit")
         try:
             with np.load(shard_path, allow_pickle=False) as z:
                 for k in z.files:

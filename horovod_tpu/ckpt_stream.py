@@ -86,6 +86,18 @@ def _crash_in_save_epoch(rank: int) -> Optional[int]:
     return min((s.epoch for s in specs), default=None)
 
 
+def _corrupt_ckpt_epoch(rank: int) -> Optional[int]:
+    """Smallest ``corrupt_ckpt`` fault epoch targeting ``rank``, or None.
+    The drill flips bytes in a COMMITTED shard file — simulating bit rot
+    the rename discipline cannot see — so the next restore must detect
+    the CRC mismatch and fall back to the prior committed chain."""
+    from horovod_tpu.core import parse_fault_specs
+    specs = [s for s in parse_fault_specs(
+                 os.environ.get("HOROVOD_TPU_FAULT", ""))
+             if s.mode == "corrupt_ckpt" and s.rank == rank]
+    return min((s.epoch for s in specs), default=None)
+
+
 class AsyncCheckpointer:
     """Rank-owned snapshot→delta pipeline over ``directory``.
 
@@ -120,6 +132,7 @@ class AsyncCheckpointer:
         # failover must not re-fire the dead coordinator's fault.
         first_rank = int(os.environ.get("HOROVOD_TPU_RANK", self._rank))
         self._fault_epoch = _crash_in_save_epoch(first_rank)
+        self._corrupt_epoch = _corrupt_ckpt_epoch(first_rank)
         self._thread = threading.Thread(
             target=self._run, name="htpu-ckpt-writer", daemon=True)
         self._thread.start()
@@ -254,6 +267,35 @@ class AsyncCheckpointer:
             f"epoch={epoch} kind={stats['kind']} "
             f"shards={stats['shards']}/{stats['total']}",
             nbytes=stats["nbytes"])
+        self._maybe_corrupt(epoch)
+
+    def _maybe_corrupt(self, epoch: int) -> None:
+        """corrupt_ckpt drill: flip a byte in the just-COMMITTED shard
+        file, after the rename published it — exactly the corruption the
+        manifest CRC32C exists to catch at restore."""
+        if self._corrupt_epoch is None or epoch < self._corrupt_epoch:
+            return
+        self._corrupt_epoch = None
+        path = os.path.join(
+            checkpoint.checkpoint_path(self._dir, epoch),
+            checkpoint.CHAIN_SHARDS)
+        try:
+            with open(path, "r+b") as f:
+                data = f.read()
+                if not data:
+                    return
+                f.seek(len(data) // 2)
+                f.write(bytes([data[len(data) // 2] ^ 0x5A]))
+        except OSError as exc:
+            print(f"htpu fault injection: corrupt_ckpt could not mangle "
+                  f"{path!r}: {exc}", file=sys.stderr, flush=True)
+            return
+        _metrics.registry.inc("ckpt.faults_injected#mode=corrupt_ckpt")
+        cpp_core.flight_record(
+            "fault.corrupt_ckpt",
+            f"epoch={epoch} rank={self._rank} path={path}")
+        print(f"htpu fault injection: flipped a byte in committed shard "
+              f"{path!r} (epoch {epoch})", file=sys.stderr, flush=True)
 
     def _maybe_crash(self, epoch: int) -> None:
         if self._fault_epoch is not None and epoch >= self._fault_epoch:

@@ -121,22 +121,29 @@ class FaultSpec:
     firing.
     """
     mode: str      # "crash" | "hang" | "drop_conn" | "rejoin"
-                   # | "crash_in_save" | "slow"
+                   # | "crash_in_save" | "slow" | "corrupt" | "corrupt_ckpt"
     rank: int      # first global rank of the target process
     tick: int      # 1-based negotiation tick on which the fault fires;
-                   # for crash_in_save, the 0-based snapshot epoch; for
-                   # slow, the first delayed tick (-1 = from the start)
+                   # for crash_in_save/corrupt_ckpt, the 0-based snapshot
+                   # epoch; for slow, the first delayed tick (-1 = from
+                   # the start)
     ms: int = 0    # slow only: per-tick delay in milliseconds
+    leg: str = "classic"  # corrupt only: which data-plane leg to mangle
+                          # ("classic" | "shm" | "uring" | "ctrl")
+    count: int = 1        # corrupt only: how many frames/chunks to flip
 
     @property
     def epoch(self) -> int:
         """crash_in_save's trigger: first committed snapshot epoch >= this
-        value kills the writer mid-commit."""
+        value kills the writer mid-commit.  For corrupt_ckpt, the epoch
+        whose committed shard file gets its bytes flipped."""
         return self.tick
 
 
 _FAULT_MODES = ("crash", "hang", "drop_conn", "rejoin", "crash_in_save",
-                "slow")
+                "slow", "corrupt", "corrupt_ckpt")
+
+_CORRUPT_LEGS = ("classic", "shm", "uring", "ctrl")
 
 
 def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
@@ -145,7 +152,11 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
     workers at the first tick >= T (elastic mode's deterministic readmit
     trigger); ``crash_in_save`` takes ``epoch=`` instead of ``tick=``
     (epochs are step numbers, counted from 0) and kills the async
-    checkpoint writer between staging its shards and committing them."""
+    checkpoint writer between staging its shards and committing them;
+    ``corrupt`` flips a payload byte post-checksum pre-send on the chosen
+    data-plane leg; ``corrupt_ckpt`` flips bytes in a committed shard
+    file (Python-owned, like crash_in_save — the native parser skips
+    both)."""
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -186,13 +197,62 @@ def parse_fault_spec(spec: str) -> Optional[FaultSpec]:
                 f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
                 "(ticks are counted from 1).")
         return FaultSpec("slow", kv["rank"], kv.get("tick", -1), kv["ms"])
+    if parts[0] == "corrupt":
+        # corrupt:rank=<R>:tick=<T>[:leg=<L>][:count=<N>] — flip a byte in
+        # a data-plane payload post-checksum, pre-send, on the chosen leg
+        # (classic socket ring by default), starting at tick T, N times.
+        if len(parts) not in (3, 4, 5):
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+                "'corrupt:rank=<R>:tick=<T>[:leg=<L>][:count=<N>]'.")
+        kv = {}
+        for part in parts[1:]:
+            key, sep, val = part.partition("=")
+            if not sep or key not in ("rank", "tick", "leg", "count") \
+                    or key in kv:
+                raise ValueError(
+                    f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
+                    "'corrupt:rank=<R>:tick=<T>[:leg=<L>][:count=<N>]'.")
+            if key == "leg":
+                kv[key] = val
+                continue
+            try:
+                kv[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"Malformed HOROVOD_TPU_FAULT {spec!r}: {key!r} must "
+                    f"be an integer, got {val!r}.") from None
+        if "rank" not in kv or "tick" not in kv:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: both rank= and "
+                "tick= are required.")
+        if kv["rank"] < 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: rank must be >= 0.")
+        if kv["tick"] <= 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: tick must be >= 1 "
+                "(ticks are counted from 1).")
+        leg = kv.get("leg", "classic")
+        if leg not in _CORRUPT_LEGS:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: leg must be one of "
+                f"{'|'.join(_CORRUPT_LEGS)}, got {leg!r}.")
+        if kv.get("count", 1) <= 0:
+            raise ValueError(
+                f"Malformed HOROVOD_TPU_FAULT {spec!r}: count must be >= 1.")
+        return FaultSpec("corrupt", kv["rank"], kv["tick"], 0, leg,
+                         kv.get("count", 1))
     if len(parts) != 3 or parts[0] not in _FAULT_MODES:
         raise ValueError(
             f"Malformed HOROVOD_TPU_FAULT {spec!r}: expected "
             "'<crash|hang|drop_conn|rejoin>:rank=<R>:tick=<T>', "
-            "'crash_in_save:rank=<R>:epoch=<E>' or "
+            "'crash_in_save:rank=<R>:epoch=<E>', "
+            "'corrupt_ckpt:rank=<R>:epoch=<E>', "
+            "'corrupt:rank=<R>:tick=<T>[:leg=<L>][:count=<N>]' or "
             "'slow:rank=<R>:ms=<M>[:tick=<T>]'.")
-    when_key = "epoch" if parts[0] == "crash_in_save" else "tick"
+    when_key = ("epoch" if parts[0] in ("crash_in_save", "corrupt_ckpt")
+                else "tick")
     kv = {}
     for part in parts[1:]:
         key, sep, val = part.partition("=")

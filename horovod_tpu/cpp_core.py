@@ -312,6 +312,18 @@ def _configure(lib) -> None:
         lib.htpu_process_sets_construct.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_void_p)]
+    # Integrity plane (PR 17: CRC32C + checked transfers), same guard —
+    # a prebuilt .so from before the integrity layer still loads.
+    if hasattr(lib, "htpu_crc32c"):
+        lib.htpu_crc32c.restype = ctypes.c_uint
+        lib.htpu_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.htpu_crc32c_sw.restype = ctypes.c_uint
+        lib.htpu_crc32c_sw.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.htpu_crc32c_hw.restype = ctypes.c_int
+        lib.htpu_crc32c_hw.argtypes = []
+        lib.htpu_control_set_xfer_context.restype = None
+        lib.htpu_control_set_xfer_context.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
 
 
 def load():
@@ -907,6 +919,34 @@ def metrics_reset() -> None:
         lib.htpu_metrics_reset()
 
 
+def crc32c_native(data: bytes):
+    """CRC32C (Castagnoli) via the native runtime-dispatched path (SSE4.2
+    when available); ``None`` when the native core is unavailable or
+    predates the integrity layer — callers fall back to the pure-Python
+    table in horovod_tpu.wire."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_crc32c"):
+        return None
+    return int(lib.htpu_crc32c(data, len(data)))
+
+
+def crc32c_native_sw(data: bytes):
+    """The native software (table) path, regardless of CPU support — for
+    pinning hardware == software == Python on the same inputs."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_crc32c_sw"):
+        return None
+    return int(lib.htpu_crc32c_sw(data, len(data)))
+
+
+def crc32c_hardware() -> bool:
+    """True when the native dispatcher selected the SSE4.2 path."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_crc32c_hw"):
+        return False
+    return bool(lib.htpu_crc32c_hw())
+
+
 class CppControlPlane:
     """Multi-process control + eager data plane (TCP, native).
 
@@ -1042,6 +1082,15 @@ class CppControlPlane:
         if not hasattr(self._lib, "htpu_control_elastic"):
             return False
         return bool(self._lib.htpu_control_elastic(self._ptr))
+
+    def set_xfer_context(self, tensors: str) -> None:
+        """Name the tensors of the collective about to run; a checked
+        transfer that exhausts its retransmit budget folds this into the
+        attributed error (HOROVOD_TPU_INTEGRITY).  No-op on an older
+        native core."""
+        if hasattr(self._lib, "htpu_control_set_xfer_context"):
+            self._lib.htpu_control_set_xfer_context(
+                self._ptr, tensors.encode("utf-8", "replace"))
 
     def last_error(self):
         """Attribution of the most recent native failure on this process:

@@ -594,6 +594,14 @@ class DistributedExecutor(Executor):
             if algo:
                 activity += f"[{algo}]"
             self.timeline.activity_start_all(entries, activity)
+        # Name the in-flight tensors for the integrity layer: a checked
+        # transfer that exhausts its retransmit budget folds this into the
+        # attributed abort (HOROVOD_TPU_INTEGRITY).
+        if hasattr(self._control, "set_xfer_context"):
+            names = ",".join(e.name for e in entries[:3])
+            if len(entries) > 3:
+                names += f",+{len(entries) - 3}"
+            self._control.set_xfer_context(names)
         reduced = np.frombuffer(
             self._control.allreduce(str(dtype), np.ascontiguousarray(buf),
                                     wire_dtype, algo),

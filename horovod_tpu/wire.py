@@ -12,6 +12,7 @@ control plane.
 from __future__ import annotations
 
 import dataclasses
+import os
 import struct
 from typing import List, Optional, Tuple
 
@@ -40,8 +41,13 @@ FLAG_ELASTIC_EXT = 0x08
 # so default-set-only traffic stays byte-identical to the pre-set wire —
 # golden-frame guarded in tests/test_process_sets.py).
 FLAG_SET_EXT = 0x10
+# Integrity extension (HOROVOD_TPU_INTEGRITY=1 only): the frame ends with
+# a CRC32C trailer over every preceding byte, verified at parse.  Frames
+# with integrity off never set the bit, so legacy control traffic stays
+# byte-identical (golden-frame guarded like FLAG_SET_EXT).
+FLAG_CRC_EXT = 0x20
 _KNOWN_FLAGS = (FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
-                | FLAG_ELASTIC_EXT | FLAG_SET_EXT)
+                | FLAG_ELASTIC_EXT | FLAG_SET_EXT | FLAG_CRC_EXT)
 
 # Response-cache extension cflags (ResponseList direction only).
 CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
@@ -109,6 +115,71 @@ class ResponseElasticExt:
     digest_members: List[Tuple[int, str]] = dataclasses.field(
         default_factory=list)
     digest_standbys: List[int] = dataclasses.field(default_factory=list)
+
+
+# ------------------------------------------------------------ integrity
+# CRC32C (Castagnoli, reflected poly 0x82F63B78) — the checksum the
+# native integrity layer (cpp/htpu/integrity.cc) stamps on control
+# frames.  NOT zlib/binascii crc32 (that is the IEEE polynomial); this
+# table mirrors the native software path bit for bit and is parity-tested
+# against both native paths in tests.
+
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table: Optional[List[int]] = None
+
+
+def _crc32c_tbl() -> List[int]:
+    global _crc32c_table
+    if _crc32c_table is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (_CRC32C_POLY ^ (c >> 1)) if c & 1 else (c >> 1)
+            tbl.append(c)
+        _crc32c_table = tbl
+    return _crc32c_table
+
+
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python CRC32C (incremental: pass the previous digest as
+    ``crc``).  ``crc32c_py(b) == native Crc32c(b)`` by construction."""
+    tbl = _crc32c_tbl()
+    c = (crc & 0xFFFFFFFF) ^ 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes) -> int:
+    """CRC32C via the native dispatched path when the core is loaded
+    (SSE4.2 at memory bandwidth), the Python table otherwise."""
+    from horovod_tpu import cpp_core   # lazy: cpp_core imports this module
+    native = cpp_core.crc32c_native(bytes(data))
+    return native if native is not None else crc32c_py(data)
+
+
+def integrity_enabled() -> bool:
+    """HOROVOD_TPU_INTEGRITY — mirrors the native EnvFlag rule (first
+    char '0'/'f'/'F'/'n'/'N' = off, default off) so both serializers pick
+    the same wire format."""
+    v = os.environ.get("HOROVOD_TPU_INTEGRITY", "")
+    if not v:
+        return False
+    return v[0] not in "0fFnN"
+
+
+def _put_crc_trailer(out: bytearray) -> None:
+    out += struct.pack("<I", crc32c(bytes(out)))
+
+
+def _check_crc_trailer(rd: "_Reader", what: str) -> None:
+    body_end = rd.pos
+    wire_crc = rd.i32() & 0xFFFFFFFF
+    if crc32c(rd.data[:body_end]) != wire_crc:
+        raise ValueError(
+            f"checksum mismatch in {what}: CRC32C trailer does not match "
+            "the frame body (corrupt frame)")
 
 
 def _put_str(out: bytearray, s: str) -> None:
@@ -263,6 +334,9 @@ def serialize_request_list(requests: List[Request],
     with_set = _any_set(requests)
     if with_set:
         flags |= FLAG_SET_EXT
+    with_crc = integrity_enabled()
+    if with_crc:
+        flags |= FLAG_CRC_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
@@ -276,6 +350,8 @@ def serialize_request_list(requests: List[Request],
         out += cache_ext.bits
     if elastic_ext is not None:
         out += struct.pack("<i", elastic_ext.generation)
+    if with_crc:
+        _put_crc_trailer(out)
     return bytes(out)
 
 
@@ -301,6 +377,8 @@ def parse_request_list_elastic(data: bytes) -> Tuple[
     elastic = None
     if flags & FLAG_ELASTIC_EXT:
         elastic = RequestElasticExt(generation=rd.i32())
+    if flags & FLAG_CRC_EXT:
+        _check_crc_trailer(rd, "request list")
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in request list: parsed {rd.pos} of "
@@ -340,6 +418,9 @@ def serialize_response_list(responses: List[Response],
     with_set = _any_set(responses)
     if with_set:
         flags |= FLAG_SET_EXT
+    with_crc = integrity_enabled()
+    if with_crc:
+        flags |= FLAG_CRC_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
@@ -380,6 +461,8 @@ def serialize_response_list(responses: List[Response],
             out += struct.pack("<i", len(elastic_ext.digest_standbys))
             for sid in elastic_ext.digest_standbys:
                 out += struct.pack("<i", sid)
+    if with_crc:
+        _put_crc_trailer(out)
     return bytes(out)
 
 
@@ -433,6 +516,8 @@ def parse_response_list_elastic(data: bytes) -> Tuple[
             has_digest=has_digest, coord_epoch=coord_epoch,
             digest_cache_epoch=digest_cache_epoch,
             digest_members=digest_members, digest_standbys=digest_standbys)
+    if flags & FLAG_CRC_EXT:
+        _check_crc_trailer(rd, "response list")
     if rd.pos != len(data):
         raise ValueError(
             f"trailing bytes in response list: parsed {rd.pos} of "

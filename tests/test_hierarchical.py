@@ -130,9 +130,9 @@ def launch(fingerprints, algo, script=WORKER, extra_env=None, timeout=150):
             "HOROVOD_TPU_ALLREDUCE_ALGO": algo,
             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         })
-        env.update(extra_env or {})
         env.pop("HOROVOD_TPU_TIMELINE", None)
         env.pop("HOROVOD_TPU_FAULT", None)
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))

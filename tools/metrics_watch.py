@@ -328,6 +328,33 @@ def render_xport_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def render_integrity_summary(snap: dict, name_filter: str) -> list[str]:
+    """One-line end-to-end integrity digest: bytes CRC-checked across all
+    data-plane legs, plus per-leg ``integrity.crc_errors#leg=`` /
+    ``integrity.retransmits#leg=`` counts.  Errors are loud (upper-case,
+    like FALLBACKS) — a nonzero count means a frame arrived corrupt and
+    was retransmitted; silence here with HOROVOD_TPU_INTEGRITY=1 means
+    every checked byte matched."""
+    counters = snap.get("counters", {})
+    name = "integrity"
+    if name_filter and name_filter not in name:
+        return []
+    checked = counters.get("integrity.bytes_checked", 0)
+    per_leg = []
+    for leg in ("classic", "shm", "uring", "ctrl"):
+        errs = counters.get(f"integrity.crc_errors#leg={leg}", 0)
+        rexs = counters.get(f"integrity.retransmits#leg={leg}", 0)
+        if errs or rexs:
+            per_leg.append(f"CRC_ERRORS[{leg}]={errs:g}"
+                           f" retransmits[{leg}]={rexs:g}")
+    if not checked and not per_leg:
+        return []
+    text = f"checked={human_bytes(checked)}"
+    if per_leg:
+        text += " " + " ".join(per_leg)
+    return ["  -- integrity --", f"  {name:<52} {text}"]
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -374,6 +401,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
 
     lines.extend(render_algo_summary(snap, name_filter))
     lines.extend(render_xport_summary(snap, name_filter))
+    lines.extend(render_integrity_summary(snap, name_filter))
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
     lines.extend(render_elastic_summary(snap, name_filter))
