@@ -855,4 +855,71 @@ HTPU_API int htpu_process_sets_construct(void* ps, int id, const char* name,
   return CopyOut(buf, out);
 }
 
+// ------------------------------------------------- fleet observatory
+
+// HOROVOD_TPU_OBSERVE state: 1 armed, 0 off.  Runtime-toggleable (the
+// bench A/B measures both states in one process).
+HTPU_API int htpu_observe_enabled(void) {
+  return htpu::ObserveEnabled() ? 1 : 0;
+}
+
+HTPU_API void htpu_observe_set_enabled(int on) {
+  htpu::ObserveSetEnabled(on != 0);
+}
+
+// One training step's decomposition from the Python layer (seconds).
+HTPU_API void htpu_observe_note_step(double step_s, double compute_s,
+                                     double hidden_s, double exposed_s,
+                                     double stall_s) {
+  htpu::NoteStep(step_s, compute_s, hidden_s, exposed_s, stall_s);
+}
+
+// Test seam: record one completed transfer on leg 0..3 (classic, shm,
+// uring, ctrl) without driving a real job.
+HTPU_API void htpu_observe_record_xfer(int leg, long long sent_bytes,
+                                       long long recv_bytes,
+                                       double seconds) {
+  if (leg < 0 || leg > 3) return;
+  htpu::RecordXfer(htpu::Leg(leg), size_t(sent_bytes < 0 ? 0 : sent_bytes),
+                   size_t(recv_bytes < 0 ? 0 : recv_bytes), seconds);
+}
+
+// Compact local telemetry digest as JSON into *out; returns its length.
+HTPU_API int htpu_observe_snapshot(void** out) {
+  return CopyOut(htpu::ObserveSnapshotJson(), out);
+}
+
+HTPU_API void htpu_observe_reset(void) { htpu::ObserveReset(); }
+
+// The telemetry trailer this process would append to its next tick
+// frame: kObserveTrailerBytes when the observatory is armed, 0 bytes
+// when it is off (the golden-frame contract — nothing is appended).
+HTPU_API int htpu_observe_trailer_encode(void** out) {
+  std::string t;
+  if (htpu::ObserveEnabled()) htpu::AppendObserveTrailer(&t);
+  return CopyOut(t, out);
+}
+
+// Probe `len` bytes the way the coordinator does: strip a telemetry
+// trailer if one is present.  JSON {"stripped":bool,"payload_len":N,
+// "sample":{...}} into *out; returns its length.  A frame from an
+// observe-off peer reports stripped=false with the payload untouched.
+HTPU_API int htpu_observe_trailer_probe(const void* buf, int len,
+                                        void** out) {
+  std::string blob(static_cast<const char*>(buf), size_t(len < 0 ? 0 : len));
+  htpu::ObserveSample s;
+  const bool stripped = htpu::StripObserveTrailer(&blob, &s);
+  char js[512];
+  snprintf(js, sizeof(js),
+           "{\"stripped\":%s,\"payload_len\":%zu,\"sample\":{"
+           "\"step_s\":%.9g,\"compute_s\":%.9g,\"exposed_s\":%.9g,"
+           "\"stall_s\":%.9g,\"steps\":%u,\"bw_bps\":[%.9g,%.9g,%.9g,"
+           "%.9g]}}",
+           stripped ? "true" : "false", blob.size(), double(s.step_s),
+           double(s.compute_s), double(s.exposed_s), double(s.stall_s),
+           s.steps, double(s.bw_bps[0]), double(s.bw_bps[1]),
+           double(s.bw_bps[2]), double(s.bw_bps[3]));
+  return CopyOut(std::string(js), out);
+}
+
 }  // extern "C"

@@ -17,6 +17,7 @@
 
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
+#include "htpu/observe.h"
 #include "htpu/policy.h"
 #include "htpu/scheduler.h"
 #include "htpu/metrics.h"
@@ -973,9 +974,11 @@ bool ControlPlane::XferOnce(int send_fd, const char* send_buf,
                              {wseg_[0].data(), wseg_[0].size()},
                              {wseg_[1].data(), wseg_[1].size()},
                              {hier_buf_.data(), hier_buf_.size()}});
+    XferScope obs(Leg::kUring);
     ok = uring_->Duplex(send_fd, send_buf, send_len, recv_fd, recv_buf,
                         recv_len, timeout_ms_, &failed, send_tr, recv_tr);
     if (ok) {
+      obs.Done(send_len, recv_len);
       static std::atomic<long long>* u_sent =
           Metrics::Get().Counter("ring.uring.bytes_sent");
       static std::atomic<long long>* u_recv =
@@ -1360,6 +1363,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     std::string frame;
     CompressRequestFrame(request_list_blob, &frame);
     if (elastic_) StampElasticRequest(&frame);
+    // Telemetry trailer rides INSIDE the clock trailer (the coordinator
+    // strips the clock stamps first, then this one opportunistically by
+    // magic — observe-off frames stay byte-identical).
+    if (ObserveEnabled()) AppendObserveTrailer(&frame);
     AppendClockTrailer(last_resp_recv_us_, &frame);
     auto w0 = std::chrono::steady_clock::now();
     FlightRecorder::Get().Record("tick.send", "", int64_t(frame.size()),
@@ -1498,6 +1505,11 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     int64_t t1_us = 0, t4_prev_us = 0;
     bool have_trailer =
         got && StripClockTrailer(&blob, &t4_prev_us, &t1_us);
+    // Telemetry trailer (when the worker's observatory is armed) sits
+    // under the clock stamps; strip by magic regardless of our own
+    // observe state so mixed fleets interoperate.
+    ObserveSample obs_sample;
+    bool have_obs = got && StripObserveTrailer(&blob, &obs_sample);
     bool parsed_ok =
         got &&
         ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
@@ -1541,6 +1553,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
           have_arrival[size_t(i)] = true;
         }
       }
+      if (have_obs) NoteFleetSample(i, obs_sample);
       shutdown = shutdown || frames[size_t(i)].shutdown;
       if (frames[size_t(i)].abort_rank >= 0 &&
           (abort_rank < 0 ||
@@ -1575,6 +1588,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       if (all_in_set) set_attr[size_t(p)] = s;
     }
     ObserveGatherSkew(arrival_us, have_arrival, set_attr);
+    RunObservatory();
   }
   {
     auto gather_t1 = std::chrono::steady_clock::now();
@@ -2771,6 +2785,20 @@ void ControlPlane::FlushMembershipState() {
   Metrics::Get().RemoveMatching("control.gather_skew_seconds#rank=");
   Metrics::Get().RemoveMatching("control.clock_offset_us#rank=");
   Metrics::Get().RemoveMatching("policy.ewma_wait_s#rank=");
+  // Fleet telemetry series and sentinel hysteresis are keyed by rank
+  // labels too — retire them with the other per-rank series so the new
+  // membership starts clean.
+  Metrics::Get().RemoveMatching("fleet.");
+  fleet_samples_.clear();
+  fleet_have_.clear();
+  fleet_names_built_for_ = -1;
+  fleet_step_names_.clear();
+  fleet_compute_names_.clear();
+  fleet_exposed_names_.clear();
+  fleet_stall_names_.clear();
+  fleet_steps_names_.clear();
+  fleet_bw_names_.clear();
+  sentinel_.clear();
   last_resp_recv_us_ = 0;
   last_bcast_us_ = 0;
   // The replicated coordinator digest was keyed by the old membership;
@@ -2882,6 +2910,265 @@ void ControlPlane::ObserveGatherSkew(
       int rank = p < all_first_ranks_.size() ? all_first_ranks_[p] : int(p);
       Metrics::Get().SetGauge(
           "policy.ewma_wait_s#rank=" + std::to_string(rank), ew);
+    }
+  }
+  // The regression sentinel smooths the same median-anchored imposed
+  // waits (its own EWMAs — the sentinel runs with or without an armed
+  // eviction policy, and report-only must never share the policy's
+  // hysteresis state).
+  if (ObserveEnabled()) NoteSentinelWait(wait_s);
+}
+
+// ------------------------------------------------- fleet observatory
+
+namespace {
+
+// Sentinel knobs, read once per process (the drills relaunch).
+double ObsParseDouble(const char* e, double dflt) {
+  if (e == nullptr || *e == '\0') return dflt;
+  char* end = nullptr;
+  double v = strtod(e, &end);
+  return (end && *end == '\0') ? v : dflt;
+}
+
+// Step-time regression line: seconds of smoothed imposed wait above the
+// fleet-median arrival at which a rank counts as regressed.
+double SentinelThresholdS() {
+  const double dflt = 0.02;
+  static double v =
+      ObsParseDouble(getenv("HOROVOD_TPU_SENTINEL_THRESHOLD"), dflt);
+  return v;
+}
+
+// Consecutive over-threshold gathers before an alert fires (one healthy
+// gather resets the streak and re-arms the latch).
+int SentinelTicksKnob() {
+  const int dflt = 3;
+  static int v = std::max(
+      1, int(ObsParseDouble(getenv("HOROVOD_TPU_SENTINEL_TICKS"), dflt)));
+  return v;
+}
+
+// Bandwidth-collapse line: alert when a rank's per-leg bandwidth EWMA
+// falls below the fleet median for that leg divided by this factor.
+double SentinelBwFactor() {
+  const double dflt = 4.0;
+  static double v = std::max(
+      1.0,
+      ObsParseDouble(getenv("HOROVOD_TPU_SENTINEL_BW_FACTOR"), dflt));
+  return v;
+}
+
+// Fleet gauges are republished every N coordinator ticks — live enough
+// for a dashboard, cheap enough for a 1 ms cycle time.
+constexpr uint64_t kFleetPublishTicks = 16;
+
+// True median, matching ObserveGatherSkew (midpoint of the two middles
+// for even counts — at 2 processes the slow rank must not BE the
+// baseline).
+double TrueMedian(std::vector<double> v) {
+  std::nth_element(v.begin(), v.begin() + long(v.size() / 2), v.end());
+  double med = v[v.size() / 2];
+  if (v.size() % 2 == 0) {
+    double lower =
+        *std::max_element(v.begin(), v.begin() + long(v.size() / 2));
+    med = (med + lower) / 2.0;
+  }
+  return med;
+}
+
+}  // namespace
+
+void ControlPlane::NoteFleetSample(int proc, const ObserveSample& s) {
+  if (fleet_samples_.size() != size_t(process_count_)) {
+    fleet_samples_.assign(size_t(process_count_), ObserveSample());
+    fleet_have_.assign(size_t(process_count_), 0);
+  }
+  if (proc < 0 || proc >= process_count_) return;
+  fleet_samples_[size_t(proc)] = s;
+  fleet_have_[size_t(proc)] = 1;
+}
+
+void ControlPlane::NoteSentinelWait(const std::vector<double>& wait_s) {
+  if (sentinel_.size() != size_t(process_count_))
+    sentinel_.assign(size_t(process_count_), SentinelState());
+  for (size_t p = 0; p < wait_s.size() && p < sentinel_.size(); ++p) {
+    if (wait_s[p] < 0) continue;   // no arrival estimate this gather
+    double& ew = sentinel_[p].wait_ewma;
+    ew = ew < 0 ? wait_s[p] : ew + 0.2 * (wait_s[p] - ew);
+  }
+}
+
+void ControlPlane::RunObservatory() {
+  if (!ObserveEnabled()) return;
+  // The coordinator's own request list never crosses a socket, so its
+  // fleet-table row comes straight from the local observatory.
+  NoteFleetSample(0, LocalObserveSample());
+  if (fleet_samples_.empty()) return;
+  if (sentinel_.size() != size_t(process_count_))
+    sentinel_.assign(size_t(process_count_), SentinelState());
+
+  // Cached per-rank gauge names (rank labels change meaning on an
+  // elastic re-rank; FlushMembershipState clears these alongside the
+  // skew/offset name caches).
+  if (fleet_names_built_for_ != process_count_) {
+    fleet_names_built_for_ = process_count_;
+    fleet_step_names_.clear();
+    fleet_compute_names_.clear();
+    fleet_exposed_names_.clear();
+    fleet_stall_names_.clear();
+    fleet_steps_names_.clear();
+    fleet_wait_names_.clear();
+    fleet_bw_names_.clear();
+    for (int p = 0; p < process_count_; ++p) {
+      const std::string rank = std::to_string(
+          size_t(p) < all_first_ranks_.size() ? all_first_ranks_[size_t(p)]
+                                              : p);
+      fleet_step_names_.push_back("fleet.step_seconds#rank=" + rank);
+      fleet_compute_names_.push_back("fleet.compute_seconds#rank=" + rank);
+      fleet_exposed_names_.push_back("fleet.exposed_comm_fraction#rank=" +
+                                     rank);
+      fleet_stall_names_.push_back("fleet.stall_seconds#rank=" + rank);
+      fleet_steps_names_.push_back("fleet.steps#rank=" + rank);
+      fleet_wait_names_.push_back("fleet.wait_ewma_s#rank=" + rank);
+      for (int l = 0; l < 4; ++l) {
+        fleet_bw_names_.push_back("fleet.bandwidth_bps#rank=" + rank +
+                                  ",leg=" + LegName(Leg(l)));
+      }
+    }
+  }
+
+  int valid = 0;
+  for (int p = 0; p < process_count_; ++p) valid += fleet_have_[size_t(p)];
+
+  if (tick_count_ % kFleetPublishTicks == 0) {
+    Metrics& mx = Metrics::Get();
+    mx.SetGauge("fleet.ranks", double(valid));
+    for (int p = 0; p < process_count_; ++p) {
+      if (!fleet_have_[size_t(p)]) continue;
+      const ObserveSample& s = fleet_samples_[size_t(p)];
+      mx.SetGauge(fleet_step_names_[size_t(p)], double(s.step_s));
+      mx.SetGauge(fleet_compute_names_[size_t(p)], double(s.compute_s));
+      mx.SetGauge(fleet_exposed_names_[size_t(p)],
+                  s.step_s > 0 ? double(s.exposed_s) / double(s.step_s)
+                               : 0.0);
+      mx.SetGauge(fleet_stall_names_[size_t(p)], double(s.stall_s));
+      mx.SetGauge(fleet_steps_names_[size_t(p)], double(s.steps));
+      if (sentinel_[size_t(p)].wait_ewma >= 0) {
+        mx.SetGauge(fleet_wait_names_[size_t(p)],
+                    sentinel_[size_t(p)].wait_ewma);
+      }
+      for (int l = 0; l < 4; ++l) {
+        if (s.bw_bps[l] > 0) {
+          mx.SetGauge(fleet_bw_names_[size_t(p * 4 + l)],
+                      double(s.bw_bps[l]));
+        }
+      }
+    }
+    // A compact fleet digest in the flight ring, so an abort dump shows
+    // what the fleet looked like on the way down (1 event per publish —
+    // ~6% of one tick's event budget).
+    char digest[96];
+    size_t off = size_t(snprintf(digest, sizeof(digest), "step_ms"));
+    for (int p = 0; p < process_count_ && off + 12 < sizeof(digest); ++p) {
+      if (!fleet_have_[size_t(p)]) continue;
+      off += size_t(snprintf(digest + off, sizeof(digest) - off,
+                             " %d:%.1f", p,
+                             double(fleet_samples_[size_t(p)].step_s) *
+                                 1e3));
+    }
+    FlightRecorder::Get().Record("FLEET", digest, valid, 0, 0);
+  }
+
+  // ---- regression sentinel (report-only) ----
+  // Step-time regressions come from the smoothed imposed-wait EWMAs:
+  // lockstep training charges a straggler's delay to every OTHER rank's
+  // step clock, so the trailer step times rise fleet-wide while the
+  // gather-skew waits single out the rank that is actually late — the
+  // same attribution signal the eviction policy trusts.
+  const double thr = SentinelThresholdS();
+  const int need_ticks = SentinelTicksKnob();
+  static std::atomic<long long>* a_step = Metrics::Get().Counter(
+      "sentinel.alerts#kind=" + std::string("step_time"));
+  static std::atomic<long long>* a_bw = Metrics::Get().Counter(
+      "sentinel.alerts#kind=" + std::string("bandwidth"));
+  for (int p = 0; p < process_count_; ++p) {
+    SentinelState& st = sentinel_[size_t(p)];
+    if (st.wait_ewma < 0) continue;
+    if (st.wait_ewma > thr) {
+      if (++st.step_ticks >= need_ticks && !st.step_latched) {
+        st.step_latched = true;
+        a_step->fetch_add(1, std::memory_order_relaxed);
+        const int rank = size_t(p) < all_first_ranks_.size()
+                             ? all_first_ranks_[size_t(p)]
+                             : p;
+        char detail[96];
+        snprintf(detail, sizeof(detail),
+                 "rank %d imposed wait %.1fms > %.1fms for %d gathers "
+                 "(step %.1fms)",
+                 rank, st.wait_ewma * 1e3, thr * 1e3, need_ticks,
+                 double(fleet_samples_[size_t(p)].step_s) * 1e3);
+        FlightRecorder::Get().Record("SENTINEL", detail, 0, rank, 0);
+        fprintf(stderr,
+                "htpu sentinel: step-time regression: %s (report-only)\n",
+                detail);
+      }
+    } else {
+      st.step_ticks = 0;
+      st.step_latched = false;   // recovery re-arms the latch
+    }
+  }
+  // Bandwidth collapse per DATA leg (classic/shm/uring): the ctrl leg is
+  // latency-dominated — a straggler's victims spend their tick waiting
+  // in RecvFrame, which would invert the attribution.  Suppressed
+  // outright while any step-time episode is live: the victims' duplex
+  // legs spend the straggler's delay blocked mid-transfer, so their
+  // goodput collapses too, and a bandwidth alert here would blame a
+  // healthy rank for the straggler's lateness.  The step-time alert
+  // already names the real culprit.
+  bool straggler_active = false;
+  for (int p = 0; p < process_count_; ++p) {
+    if (sentinel_[size_t(p)].step_ticks > 0 ||
+        sentinel_[size_t(p)].step_latched) {
+      straggler_active = true;
+      break;
+    }
+  }
+  if (straggler_active) return;
+  const double bw_factor = SentinelBwFactor();
+  for (int l = 0; l < 3; ++l) {
+    std::vector<double> bws;
+    for (int p = 0; p < process_count_; ++p) {
+      if (fleet_have_[size_t(p)] && fleet_samples_[size_t(p)].bw_bps[l] > 0)
+        bws.push_back(double(fleet_samples_[size_t(p)].bw_bps[l]));
+    }
+    if (bws.size() < 2) continue;
+    const double med = TrueMedian(bws);
+    for (int p = 0; p < process_count_; ++p) {
+      if (!fleet_have_[size_t(p)]) continue;
+      const double bw = double(fleet_samples_[size_t(p)].bw_bps[l]);
+      if (bw <= 0) continue;
+      SentinelState& st = sentinel_[size_t(p)];
+      if (bw * bw_factor < med) {
+        if (++st.bw_ticks[l] >= need_ticks && !st.bw_latched[l]) {
+          st.bw_latched[l] = true;
+          a_bw->fetch_add(1, std::memory_order_relaxed);
+          const int rank = size_t(p) < all_first_ranks_.size()
+                               ? all_first_ranks_[size_t(p)]
+                               : p;
+          char detail[96];
+          snprintf(detail, sizeof(detail),
+                   "rank %d %s leg %.2g MB/s vs fleet median %.2g MB/s",
+                   rank, LegName(Leg(l)), bw / 1e6, med / 1e6);
+          FlightRecorder::Get().Record("SENTINEL", detail, 0, rank, 0);
+          fprintf(stderr,
+                  "htpu sentinel: bandwidth collapse: %s (report-only)\n",
+                  detail);
+        }
+      } else {
+        st.bw_ticks[l] = 0;
+        st.bw_latched[l] = false;
+      }
     }
   }
 }
@@ -3596,14 +3883,22 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
       // Zero-copy fan-in/fan-out: one memcpy into the shared slot, none
       // of the UDS frame copies.  Still feeds ring.hier_local.* — the
       // leg's traffic contract is transport-independent.
-      if (!shm_->MemberPush(data, size_t(nbytes), timeout_ms_)) {
-        return shm_fail(my_leader, "fan-in");
+      {
+        XferScope obs(Leg::kShm);
+        if (!shm_->MemberPush(data, size_t(nbytes), timeout_ms_)) {
+          return shm_fail(my_leader, "fan-in");
+        }
+        obs.Done(size_t(nbytes), 0);
       }
       data_bytes_sent_ += nbytes;
       l_sent->fetch_add(nbytes, std::memory_order_relaxed);
       s_sent->fetch_add(nbytes, std::memory_order_relaxed);
-      if (!shm_->MemberPull(data, size_t(nbytes), timeout_ms_)) {
-        return shm_fail(my_leader, "fan-out");
+      {
+        XferScope obs(Leg::kShm);
+        if (!shm_->MemberPull(data, size_t(nbytes), timeout_ms_)) {
+          return shm_fail(my_leader, "fan-out");
+        }
+        obs.Done(0, size_t(nbytes));
       }
       data_bytes_recv_ += nbytes;
       l_recv->fetch_add(nbytes, std::memory_order_relaxed);
@@ -3634,6 +3929,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
     // the identical association order to the socket loop below, so the
     // two paths agree bit for bit.
     int lag = -1;
+    XferScope obs(Leg::kShm);
     if (!shm_->LeaderReduce(
             size_t(nbytes),
             [&](int /*mpos*/, const char* src, size_t off, size_t len) {
@@ -3648,6 +3944,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
     }
     const long long in_bytes =
         (long long)nbytes * (long long)(group_.size() - 1);
+    obs.Done(0, size_t(in_bytes));
     data_bytes_recv_ += in_bytes;
     l_recv->fetch_add(in_bytes, std::memory_order_relaxed);
     s_recv->fetch_add(in_bytes, std::memory_order_relaxed);
@@ -3677,6 +3974,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
 
   if (shm_) {
     int lag = -1;
+    XferScope obs(Leg::kShm);
     if (!shm_->LeaderBroadcast(data, size_t(nbytes), timeout_ms_, &lag)) {
       const int peer = (lag >= 0 && size_t(lag) + 1 < group_.size())
                            ? group_[size_t(lag) + 1]
@@ -3685,6 +3983,7 @@ bool ControlPlane::HierarchicalAllreduce(const std::string& dtype,
     }
     const long long out_bytes =
         (long long)nbytes * (long long)(group_.size() - 1);
+    obs.Done(size_t(out_bytes), 0);
     data_bytes_sent_ += out_bytes;
     l_sent->fetch_add(out_bytes, std::memory_order_relaxed);
     s_sent->fetch_add(out_bytes, std::memory_order_relaxed);

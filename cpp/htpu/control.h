@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "htpu/message_table.h"
+#include "htpu/observe.h"
 #include "htpu/process_set.h"
 #include "htpu/wire.h"
 
@@ -361,6 +362,18 @@ class ControlPlane {
                          const std::vector<bool>& have_arrival,
                          const std::vector<int32_t>& set_attr);
 
+  // ---- fleet observatory (coordinator, HOROVOD_TPU_OBSERVE=1) ----
+  // Store one telemetry-trailer sample for worker process `proc`.
+  void NoteFleetSample(int proc, const ObserveSample& s);
+  // Smooth this gather's median-anchored imposed waits into the
+  // sentinel's per-process EWMAs (report-only twin of the fleet
+  // policy's straggler signal).
+  void NoteSentinelWait(const std::vector<double>& wait_s);
+  // Per-gather observatory pass: refresh the coordinator's own fleet
+  // row, republish the fleet.* gauges every few ticks, and run the
+  // regression sentinel (step-time + per-leg bandwidth, latched alerts).
+  void RunObservatory();
+
   int process_index_ = 0;
   int process_count_ = 0;
   int first_rank_ = 0;
@@ -488,6 +501,28 @@ class ControlPlane {
   std::vector<ClockSync> clock_sync_;        // per process index
   std::vector<std::string> skew_names_;      // precomputed metric names
   std::vector<std::string> offset_names_;
+
+  // Fleet observatory state (coordinator): latest trailer sample per
+  // process, cached fleet.* gauge names, and the sentinel's latched
+  // hysteresis — all membership-keyed, cleared by FlushMembershipState.
+  std::vector<ObserveSample> fleet_samples_;
+  std::vector<char> fleet_have_;
+  int fleet_names_built_for_ = -1;
+  std::vector<std::string> fleet_step_names_;
+  std::vector<std::string> fleet_compute_names_;
+  std::vector<std::string> fleet_exposed_names_;
+  std::vector<std::string> fleet_stall_names_;
+  std::vector<std::string> fleet_steps_names_;
+  std::vector<std::string> fleet_wait_names_;
+  std::vector<std::string> fleet_bw_names_;   // flattened [proc*4 + leg]
+  struct SentinelState {
+    double wait_ewma = -1.0;   // smoothed imposed wait (gather skew)
+    int step_ticks = 0;        // consecutive over-threshold gathers
+    bool step_latched = false;  // one alert per regression episode
+    int bw_ticks[4] = {0, 0, 0, 0};
+    bool bw_latched[4] = {false, false, false, false};
+  };
+  std::vector<SentinelState> sentinel_;
 
   std::unique_ptr<MessageTable> table_;   // coordinator only
   // Non-default process sets (HOROVOD_TPU_PROCESS_SETS), coordinator only.

@@ -1,0 +1,258 @@
+#include "htpu/observe.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "htpu/metrics.h"
+
+namespace htpu {
+namespace {
+
+bool EnvFlag(const char* name, bool dflt) {
+  const char* e = getenv(name);
+  if (e == nullptr || *e == '\0') return dflt;
+  return !(strcmp(e, "0") == 0 || strcmp(e, "false") == 0 ||
+           strcmp(e, "FALSE") == 0);
+}
+
+std::atomic<bool>& EnabledFlag() {
+  // Seeded from the env once, then runtime-owned: the bench A/B and the
+  // tests flip it through ObserveSetEnabled without relaunching.
+  static std::atomic<bool> f{EnvFlag("HOROVOD_TPU_OBSERVE", false)};
+  return f;
+}
+
+// EWMA smoothing factor — matches the fleet policy's wait EWMAs so the
+// two smoothed views move on the same timescale.
+constexpr double kAlpha = 0.2;
+
+inline double Ewma(double prev, double v) {
+  return prev == 0.0 ? v : prev + kAlpha * (v - prev);
+}
+
+// Relaxed-atomic EWMA cell: racy read-modify-write is fine — this is
+// monitoring, and a lost update under contention skews one sample.
+struct EwmaCell {
+  std::atomic<double> v{0.0};
+  void Update(double sample) {
+    v.store(Ewma(v.load(std::memory_order_relaxed), sample),
+            std::memory_order_relaxed);
+  }
+  double Load() const { return v.load(std::memory_order_relaxed); }
+};
+
+struct LegState {
+  EwmaCell bw_bps;
+};
+
+LegState g_legs[4];
+std::atomic<long long> g_inflight{0};
+
+// Step decomposition EWMAs + count.
+EwmaCell g_step_s, g_compute_s, g_hidden_s, g_exposed_s, g_stall_s;
+std::atomic<long long> g_steps{0};
+
+// Size classes for the latency histograms: a 4 KiB verdict byte and a
+// 64 MiB fusion buffer should not share buckets' meaning.
+const char* SizeClass(size_t bytes) {
+  if (bytes < 64 * 1024) return "small";
+  if (bytes < 4 * 1024 * 1024) return "mid";
+  return "large";
+}
+
+double MonotonicSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void PutF32(std::string* s, float f) {
+  uint32_t u = 0;
+  memcpy(&u, &f, 4);
+  for (int i = 0; i < 4; ++i) s->push_back(char((u >> (8 * i)) & 0xff));
+}
+
+float ReadF32(const std::string& s, size_t off) {
+  uint32_t u = 0;
+  for (int i = 0; i < 4; ++i)
+    u |= uint32_t(uint8_t(s[off + size_t(i)])) << (8 * i);
+  float f = 0.0f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+}  // namespace
+
+bool ObserveEnabled() {
+  return EnabledFlag().load(std::memory_order_relaxed);
+}
+
+void ObserveSetEnabled(bool on) {
+  EnabledFlag().store(on, std::memory_order_relaxed);
+}
+
+double ObserveNow() {
+  return ObserveEnabled() ? MonotonicSeconds() : 0.0;
+}
+
+void RecordXfer(Leg leg, size_t sent, size_t recv, double seconds) {
+  if (!ObserveEnabled()) return;
+  Metrics& mx = Metrics::Get();
+  static std::atomic<long long>* ops[4] = {
+      mx.Counter("xfer.ops#leg=" + std::string(LegName(Leg::kClassic))),
+      mx.Counter("xfer.ops#leg=" + std::string(LegName(Leg::kShm))),
+      mx.Counter("xfer.ops#leg=" + std::string(LegName(Leg::kUring))),
+      mx.Counter("xfer.ops#leg=" + std::string(LegName(Leg::kCtrl)))};
+  static std::atomic<long long>* b_sent[4] = {
+      mx.Counter("xfer.bytes_sent#leg=" +
+                 std::string(LegName(Leg::kClassic))),
+      mx.Counter("xfer.bytes_sent#leg=" + std::string(LegName(Leg::kShm))),
+      mx.Counter("xfer.bytes_sent#leg=" +
+                 std::string(LegName(Leg::kUring))),
+      mx.Counter("xfer.bytes_sent#leg=" +
+                 std::string(LegName(Leg::kCtrl)))};
+  static std::atomic<long long>* b_recv[4] = {
+      mx.Counter("xfer.bytes_recv#leg=" +
+                 std::string(LegName(Leg::kClassic))),
+      mx.Counter("xfer.bytes_recv#leg=" + std::string(LegName(Leg::kShm))),
+      mx.Counter("xfer.bytes_recv#leg=" +
+                 std::string(LegName(Leg::kUring))),
+      mx.Counter("xfer.bytes_recv#leg=" +
+                 std::string(LegName(Leg::kCtrl)))};
+  const int li = int(leg);
+  ops[li]->fetch_add(1, std::memory_order_relaxed);
+  if (sent) b_sent[li]->fetch_add((long long)sent,
+                                  std::memory_order_relaxed);
+  if (recv) b_recv[li]->fetch_add((long long)recv,
+                                  std::memory_order_relaxed);
+  const size_t bytes = sent + recv;
+  if (seconds <= 0.0) return;
+  mx.Observe("xfer.latency_seconds#leg=" + std::string(LegName(leg)) +
+                 ",size=" + SizeClass(bytes),
+             seconds);
+  if (bytes == 0) return;
+  g_legs[li].bw_bps.Update(double(bytes) / seconds);
+  mx.SetGauge("xfer.bandwidth_bps#leg=" + std::string(LegName(leg)),
+              g_legs[li].bw_bps.Load());
+}
+
+XferScope::XferScope(Leg leg)
+    : leg_(leg), start_(0.0), armed_(ObserveEnabled()) {
+  if (!armed_) return;
+  start_ = MonotonicSeconds();
+  long long n = g_inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+  Metrics::Get().SetGauge("xfer.inflight", double(n));
+}
+
+XferScope::~XferScope() {
+  if (!armed_) return;
+  long long n = g_inflight.fetch_sub(1, std::memory_order_relaxed) - 1;
+  Metrics::Get().SetGauge("xfer.inflight", double(n < 0 ? 0 : n));
+}
+
+void XferScope::Done(size_t sent, size_t recv) {
+  if (!armed_) return;
+  RecordXfer(leg_, sent, recv, MonotonicSeconds() - start_);
+}
+
+void NoteStep(double step_s, double compute_s, double hidden_s,
+              double exposed_s, double stall_s) {
+  if (!ObserveEnabled()) return;
+  Metrics& mx = Metrics::Get();
+  static std::atomic<long long>* steps = mx.Counter("step.count");
+  steps->fetch_add(1, std::memory_order_relaxed);
+  g_steps.fetch_add(1, std::memory_order_relaxed);
+  g_step_s.Update(step_s);
+  g_compute_s.Update(compute_s);
+  g_hidden_s.Update(hidden_s);
+  g_exposed_s.Update(exposed_s);
+  g_stall_s.Update(stall_s);
+  mx.Observe("step.seconds", step_s);
+  mx.Observe("step.compute_seconds", compute_s);
+  mx.Observe("step.hidden_comm_seconds", hidden_s);
+  mx.Observe("step.exposed_comm_seconds", exposed_s);
+  mx.Observe("step.stall_seconds", stall_s);
+  mx.SetGauge("step.ewma_seconds", g_step_s.Load());
+}
+
+ObserveSample LocalObserveSample() {
+  ObserveSample s;
+  s.step_s = float(g_step_s.Load());
+  s.compute_s = float(g_compute_s.Load());
+  s.exposed_s = float(g_exposed_s.Load());
+  s.stall_s = float(g_stall_s.Load());
+  for (int l = 0; l < 4; ++l) s.bw_bps[l] = float(g_legs[l].bw_bps.Load());
+  s.steps = uint32_t(g_steps.load(std::memory_order_relaxed));
+  return s;
+}
+
+void AppendObserveTrailer(std::string* frame) {
+  const ObserveSample s = LocalObserveSample();
+  const size_t base = frame->size();
+  for (int i = 0; i < 4; ++i)
+    frame->push_back(char((kObserveTrailerMagic >> (8 * i)) & 0xff));
+  PutF32(frame, s.step_s);
+  PutF32(frame, s.compute_s);
+  PutF32(frame, s.exposed_s);
+  PutF32(frame, s.stall_s);
+  for (int l = 0; l < 4; ++l) PutF32(frame, s.bw_bps[l]);
+  for (int i = 0; i < 4; ++i)
+    frame->push_back(char((s.steps >> (8 * i)) & 0xff));
+  (void)base;
+}
+
+bool StripObserveTrailer(std::string* blob, ObserveSample* out) {
+  if (blob->size() < kObserveTrailerBytes) return false;
+  const size_t base = blob->size() - kObserveTrailerBytes;
+  uint32_t magic = 0;
+  for (int i = 0; i < 4; ++i)
+    magic |= uint32_t(uint8_t((*blob)[base + size_t(i)])) << (8 * i);
+  if (magic != kObserveTrailerMagic) return false;
+  size_t off = base + 4;
+  out->step_s = ReadF32(*blob, off);
+  out->compute_s = ReadF32(*blob, off + 4);
+  out->exposed_s = ReadF32(*blob, off + 8);
+  out->stall_s = ReadF32(*blob, off + 12);
+  for (int l = 0; l < 4; ++l)
+    out->bw_bps[l] = ReadF32(*blob, off + 16 + size_t(4 * l));
+  uint32_t steps = 0;
+  for (int i = 0; i < 4; ++i)
+    steps |= uint32_t(uint8_t((*blob)[off + 32 + size_t(i)])) << (8 * i);
+  out->steps = steps;
+  blob->resize(base);
+  return true;
+}
+
+std::string ObserveSnapshotJson() {
+  const ObserveSample s = LocalObserveSample();
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"enabled\":%s,\"steps\":%u,\"step_ewma_s\":%.9g,"
+           "\"compute_ewma_s\":%.9g,\"hidden_ewma_s\":%.9g,"
+           "\"exposed_ewma_s\":%.9g,\"stall_ewma_s\":%.9g,"
+           "\"inflight\":%lld,\"bw_bps\":{\"classic\":%.9g,\"shm\":%.9g,"
+           "\"uring\":%.9g,\"ctrl\":%.9g}}",
+           ObserveEnabled() ? "true" : "false", s.steps,
+           double(s.step_s), double(s.compute_s),
+           double(g_hidden_s.Load()), double(s.exposed_s),
+           double(s.stall_s),
+           g_inflight.load(std::memory_order_relaxed),
+           double(s.bw_bps[0]), double(s.bw_bps[1]), double(s.bw_bps[2]),
+           double(s.bw_bps[3]));
+  return std::string(buf);
+}
+
+void ObserveReset() {
+  for (int l = 0; l < 4; ++l)
+    g_legs[l].bw_bps.v.store(0.0, std::memory_order_relaxed);
+  g_inflight.store(0, std::memory_order_relaxed);
+  for (EwmaCell* c : {&g_step_s, &g_compute_s, &g_hidden_s, &g_exposed_s,
+                      &g_stall_s})
+    c->v.store(0.0, std::memory_order_relaxed);
+  g_steps.store(0, std::memory_order_relaxed);
+}
+
+}  // namespace htpu

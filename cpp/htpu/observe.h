@@ -1,0 +1,122 @@
+// Fleet performance observatory: always-on per-hop transfer telemetry,
+// step-time decomposition, and the fixed-size per-rank telemetry
+// trailer the coordinator aggregates into its live fleet view.
+//
+// Everything is gated behind HOROVOD_TPU_OBSERVE=1 (runtime-toggleable
+// through ObserveSetEnabled, so an in-process A/B can measure the
+// overhead without relaunching).  Disabled, the hot-path cost of every
+// instrumentation site is a single relaxed atomic load and the tick
+// frames stay byte-identical to the pre-observatory wire — the same
+// golden-frame contract the elastic, cache and integrity extensions
+// honour.  Enabled, a completed transfer costs a handful of relaxed
+// fetch_adds, one EWMA store and one histogram observation.
+//
+// The per-leg taxonomy is shared with the integrity layer (Leg /
+// LegName in integrity.h): classic duplex sockets, intra-host shm
+// rings, io_uring duplexes, and control frames.
+#ifndef HTPU_OBSERVE_H_
+#define HTPU_OBSERVE_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "htpu/integrity.h"
+
+namespace htpu {
+
+// HOROVOD_TPU_OBSERVE=1 arms the observatory.  Unlike the read-once
+// env latches, this is a live atomic: ObserveSetEnabled flips it at
+// runtime (the bench A/B and the tests drive both states in one
+// process).  The env value seeds it on first read.
+bool ObserveEnabled();
+void ObserveSetEnabled(bool on);
+
+// Monotonic seconds when the observatory is armed, 0.0 when it is off —
+// callers pair it with RecordXfer so a disabled observatory never pays
+// for a clock read.
+double ObserveNow();
+
+// One completed transfer on `leg`: `sent` + `recv` payload bytes moved
+// in `seconds` of wall time (poll waits included — the series reads as
+// goodput, which is what a hop-health view wants).  Feeds the
+// xfer.bytes_sent/bytes_recv/ops#leg= counters, the
+// xfer.latency_seconds#leg=,size= histograms and the per-leg bandwidth
+// EWMA behind xfer.bandwidth_bps#leg=.  No-op (one relaxed load) when
+// the observatory is off.
+void RecordXfer(Leg leg, size_t sent, size_t recv, double seconds);
+
+// RAII transfer scope for the instrumentation sites: tracks the
+// xfer.inflight gauge for the lifetime of the transfer and records the
+// clock pair on the success path only (a failed or timed-out transfer
+// must not pollute the bandwidth EWMA — failures already have their
+// own flight events).
+class XferScope {
+ public:
+  explicit XferScope(Leg leg);
+  ~XferScope();
+  void Done(size_t sent, size_t recv);   // success: RecordXfer(elapsed)
+
+ private:
+  Leg leg_;
+  double start_;
+  bool armed_;
+};
+
+// One training step's decomposition from the Python layer (the eager
+// overlap path or the make_train_step dispatch wrapper): total step
+// seconds plus the compute / hidden-comm / exposed-comm / stall split.
+// Feeds the step.* histograms and the EWMAs the telemetry trailer
+// ships to the coordinator.
+void NoteStep(double step_s, double compute_s, double hidden_s,
+              double exposed_s, double stall_s);
+
+// ------------------------------------------------ telemetry trailer
+
+// Fixed-size per-rank digest appended to the worker's tick frame when
+// the observatory is armed — BETWEEN the elastic/cache extensions and
+// the clock trailer (the clock trailer stays outermost; the
+// coordinator strips it first, then strips this one opportunistically
+// by magic + length, so mixed observe-on/off fleets interoperate with
+// no negotiation).  Observatory off: nothing is appended and the frame
+// bytes are identical to the pre-observatory wire.
+constexpr uint32_t kObserveTrailerMagic = 0x4f425348u;   // "HSBO" on wire
+constexpr size_t kObserveTrailerBytes = 4 + 4 * 4 + 4 * 4 + 4;   // 40
+
+struct ObserveSample {
+  float step_s = 0.0f;       // EWMA step seconds
+  float compute_s = 0.0f;    // EWMA compute seconds
+  float exposed_s = 0.0f;    // EWMA exposed-comm seconds
+  float stall_s = 0.0f;      // EWMA stall seconds
+  float bw_bps[4] = {0, 0, 0, 0};   // per-leg bandwidth EWMA, Leg order
+  uint32_t steps = 0;        // steps observed so far
+};
+
+// Appends this process's current ObserveSample as a trailer (caller
+// gates on ObserveEnabled()).
+void AppendObserveTrailer(std::string* frame);
+
+// Strips a telemetry trailer off `blob` into `out` if one is present;
+// returns false (blob untouched) otherwise.  Safe to call on frames
+// from observe-off peers.
+bool StripObserveTrailer(std::string* blob, ObserveSample* out);
+
+// This process's current sample (what AppendObserveTrailer would
+// ship) — the coordinator uses it for its own fleet-table row, since
+// its request list never crosses a socket.
+ObserveSample LocalObserveSample();
+
+// ------------------------------------------------- snapshot / reset
+
+// Compact JSON digest of the local telemetry state: enabled flag, step
+// EWMAs, per-leg bandwidth EWMAs, inflight count.  Served through
+// htpu_observe_snapshot.
+std::string ObserveSnapshotJson();
+
+// Zero every EWMA, count and inflight tracker (tests and the bench
+// A/B; the metric registry itself is reset separately).
+void ObserveReset();
+
+}  // namespace htpu
+
+#endif  // HTPU_OBSERVE_H_

@@ -33,6 +33,7 @@
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
 #include "htpu/metrics.h"
+#include "htpu/observe.h"
 #include "htpu/policy.h"
 #include "htpu/process_set.h"
 #include "htpu/scheduler.h"
@@ -44,6 +45,7 @@
 // c_api.cc is linked into this binary too; exercise the exported metrics
 // snapshot exactly as ctypes would, under the sanitizers.
 extern "C" int htpu_metrics_snapshot(void** out);
+extern "C" int htpu_observe_snapshot(void** out);
 extern "C" void htpu_free(void* p);
 
 namespace {
@@ -1206,6 +1208,206 @@ int RunIntegrityPhase() {
   return 0;
 }
 
+// One worker of the observatory's mini control round: a 2-process plane
+// ticking with the telemetry trailer armed while a reader thread polls
+// htpu_observe_snapshot concurrently — the exact shape a live job has
+// (executor ticking, exporter thread snapshotting).  After the fleet
+// publish cadence has fired, the coordinator must carry per-rank
+// fleet.* gauges aggregated from the trailers.
+int RunObserveControlProcess(int pidx, int port) {
+  constexpr int kObsProcs = 2;
+  setenv("HOROVOD_TPU_OBSERVE", "1", 1);
+  setenv("HOROVOD_TPU_HOST_FINGERPRINT", "smokeO", 1);
+  if (!htpu::ObserveEnabled()) return Fail(pidx, "observe env did not latch");
+  auto cp = htpu::ControlPlane::Create(pidx, kObsProcs, "127.0.0.1", port,
+                                       /*first_rank=*/pidx,
+                                       /*nranks_total=*/kObsProcs,
+                                       /*timeout_ms=*/20000);
+  if (!cp) return Fail(pidx, "observe ControlPlane::Create");
+
+  htpu::RequestList idle;
+  std::string tick_blob, resp;
+  htpu::SerializeRequestList(idle, &tick_blob);
+
+  std::atomic<bool> stop{false};
+  std::atomic<int> snaps{0};
+  std::thread reader([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      void* buf = nullptr;
+      int len = htpu_observe_snapshot(&buf);
+      if (len > 0 && buf != nullptr) {
+        snaps.fetch_add(1, std::memory_order_relaxed);
+        htpu_free(buf);
+      }
+      std::this_thread::yield();
+    }
+  });
+  bool ok = true;
+  for (int i = 0; ok && i < 48; ++i) {   // > 2 fleet publish windows
+    htpu::NoteStep(0.010 * (pidx + 1), 0.008, 0.0, 0.001, 0.001);
+    ok = cp->Tick(tick_blob, 0, &resp);
+    if (ok && i % 8 == 0) {
+      std::vector<float> buf(256, float(pidx + 1));
+      ok = cp->AllreduceBuf("float32", reinterpret_cast<char*>(buf.data()),
+                            int64_t(buf.size() * sizeof(float)), "");
+    }
+  }
+  stop.store(true, std::memory_order_release);
+  reader.join();
+  if (!ok) return Fail(pidx, "observe tick/allreduce");
+  if (snaps.load() <= 0) return Fail(pidx, "observe reader saw no snapshots");
+
+  if (pidx == 0) {
+    // The coordinator must have aggregated the workers' trailers into
+    // per-rank fleet gauges by now (publish cadence is 16 ticks).
+    void* buf = nullptr;
+    int len = htpu_metrics_snapshot(&buf);
+    if (len <= 0 || !buf) return Fail(pidx, "observe metrics snapshot");
+    std::string js(static_cast<const char*>(buf), size_t(len));
+    htpu_free(buf);
+    for (const char* key : {"\"fleet.ranks\":",
+                            "\"fleet.step_seconds#rank=1\":",
+                            "\"fleet.steps#rank=1\":"}) {
+      if (js.find(key) == std::string::npos) {
+        fprintf(stderr, "smoke proc %d: missing %s\n", pidx, key);
+        return Fail(pidx, "fleet gauge missing after trailer rounds");
+      }
+    }
+  }
+  fprintf(stderr, "smoke proc %d: observe control round OK (%d snaps)\n",
+          pidx, snaps.load());
+  return 0;
+}
+
+// Observatory phase (forked child: HOROVOD_TPU_OBSERVE must not leak
+// into the classic rounds, whose frames are expected byte-identical to
+// the legacy wire).
+//
+//  (a) the telemetry primitives hammered from two threads — XferScope /
+//      RecordXfer / NoteStep on this thread, htpu_observe_snapshot and
+//      trailer append/strip on the other — TSan proves the relaxed EWMA
+//      cells and inflight gauge against concurrent snapshot reads;
+//  (b) trailer round-trip: append onto a payload, strip back, payload
+//      untouched and the sample carries what was recorded — plus the
+//      golden-frame contract (off: nothing appended; a non-trailer blob
+//      never strips);
+//  (c) a live 2-process control round with the trailer armed — fleet
+//      aggregation on the coordinator under concurrent snapshot reads.
+int RunObservePhase() {
+  setenv("HOROVOD_TPU_OBSERVE", "1", 1);
+  if (!htpu::ObserveEnabled()) {
+    fprintf(stderr, "smoke: HOROVOD_TPU_OBSERVE=1 did not latch\n");
+    return 1;
+  }
+
+  // --- (a) concurrent hammer.
+  {
+    std::atomic<bool> stop{false};
+    std::atomic<bool> bad{false};
+    std::thread reader([&] {
+      while (!stop.load(std::memory_order_acquire)) {
+        void* buf = nullptr;
+        int len = htpu_observe_snapshot(&buf);
+        if (len <= 0 || buf == nullptr) {
+          bad.store(true);
+          return;
+        }
+        htpu_free(buf);
+        std::string frame = "payload";
+        htpu::AppendObserveTrailer(&frame);
+        htpu::ObserveSample s;
+        if (!htpu::StripObserveTrailer(&frame, &s) || frame != "payload") {
+          bad.store(true);
+          return;
+        }
+        std::this_thread::yield();
+      }
+    });
+    for (int i = 0; i < 20000; ++i) {
+      htpu::XferScope sc(htpu::Leg(i % 4));
+      sc.Done(4096, 4096);
+      htpu::RecordXfer(htpu::Leg(i % 4), 1 << 16, 0, 1e-4);
+      if (i % 16 == 0) htpu::NoteStep(0.01, 0.008, 0.001, 0.0005, 0.0005);
+    }
+    stop.store(true, std::memory_order_release);
+    reader.join();
+    if (bad.load()) {
+      fprintf(stderr, "smoke: observe concurrent hammer failed\n");
+      return 1;
+    }
+  }
+
+  // --- (b) trailer round-trip + golden-frame contract.
+  {
+    std::string frame = "tickbytes";
+    htpu::AppendObserveTrailer(&frame);
+    if (frame.size() != 9 + htpu::kObserveTrailerBytes) {
+      fprintf(stderr, "smoke: trailer size wrong (%zu)\n", frame.size());
+      return 1;
+    }
+    htpu::ObserveSample s;
+    if (!htpu::StripObserveTrailer(&frame, &s) || frame != "tickbytes" ||
+        s.steps == 0 || s.step_s <= 0.0f || s.bw_bps[0] <= 0.0f) {
+      fprintf(stderr, "smoke: trailer round-trip lost the sample\n");
+      return 1;
+    }
+    // A frame that never carried a trailer must never strip, whatever
+    // its length.
+    std::string plain(64, 'x');
+    if (htpu::StripObserveTrailer(&plain, &s) || plain.size() != 64) {
+      fprintf(stderr, "smoke: non-trailer blob stripped\n");
+      return 1;
+    }
+    // Off: the clock never reads and the local sample freezes; the
+    // caller gates Append on ObserveEnabled so frames stay legacy.
+    htpu::ObserveSetEnabled(false);
+    if (htpu::ObserveNow() != 0.0) {
+      fprintf(stderr, "smoke: ObserveNow live while disabled\n");
+      return 1;
+    }
+    htpu::RecordXfer(htpu::Leg::kClassic, 1 << 20, 0, 1e-3);   // must no-op
+    htpu::ObserveSetEnabled(true);
+    htpu::ObserveReset();
+    const htpu::ObserveSample z = htpu::LocalObserveSample();
+    if (z.steps != 0 || z.step_s != 0.0f || z.bw_bps[0] != 0.0f) {
+      fprintf(stderr, "smoke: ObserveReset left state behind\n");
+      return 1;
+    }
+  }
+
+  // --- (c) live control round with the trailer armed.
+  int port = FreePort();
+  if (port < 0) {
+    fprintf(stderr, "smoke: no free port for observe round\n");
+    return 1;
+  }
+  pid_t pids[2];
+  for (int p = 0; p < 2; ++p) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (pid == 0) _exit(RunObserveControlProcess(p, port));
+    pids[p] = pid;
+  }
+  int rc = 0;
+  for (int p = 0; p < 2; ++p) {
+    int st = 0;
+    waitpid(pids[p], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr, "smoke: observe proc %d exited abnormally (status %d)\n",
+              p, st);
+      rc = 1;
+    }
+  }
+  if (rc == 0) {
+    fprintf(stderr,
+            "smoke: observatory OK (hammer, trailer, 2-proc fleet round)\n");
+  }
+  return rc;
+}
+
 }  // namespace
 
 int main() {
@@ -1225,6 +1427,23 @@ int main() {
     waitpid(ipid, &st, 0);
     if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
       fprintf(stderr, "smoke: integrity phase failed (status %d)\n", st);
+      return 1;
+    }
+  }
+  // Observatory phase, likewise forked: HOROVOD_TPU_OBSERVE must stay
+  // out of the classic rounds' environment (their frames are checked
+  // against the legacy byte-identical wire).
+  {
+    pid_t opid = fork();
+    if (opid < 0) {
+      perror("fork");
+      return 1;
+    }
+    if (opid == 0) _exit(RunObservePhase());
+    int st = 0;
+    waitpid(opid, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      fprintf(stderr, "smoke: observe phase failed (status %d)\n", st);
       return 1;
     }
   }

@@ -19,6 +19,7 @@
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
 #include "htpu/metrics.h"
+#include "htpu/observe.h"
 
 namespace htpu {
 
@@ -190,6 +191,7 @@ int AcceptEither(int listen_fd_a, int listen_fd_b, int timeout_ms) {
 }
 
 bool SendFrame(int fd, const std::string& payload) {
+  XferScope obs(Leg::kCtrl);
   if (payload.size() > kMaxFrameBytes) {
     fprintf(stderr,
             "htpu transport: refusing to send a %zu-byte frame (cap %llu "
@@ -285,10 +287,12 @@ bool SendFrame(int fd, const std::string& payload) {
   frames->fetch_add(1, std::memory_order_relaxed);
   bytes->fetch_add(4 + static_cast<long long>(len),
                    std::memory_order_relaxed);
+  obs.Done(4 + len, 0);
   return true;
 }
 
 bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
+  XferScope obs(Leg::kCtrl);
   uint8_t hdr[4];
   if (!RecvAll(fd, hdr, 4, timeout_ms)) {
     // EOF, error, or the poll deadline lapsing with no header — this is
@@ -341,6 +345,7 @@ bool RecvFrame(int fd, std::string* payload, int timeout_ms) {
   frames->fetch_add(1, std::memory_order_relaxed);
   bytes->fetch_add(4 + static_cast<long long>(len),
                    std::memory_order_relaxed);
+  obs.Done(0, 4 + len);
   return true;
 }
 
@@ -349,6 +354,7 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
                     int timeout_ms, int* failed_fd, const char* send_tr,
                     char* recv_tr) {
   constexpr size_t kSliceBytes = 1 << 20;
+  XferScope obs(Leg::kClassic);
   if (failed_fd) *failed_fd = -1;
   const size_t total_send = send_len + (send_tr ? kTrailerBytes : 0);
   const size_t total_recv = recv_len + (recv_tr ? kTrailerBytes : 0);
@@ -462,6 +468,7 @@ bool DuplexTransfer(int send_fd, const char* send_buf, size_t send_len,
       }
     }
   }
+  obs.Done(total_send, total_recv);
   return true;
 }
 

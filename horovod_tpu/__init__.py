@@ -67,6 +67,9 @@ from horovod_tpu.basics import (           # noqa: F401
 # Callable module: ``hvd.metrics()`` returns the merged snapshot while
 # ``hvd.metrics.registry`` / ``.prometheus_text()`` expose the machinery.
 from horovod_tpu import metrics        # noqa: F401, E402
+# Callable module: ``hvd.observe()`` returns the merged local+fleet
+# observatory view; ``hvd.observe.note_step`` feeds the decomposition.
+from horovod_tpu import observe        # noqa: F401, E402
 from horovod_tpu.ops.eager import (        # noqa: F401
     allreduce, allreduce_async, allgather, allgather_async, broadcast,
     broadcast_async, poll, synchronize, PerRank, scatter_ranks,

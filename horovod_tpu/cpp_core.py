@@ -196,6 +196,33 @@ def _configure(lib) -> None:
         lib.htpu_flight_snapshot.restype = ctypes.c_int
         lib.htpu_flight_snapshot.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    # Fleet observatory (guarded: a prebuilt .so from before the
+    # observatory still loads for the rest of the surface).
+    if hasattr(lib, "htpu_observe_enabled"):
+        lib.htpu_observe_enabled.restype = ctypes.c_int
+        lib.htpu_observe_enabled.argtypes = []
+        lib.htpu_observe_set_enabled.restype = None
+        lib.htpu_observe_set_enabled.argtypes = [ctypes.c_int]
+        lib.htpu_observe_note_step.restype = None
+        lib.htpu_observe_note_step.argtypes = [
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double]
+        lib.htpu_observe_record_xfer.restype = None
+        lib.htpu_observe_record_xfer.argtypes = [
+            ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_double]
+        lib.htpu_observe_snapshot.restype = ctypes.c_int
+        lib.htpu_observe_snapshot.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_observe_reset.restype = None
+        lib.htpu_observe_reset.argtypes = []
+        lib.htpu_observe_trailer_encode.restype = ctypes.c_int
+        lib.htpu_observe_trailer_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_observe_trailer_probe.restype = ctypes.c_int
+        lib.htpu_observe_trailer_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p)]
     # Scheduler API (guarded: a prebuilt .so predating the plane-agnostic
     # scheduler still loads for the rest of the surface).
     if hasattr(lib, "htpu_sched_create"):
@@ -917,6 +944,94 @@ def metrics_reset() -> None:
     lib = load()
     if lib is not None:
         lib.htpu_metrics_reset()
+
+
+def observe_enabled():
+    """Native observatory state: True/False, or ``None`` when the native
+    core is unavailable or predates the observatory."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_observe_enabled"):
+        return None
+    return bool(lib.htpu_observe_enabled())
+
+
+def observe_set_enabled(on: bool) -> None:
+    """Flip the native observatory at runtime (bench A/B, tests)."""
+    lib = load()
+    if lib is not None and hasattr(lib, "htpu_observe_set_enabled"):
+        lib.htpu_observe_set_enabled(1 if on else 0)
+
+
+def observe_note_step(step_s: float, compute_s: float = 0.0,
+                      hidden_s: float = 0.0, exposed_s: float = 0.0,
+                      stall_s: float = 0.0) -> bool:
+    """Feed one step's decomposition to the native observatory; returns
+    False when the native core is unavailable (caller falls back to the
+    Python registry)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_observe_note_step"):
+        return False
+    lib.htpu_observe_note_step(step_s, compute_s, hidden_s, exposed_s,
+                               stall_s)
+    return True
+
+
+def observe_snapshot() -> dict:
+    """Local telemetry digest (step EWMAs, per-leg bandwidth EWMAs,
+    inflight) as a dict; empty when the native core is unavailable."""
+    import json
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_observe_snapshot"):
+        return {}
+    out = ctypes.c_void_p()
+    n = lib.htpu_observe_snapshot(ctypes.byref(out))
+    if n < 0:
+        return {}
+    return json.loads(_take_buffer(lib, out, n).decode("utf-8"))
+
+
+def observe_reset() -> None:
+    """Zero the native observatory EWMAs and counts (tests, bench A/B)."""
+    lib = load()
+    if lib is not None and hasattr(lib, "htpu_observe_reset"):
+        lib.htpu_observe_reset()
+
+
+def observe_record_xfer(leg: int, sent_bytes: int, recv_bytes: int,
+                        seconds: float) -> None:
+    """Test seam: record one transfer on leg 0..3 (classic/shm/uring/
+    ctrl) without driving a real job."""
+    lib = load()
+    if lib is not None and hasattr(lib, "htpu_observe_record_xfer"):
+        lib.htpu_observe_record_xfer(leg, sent_bytes, recv_bytes, seconds)
+
+
+def observe_trailer_encode() -> bytes:
+    """The telemetry trailer this process would append to its next tick
+    frame — b"" when the observatory is off (golden-frame contract)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_observe_trailer_encode"):
+        return b""
+    out = ctypes.c_void_p()
+    n = lib.htpu_observe_trailer_encode(ctypes.byref(out))
+    if n <= 0:
+        return b""
+    return _take_buffer(lib, out, n)
+
+
+def observe_trailer_probe(blob: bytes) -> dict:
+    """Strip-probe arbitrary frame bytes the way the coordinator does:
+    ``{"stripped": bool, "payload_len": int, "sample": {...}}``; empty
+    dict when the native core is unavailable."""
+    import json
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_observe_trailer_probe"):
+        return {}
+    out = ctypes.c_void_p()
+    n = lib.htpu_observe_trailer_probe(blob, len(blob), ctypes.byref(out))
+    if n < 0:
+        return {}
+    return json.loads(_take_buffer(lib, out, n).decode("utf-8"))
 
 
 def crc32c_native(data: bytes):

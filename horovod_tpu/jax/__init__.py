@@ -327,6 +327,7 @@ def _overlapped_allreduce(leaves, treedef, *, average, compression,
     actually waited on after backward finished.
     """
     from horovod_tpu import sparse as _sparse
+    t_entry = time.perf_counter()
     arrs = [None if _is_sparse(l) else _as_leaf(l) for l in leaves]
     fp32 = [i for i, a in enumerate(arrs)
             if a is not None and jnp.result_type(a) == jnp.float32]
@@ -412,6 +413,15 @@ def _overlapped_allreduce(leaves, treedef, *, average, compression,
         _metrics.observe("overlap.exposed_seconds", exposed)
         if comm_span > 0:
             _metrics.observe("overlap.hidden_fraction", hidden / comm_span)
+        # Observatory decomposition for the eager overlap step: the span
+        # from entry to backward-done is compute (comm hides under it),
+        # the post-backward tail is exposed comm, and whatever wall time
+        # neither bucket accounts for is stall.
+        from horovod_tpu import observe as _observe
+        step_s = max(0.0, t_comm_done - t_entry)
+        compute_s = max(0.0, t_backward_done - t_entry)
+        stall_s = max(0.0, step_s - compute_s - exposed)
+        _observe.note_step(step_s, compute_s, hidden, exposed, stall_s)
     # Drain the up-front (sparse / non-f32) handles.
     for i, h in handles.items():
         if isinstance(h, tuple):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Callable, Tuple
 
 import jax
@@ -331,6 +332,39 @@ def _wire_metrics(fn, mesh, compression, steps_per_call: int):
     return _wrap_with_stages(fn, around)
 
 
+def _wire_observe(fn, steps_per_call: int):
+    """Observatory step decomposition for the in-jit path.  Dispatch is
+    async — the host call returns before the device finishes — so the
+    device-step wall time is the *inter-dispatch* delta: once the
+    pipeline is primed, the host re-enters dispatch exactly once per
+    executed call, and any time it spends blocked *inside* dispatch
+    (donation back-pressure, the runtime throttling enqueue) is stall
+    the device pipeline could not hide.  Compute is the remainder;
+    in-jit collectives are compiled into the program, so hidden/exposed
+    comm are not separable here and are reported as zero (the eager
+    overlap path owns those series)."""
+    from horovod_tpu import observe as _observe
+
+    t_prev = [0.0]
+
+    def around(target, args, kwargs):
+        t_in = time.perf_counter()
+        out = target(*args, **kwargs)
+        if not _observe.enabled():
+            t_prev[0] = 0.0
+            return out
+        t_out = time.perf_counter()
+        stall_s = (t_out - t_in) / steps_per_call
+        if t_prev[0] > 0.0:
+            step_s = max(0.0, (t_out - t_prev[0]) / steps_per_call)
+            _observe.note_step(step_s, max(0.0, step_s - stall_s),
+                               0.0, 0.0, stall_s)
+        t_prev[0] = t_out
+        return out
+
+    return _wrap_with_stages(fn, around)
+
+
 def _ordering_guard(fn, what: str = "make_train_step"):
     """Enforce the shared-runtime async-eager ordering contract at every
     dispatch: launching this jitted collective program while ``*_async``
@@ -552,7 +586,7 @@ def make_train_step(
     wire_identity = (compression is NoneCompressor
                      or isinstance(compression, NoneCompressor))
     if mesh.size > 1 or not wire_identity:
-        return spans.instrument(spmd_step)
+        return spans.instrument(_wire_observe(spmd_step, steps_per_call))
 
     # Single-chip fast path: on a 1-device mesh every collective is the
     # identity, but the shard_map wrapper still costs ~2% wall-clock
@@ -611,7 +645,7 @@ def make_train_step(
         return _resolve(args)(*args)
 
     dispatch.lower = lambda *args: _resolve(args).lower(*args)
-    return spans.instrument(dispatch)
+    return spans.instrument(_wire_observe(dispatch, steps_per_call))
 
 
 def _sync_or_check_aux(new_aux, axes, sync_aux_state: bool):

@@ -169,8 +169,9 @@ def _prom_name_and_labels(name: str) -> Tuple[str, str]:
 
 def prometheus_text(snap: Optional[dict] = None) -> str:
     """Render a snapshot as the Prometheus text exposition format
-    (version 0.0.4): ``# TYPE`` headers, counters/gauges as samples,
-    histograms as the standard ``_bucket{le=...}/_sum/_count`` triple."""
+    (version 0.0.4): ``# HELP``/``# TYPE`` headers per family,
+    counters/gauges as samples, histograms as the standard
+    ``_bucket{le=...}/_sum/_count`` triple."""
     if snap is None:
         snap = snapshot()
     lines: List[str] = []
@@ -179,6 +180,7 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     def type_header(prom: str, kind: str):
         if prom not in typed:
             typed.add(prom)
+            lines.append(f"# HELP {prom} horovod_tpu {kind}")
             lines.append(f"# TYPE {prom} {kind}")
 
     for name in sorted(snap.get("counters", {})):

@@ -134,3 +134,52 @@ class TestPinCpuHalf:
         pinned = _fake_topology(monkeypatch, bench_mod, [0], {0: (0, 0)})
         assert not bench_mod._pin_cpu_half(0)
         assert "mask" not in pinned
+
+
+class TestBenchSummary:
+    """write_bench_summary: the consolidated BENCH_rNN.json artifact."""
+
+    REPORT = {
+        "step_time_ms": 123.4,
+        "mfu": 0.33,
+        "transformer_lm": {"step_time_ms": 516.9, "mfu": 0.74},
+        "scaling_virtual_8dev": {"scaling_efficiency": 0.12},
+        "scaling_tcp_2proc": {
+            "scaling_efficiency": 0.33,
+            "comm_fraction": 0.35,
+            "wire_compression": {"fp32": {"step_time_ms": 42.0}},
+            "overlap_ab": {"off": {}, "on": {}},
+            "xport_sweep": {"shm_vs_uds_speedup_256k_plus": 1.4,
+                            "crc_overhead_256k_plus": {"max": 0.03}},
+            "observe_ab": {"off": {"step_time_ms": 40.0},
+                           "on": {"step_time_ms": 40.4},
+                           "overhead_fraction": 0.01},
+        },
+    }
+
+    def test_headlines_extracted(self, tmp_path, bench_mod):
+        import json
+        path = str(tmp_path / "BENCH_r06.json")
+        assert bench_mod.write_bench_summary(self.REPORT, path) == path
+        s = json.loads(open(path).read())
+        assert s["resnet_step_time_ms"] == 123.4
+        assert s["transformer_mfu"] == 0.74
+        assert s["tcp_scaling_efficiency"] == 0.33
+        assert s["tcp_step_time_ms"] == 42.0
+        assert s["crc_overhead_256k_plus"] == 0.03
+        assert s["observe_ab"]["overhead_fraction"] == 0.01
+
+    def test_missing_legs_become_none_not_errors(self, tmp_path, bench_mod):
+        import json
+        path = str(tmp_path / "s.json")
+        assert bench_mod.write_bench_summary({}, path) == path
+        s = json.loads(open(path).read())
+        assert s["observe_ab"] is None and s["resnet_mfu"] is None
+
+    def test_empty_path_skips(self, bench_mod, monkeypatch):
+        monkeypatch.setenv("BENCH_SUMMARY_FILE", "")
+        assert bench_mod.write_bench_summary({}) is None
+
+    def test_unwritable_path_returns_none(self, bench_mod, tmp_path):
+        assert bench_mod.write_bench_summary(
+            {}, str(tmp_path / "no" / "dir" / "s.json")) is None

@@ -100,6 +100,10 @@ class TestPrometheusText:
         hm.registry.observe("test.parse_lat", 0.01)
         try:
             for line in hm.prometheus_text().splitlines():
+                if line.startswith("# HELP "):
+                    _, _, name, text = line.split(" ", 3)
+                    assert name and text, line
+                    continue
                 if line.startswith("#"):
                     _, _, name, kind = line.split(" ", 3)
                     assert kind in ("counter", "gauge", "histogram")
@@ -109,6 +113,103 @@ class TestPrometheusText:
                 assert name_labels and name_labels[0].isalpha()
         finally:
             hm.registry.clear()
+
+
+class TestPrometheusRoundTrip:
+    """Parse the FULL 0.0.4 text output back into structures and check
+    it against the snapshot it was rendered from — so new families
+    (``fleet.*``/``xfer.*``/``step.*``/``sentinel.*``) can't silently
+    break the exporter."""
+
+    SNAP = {
+        "counters": {
+            "xfer.bytes_sent#leg=classic": 1048576,
+            "xfer.bytes_sent#leg=ctrl": 4096,
+            "sentinel.alerts#kind=step_time": 1,
+            "step.count": 12,
+            # Label value exercising the escaping rules.
+            'evil#msg=a"b\\c': 3,
+        },
+        "gauges": {
+            "fleet.step_seconds#rank=0": 0.0125,
+            "fleet.bandwidth_bps#rank=1,leg=classic": 2.5e9,
+        },
+        "histograms": {
+            "step.seconds": {"bounds": [0.01, 0.1], "counts": [7, 4, 1],
+                             "sum": 0.42, "count": 12},
+        },
+    }
+
+    @staticmethod
+    def _parse(txt):
+        helps, types, samples = {}, {}, []
+        order = []   # (family, kind-of-line) in emission order
+        for line in txt.splitlines():
+            assert line == line.strip() and line, repr(line)
+            if line.startswith("# HELP "):
+                _, _, rest = line.partition("# HELP ")
+                fam, _, text = rest.partition(" ")
+                assert fam not in helps, f"duplicate HELP for {fam}"
+                helps[fam] = text
+                order.append((fam, "help"))
+            elif line.startswith("# TYPE "):
+                _, _, rest = line.partition("# TYPE ")
+                fam, _, kind = rest.partition(" ")
+                assert fam not in types, f"duplicate TYPE for {fam}"
+                types[fam] = kind
+                order.append((fam, "type"))
+            else:
+                name_labels, _, value = line.rpartition(" ")
+                if "{" in name_labels:
+                    name, _, rest = name_labels.partition("{")
+                    labels = rest.rstrip("}")
+                else:
+                    name, labels = name_labels, ""
+                samples.append((name, labels, float(value)))
+        return helps, types, samples, order
+
+    def test_every_family_has_help_then_type(self):
+        helps, types, samples, order = self._parse(
+            hm.prometheus_text(self.SNAP))
+        fams = set()
+        for name, _, _ in samples:
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if types.get(base) != "histogram" and name.endswith(suffix):
+                    cand = name[: -len(suffix)]
+                    if types.get(cand) == "histogram":
+                        base = cand
+            fams.add(base)
+        for fam in fams:
+            assert fam in helps, f"{fam} missing HELP"
+            assert fam in types, f"{fam} missing TYPE"
+            assert types[fam] in ("counter", "gauge", "histogram")
+            assert order.index((fam, "help")) + 1 == \
+                order.index((fam, "type")), f"{fam}: HELP must precede TYPE"
+
+    def test_histogram_bucket_consistency(self):
+        _, _, samples, _ = self._parse(hm.prometheus_text(self.SNAP))
+        buckets = [(l, v) for n, l, v in samples
+                   if n == "htpu_step_seconds_bucket"]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), buckets   # cumulative
+        assert buckets[-1][0] == 'le="+Inf"'
+        total = [v for n, _, v in samples if n == "htpu_step_seconds_count"]
+        assert total == [12.0] and counts[-1] == 12.0
+        s = [v for n, _, v in samples if n == "htpu_step_seconds_sum"]
+        assert s == [pytest.approx(0.42)]
+
+    def test_label_values_round_trip(self):
+        _, _, samples, _ = self._parse(hm.prometheus_text(self.SNAP))
+        by_name = {}
+        for n, l, v in samples:
+            by_name.setdefault(n, []).append((l, v))
+        assert ('leg="classic"', 1048576.0) in by_name["htpu_xfer_bytes_sent"]
+        assert ('rank="1",leg="classic"', 2.5e9) in \
+            by_name["htpu_fleet_bandwidth_bps"]
+        assert ('kind="step_time"', 1.0) in by_name["htpu_sentinel_alerts"]
+        # The escaped quote/backslash survived and the line still parsed.
+        assert ('msg="a\\"b\\\\c"', 3.0) in by_name["htpu_evil"]
 
 
 # ------------------------------------------------------- merge + native
@@ -229,10 +330,14 @@ METRICS_WORKER = textwrap.dedent("""
         assert c.get(key, 0) > 0, (key, c)
     # ...and their sum reconciles EXACTLY with the transport's own
     # data-plane totals (the counters are incremented at the same sites).
+    # Only the logical per-collective families count here: ring.uring./
+    # ring.shm./ring.hier_local. re-bucket the SAME traffic by transport
+    # leg, so including them would double-count whichever leg negotiated.
+    logical = ("ring.allreduce.", "ring.allgather.", "ring.broadcast.")
     ring_sent = sum(v for k, v in c.items()
-                    if k.startswith("ring.") and ".bytes_sent" in k)
+                    if k.startswith(logical) and ".bytes_sent" in k)
     ring_recvd = sum(v for k, v in c.items()
-                     if k.startswith("ring.") and ".bytes_recv" in k)
+                     if k.startswith(logical) and ".bytes_recv" in k)
     assert ring_sent == sent, (ring_sent, sent, c)
     assert ring_recvd == recvd, (ring_recvd, recvd, c)
     # int8 moved ~1/4 the bytes of the raw fp32 pass on the same payload.

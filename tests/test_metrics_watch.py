@@ -149,6 +149,70 @@ class TestXportDigest:
         assert "zero-copy transports" in out
 
 
+class TestObservatoryDigest:
+    """Fleet-observatory digest (PR: fleet performance observatory)."""
+
+    def _snap(self):
+        def hist(total, count):
+            return {"bounds": [0.001, 0.01, 0.1], "counts": [count, 0, 0, 0],
+                    "sum": total, "count": count}
+        return {"rank": 0, "ts": 100,
+                "counters": {"xfer.ops#leg=classic": 240,
+                             "xfer.bytes_sent#leg=classic": 31457280,
+                             "xfer.bytes_recv#leg=classic": 31457280,
+                             "xfer.ops#leg=ctrl": 500,
+                             "xfer.bytes_sent#leg=ctrl": 40960,
+                             "xfer.bytes_recv#leg=ctrl": 61440,
+                             "step.count": 120,
+                             "sentinel.alerts#kind=step_time": 1,
+                             "sentinel.alerts#kind=bandwidth": 0},
+                "gauges": {"xfer.bandwidth_bps#leg=classic": 2.5e9,
+                           "fleet.ranks": 2},
+                "histograms": {
+                    "xfer.latency_seconds#leg=classic,size=mid":
+                        hist(0.48, 240),
+                    "step.seconds": hist(1.2, 120),
+                    "step.compute_seconds": hist(0.96, 120),
+                    "step.exposed_comm_seconds": hist(0.12, 120)}}
+
+    def test_one_line_per_engaged_hop(self):
+        lines = metrics_watch.render_observatory_summary(self._snap(), "")
+        text = "\n".join(lines)
+        assert "-- observatory --" in text
+        classic = next(ln for ln in lines if "xfer[classic]" in ln)
+        assert "ops=240" in classic and "sent=30.0MiB" in classic
+        assert "bw=2.3GiB/s" in classic and "p50_mid=" in classic
+        ctrl = next(ln for ln in lines if "xfer[ctrl]" in ln)
+        assert "ops=500" in ctrl
+        # Quiet legs stay off the digest entirely.
+        assert not any("xfer[shm]" in ln or "xfer[uring]" in ln
+                       for ln in lines)
+
+    def test_step_decomposition_and_fleet_line(self):
+        lines = metrics_watch.render_observatory_summary(self._snap(), "")
+        step = next(ln for ln in lines if ln.lstrip().startswith("step"))
+        assert "steps=120" in step and "p50_step=" in step \
+            and "p50_compute=" in step and "exposed_tail=0.12s" in step
+        fleet = next(ln for ln in lines if "fleet" in ln)
+        assert "ranks=2" in fleet
+
+    def test_alerts_are_loud_and_zero_kinds_stay_dark(self):
+        lines = metrics_watch.render_observatory_summary(self._snap(), "")
+        sentinel = next(ln for ln in lines if "SENTINEL_ALERTS" in ln)
+        assert "SENTINEL_ALERTS[step_time]=1" in sentinel
+        # The eagerly-registered bandwidth kind sits at zero: not shown.
+        assert "bandwidth" not in sentinel
+
+    def test_absent_with_observe_off(self):
+        snap = {"counters": {"control.ticks": 3, "ring.allreduce.ops": 5},
+                "gauges": {}, "histograms": {}}
+        assert metrics_watch.render_observatory_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(), None, "")
+        assert "-- observatory --" in out
+
+
 class TestBadInputs:
     """Missing/empty inputs produce a one-line error, not a traceback or
     silence (PR: static analysis)."""

@@ -1,0 +1,367 @@
+"""Fleet performance observatory (PR: observability).
+
+Fast tier:
+
+* **golden trailer** — with the observatory off (the default) the
+  telemetry trailer encodes to ZERO bytes, so tick frames stay
+  byte-identical to the pre-observatory wire; on, the trailer is exactly
+  the documented 40-byte ``HSBO`` record and strip-probes round-trip it
+  without touching the payload.  A blob that never carried a trailer
+  must never strip, whatever its length or content;
+* **local telemetry** — the ``record_xfer`` test seam feeds the per-leg
+  bandwidth EWMAs and ``xfer.*`` counters; ``note_step`` feeds the
+  step-decomposition EWMAs, the native histograms and the Python-side
+  mirror; everything is inert while disabled;
+* **Python surface** — ``hvd.observe()`` merges the local digest, the
+  coordinator's ``fleet.*`` gauges and the sentinel counters;
+  ``fleet_from_gauges`` reshapes the flat gauge names into the per-rank
+  table ``tools/fleet_top.py`` renders.
+
+Slow tier (multi-process over the native control plane):
+
+* **straggler attribution drill** — ``HOROVOD_TPU_FAULT=slow:rank=1:ms=50``
+  on exactly one process; the coordinator's fleet snapshot must charge
+  the imposed wait to rank 1, the regression sentinel must fire exactly
+  one (report-only) step-time alert, and each rank's ``xfer.*`` byte
+  series must reconcile with the ring's own byte counters;
+* **observe off stays dark** — without the knob no ``xfer.*``/``fleet.*``
+  series exists and no sentinel state is created.
+"""
+
+import json
+import struct
+
+import pytest
+
+from horovod_tpu import cpp_core, metrics, observe
+
+from test_hierarchical import run_ok
+
+native = pytest.mark.skipif(not cpp_core.available(),
+                            reason="native core not built")
+
+
+@pytest.fixture()
+def observatory():
+    """Arm the observatory for one test, then restore the dark default
+    and scrub every series it created."""
+    observe.set_enabled(True)
+    cpp_core.observe_reset()
+    cpp_core.metrics_reset()
+    metrics.registry.clear()
+    yield
+    observe.set_enabled(False)
+    cpp_core.observe_reset()
+    cpp_core.metrics_reset()
+    metrics.registry.clear()
+
+
+# --------------------------------------------------------------- fast
+
+
+@native
+class TestGoldenTrailer:
+    def test_off_encodes_zero_bytes(self):
+        observe.set_enabled(False)
+        assert cpp_core.observe_trailer_encode() == b""
+
+    def test_on_is_the_documented_40_byte_record(self, observatory):
+        cpp_core.observe_note_step(0.010, 0.008, 0.0, 0.001, 0.001)
+        blob = cpp_core.observe_trailer_encode()
+        assert len(blob) == 40
+        assert blob[:4] == b"HSBO"
+        # steps live in the last 4 bytes, little-endian.
+        assert struct.unpack("<I", blob[-4:])[0] == 1
+
+    def test_probe_round_trips_and_leaves_the_payload(self, observatory):
+        cpp_core.observe_note_step(0.020, 0.015, 0.0, 0.002, 0.003)
+        cpp_core.observe_record_xfer(0, 1 << 20, 1 << 20, 0.01)
+        payload = b"tick frame bytes"
+        probe = cpp_core.observe_trailer_probe(
+            payload + cpp_core.observe_trailer_encode())
+        assert probe["stripped"] is True
+        assert probe["payload_len"] == len(payload)
+        s = probe["sample"]
+        assert s["steps"] == 1
+        assert s["step_s"] == pytest.approx(0.020, rel=1e-5)
+        assert s["bw_bps"][0] > 0
+
+    def test_non_trailer_blob_never_strips(self, observatory):
+        for blob in (b"", b"short", b"x" * 40, b"y" * 4096):
+            probe = cpp_core.observe_trailer_probe(blob)
+            assert probe["stripped"] is False, len(blob)
+            assert probe["payload_len"] == len(blob)
+
+    def test_trailing_magic_inside_payload_is_honoured(self, observatory):
+        # Adversarial: the payload ENDS with the magic but the blob is a
+        # real trailer append — strip must take the trailer, not the
+        # look-alike bytes 40 further in.
+        payload = b"data" + b"HSBO"
+        probe = cpp_core.observe_trailer_probe(
+            payload + cpp_core.observe_trailer_encode())
+        assert probe["stripped"] is True
+        assert probe["payload_len"] == len(payload)
+
+
+@native
+class TestLocalTelemetry:
+    def test_record_xfer_feeds_counters_and_bandwidth(self, observatory):
+        # 1 MiB out in 5 ms = ~209.7 MB/s goodput on the classic leg.
+        cpp_core.observe_record_xfer(0, 1 << 20, 0, 0.005)
+        snap = cpp_core.metrics_snapshot()
+        assert snap["counters"]["xfer.ops#leg=classic"] == 1
+        assert snap["counters"]["xfer.bytes_sent#leg=classic"] == 1 << 20
+        bw = snap["gauges"]["xfer.bandwidth_bps#leg=classic"]
+        assert bw == pytest.approx((1 << 20) / 0.005, rel=1e-6)
+        local = cpp_core.observe_snapshot()
+        assert local["enabled"] is True
+        assert local["bw_bps"]["classic"] == pytest.approx(bw, rel=1e-6)
+        # Size-classed latency histogram: 1 MiB is "mid".
+        hist = snap["histograms"]["xfer.latency_seconds#leg=classic,size=mid"]
+        assert hist["count"] == 1
+
+    def test_note_step_mirrors_into_both_registries(self, observatory):
+        observe.note_step(0.010, 0.008, 0.001, 0.0005, 0.0005)
+        observe.note_step(0.012, 0.009, 0.001, 0.0010, 0.0010)
+        nat = cpp_core.observe_snapshot()
+        assert nat["steps"] == 2
+        assert 0.009 < nat["step_ewma_s"] < 0.013
+        py = metrics.registry.snapshot()
+        assert py["counters"]["step.count"] == 2
+        assert py["histograms"]["step.seconds"]["count"] == 2
+        assert py["histograms"]["step.stall_seconds"]["count"] == 2
+
+    def test_disabled_is_inert(self):
+        observe.set_enabled(False)
+        cpp_core.observe_reset()
+        cpp_core.metrics_reset()
+        metrics.registry.clear()
+        cpp_core.observe_record_xfer(0, 1 << 20, 0, 0.005)
+        observe.note_step(0.010)
+        assert cpp_core.observe_snapshot()["steps"] == 0
+        snap = metrics.snapshot()
+        # Series registered by earlier (enabled) tests may linger in the
+        # registry at zero; disabled means nothing MOVES.
+        assert not any(v for k, v in snap["counters"].items()
+                       if k.startswith("xfer.")), snap["counters"]
+        assert not snap["counters"].get("step.count"), snap["counters"]
+
+    def test_reset_zeroes_the_ewmas(self, observatory):
+        cpp_core.observe_record_xfer(1, 1 << 20, 0, 0.01)
+        cpp_core.observe_note_step(0.01, 0.0, 0.0, 0.0, 0.0)
+        cpp_core.observe_reset()
+        local = cpp_core.observe_snapshot()
+        assert local["steps"] == 0
+        assert local["step_ewma_s"] == 0.0
+        assert local["bw_bps"]["shm"] == 0.0
+
+
+class TestPythonSurface:
+    def test_env_gates_the_default(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_OBSERVE", raising=False)
+        if cpp_core.available():
+            # The native flag was seeded at library load; the Python
+            # surface reflects whatever it currently says.
+            assert observe.enabled() == cpp_core.observe_enabled()
+        else:
+            assert observe.enabled() is False
+
+    def test_callable_module_merges_the_views(self):
+        snap = observe()
+        assert set(snap) >= {"enabled", "local", "fleet", "sentinel_alerts"}
+        assert isinstance(snap["sentinel_alerts"], dict)
+
+    def test_fleet_from_gauges_reshapes_per_rank(self):
+        gauges = {
+            "fleet.ranks": 2.0,
+            "fleet.step_seconds#rank=0": 0.010,
+            "fleet.step_seconds#rank=1": 0.050,
+            "fleet.compute_seconds#rank=1": 0.040,
+            "fleet.stall_seconds#rank=1": 0.002,
+            "fleet.steps#rank=1": 128.0,
+            "fleet.wait_ewma_s#rank=1": 0.031,
+            "fleet.bandwidth_bps#rank=1,leg=classic": 2.0e8,
+            "other.gauge": 7.0,
+        }
+        fleet = observe.fleet_from_gauges(gauges)
+        assert fleet["ranks"] == 2
+        assert set(fleet["by_rank"]) == {0, 1}
+        r1 = fleet["by_rank"][1]
+        assert r1["step_seconds"] == pytest.approx(0.050)
+        assert r1["steps"] == 128
+        assert r1["wait_ewma_s"] == pytest.approx(0.031)
+        assert r1["bandwidth_bps"]["classic"] == pytest.approx(2.0e8)
+        assert "other.gauge" not in json.dumps(fleet)
+
+    def test_no_gauges_is_an_empty_fleet(self):
+        fleet = observe.fleet_from_gauges({})
+        assert fleet["ranks"] == 0
+        assert fleet["by_rank"] == {}
+
+
+# --------------------------------------------------------------- slow
+
+
+# Drives eager allreduces with the observatory armed, feeding a step
+# decomposition per iteration, then dumps the merged metrics view.  The
+# planted straggler (env on ONE process) makes rank 1 the regression the
+# coordinator must attribute.
+OBSERVE_WORKER = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+rank, n = hvd.rank(), hvd.size()
+assert hvd.observe.enabled(), "HOROVOD_TPU_OBSERVE=1 did not arm"
+base = np.ones(65536, np.float32)
+for i in range(120):
+    out = np.asarray(hvd.allreduce(base, average=False, name=f"obs.{i}"))
+    if out[0] != float(n):
+        raise AssertionError(f"rank {rank} iter {i}: wrong sum")
+    hvd.observe.note_step(0.010, 0.008, 0.0, 0.001, 0.001)
+snap = hvd.metrics()
+print("COUNTERS", json.dumps(snap["counters"]), flush=True)
+print("GAUGES", json.dumps(snap["gauges"]), flush=True)
+print("OBSERVE", json.dumps(hvd.observe()), flush=True)
+hvd.shutdown()
+"""
+
+
+def _parse_drill(out):
+    parsed = {}
+    for line in out.splitlines():
+        for tag in ("COUNTERS", "GAUGES", "OBSERVE"):
+            if line.startswith(tag + " "):
+                parsed[tag] = json.loads(line[len(tag) + 1:])
+    return parsed
+
+
+@pytest.mark.slow
+@native
+class TestStragglerAttributionDrill:
+    def test_sentinel_attributes_the_planted_straggler(self):
+        """ISSUE acceptance: a 2-process run with a planted 50 ms
+        straggler on rank 1 — the coordinator's fleet snapshot charges
+        the imposed wait to rank 1, exactly one sentinel alert fires
+        (report-only: the job still finishes clean), and every rank's
+        xfer byte series reconciles with the ring's own counters.
+
+        Launched by hand rather than through test_hierarchical.launch:
+        the fault spec must reach ONE process only, and launch() applies
+        extra_env to all of them."""
+        import os
+        import socket
+        import subprocess
+        import sys
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = []
+        for i in range(2):
+            env = dict(os.environ)
+            env.pop("HOROVOD_TPU_TIMELINE", None)
+            env.pop("HOROVOD_TPU_FAULT", None)
+            env.update({
+                "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+                "HOROVOD_TPU_PROCESS_INDEX": str(i),
+                "HOROVOD_TPU_PROCESS_COUNT": "2",
+                "HOROVOD_TPU_SIZE": "2",
+                "HOROVOD_TPU_RANK": str(i),
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+                "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+                "HOROVOD_TPU_HOST_FINGERPRINT": "hostA" if i == 0
+                                                else "hostB",
+                "HOROVOD_TPU_ALLREDUCE_ALGO": "ring",
+                "HOROVOD_TPU_TRANSPORT": "classic",
+                "HOROVOD_TPU_OBSERVE": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            if i == 1:
+                env["HOROVOD_TPU_FAULT"] = "slow:rank=1:ms=50"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", OBSERVE_WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append((p.returncode, out))
+
+        for i, (rc, out) in enumerate(outs):
+            assert rc == 0, f"proc {i}:\n{out}"
+        out0 = outs[0][1]
+        d0 = _parse_drill(out0)
+
+        # --- fleet attribution on the coordinator.
+        gauges = d0["GAUGES"]
+        assert gauges.get("fleet.ranks") == 2, gauges
+        wait1 = gauges.get("fleet.wait_ewma_s#rank=1", 0.0)
+        wait0 = gauges.get("fleet.wait_ewma_s#rank=0", 0.0)
+        assert wait1 > 0.02, gauges     # over the sentinel threshold
+        assert wait1 > wait0 * 2, (wait0, wait1)
+        # The trailer carried rank 1's step decomposition across the
+        # wire: its fed 10 ms steps are on the coordinator's table.
+        assert gauges.get("fleet.steps#rank=1", 0) > 0, gauges
+        assert gauges.get("fleet.step_seconds#rank=1", 0.0) == \
+            pytest.approx(0.010, rel=0.2), gauges
+
+        # --- exactly one sentinel alert, attributing rank 1.
+        counters0 = d0["COUNTERS"]
+        alerts = {k: v for k, v in counters0.items()
+                  if k.startswith("sentinel.alerts") and v}
+        assert alerts == {"sentinel.alerts#kind=step_time": 1}, alerts
+        assert "htpu sentinel: step-time regression" in out0, out0
+        assert "rank 1" in out0.split("htpu sentinel:")[1].splitlines()[0]
+        # Report-only: the run finished with zero aborts (rc checks
+        # above) and the fleet view mirrors into hvd.observe().
+        obs0 = d0["OBSERVE"]
+        assert obs0["sentinel_alerts"] == {"step_time": 1}, obs0
+        assert obs0["fleet"]["ranks"] == 2, obs0
+
+        # --- per-rank xfer series reconcile with the ring counters.
+        # The classic leg carries every allreduce chunk plus the odd
+        # metadata allgather from setup/teardown; the only wire bytes
+        # not under a ring.* family are that allgather's 8-byte size
+        # headers (one RingXfer per ring step), so the residue must
+        # stay a sliver while the allreduce volume dominates.
+        for i, (_, out) in enumerate(outs):
+            c = _parse_drill(out)["COUNTERS"]
+            xfer_sent = c.get("xfer.bytes_sent#leg=classic", 0)
+            allreduce_sent = sum(v for k, v in c.items()
+                                 if k.startswith("ring.allreduce.bytes_sent#"))
+            ring_sent = allreduce_sent + sum(
+                c.get(f"ring.{fam}.bytes_sent", 0)
+                for fam in ("allgather", "broadcast"))
+            assert allreduce_sent > 1 << 20, c
+            assert xfer_sent >= ring_sent > 0, \
+                f"proc {i}: xfer={xfer_sent} ring={ring_sent}"
+            assert xfer_sent - ring_sent < 1024, \
+                f"proc {i}: xfer={xfer_sent} ring={ring_sent}"
+            assert c.get("xfer.ops#leg=classic", 0) > 0, c
+            # Control frames were observed too (every tick is one).
+            assert c.get("xfer.ops#leg=ctrl", 0) > 100, c
+
+
+@pytest.mark.slow
+@native
+class TestObserveOffStaysDark:
+    def test_no_observatory_series_without_the_knob(self):
+        """With the knob off (the default) no xfer./fleet./sentinel.
+        series exists anywhere — the wire and the registries look
+        exactly like the pre-observatory build."""
+        parsed = run_ok(["hostA", "hostB"], "ring",
+                        extra_env={"HOROVOD_TPU_TRANSPORT": "classic"})
+        for _, c in parsed:
+            assert not any(k.startswith(("xfer.", "fleet.", "sentinel."))
+                           for k in c), c

@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Live fleet dashboard over the coordinator's observatory snapshot.
+
+With ``HOROVOD_TPU_OBSERVE=1`` the coordinator (process 0) strips the
+telemetry trailer off every tick frame and republishes the fleet view as
+``fleet.*`` gauges, which ride rank 0's metrics JSONL stream
+(``HOROVOD_TPU_METRICS_EVERY_S``).  This tool tails that one file and
+redraws an in-place, ``top``-style table — one row per rank: step time,
+compute share, exposed-comm fraction, stall, the best data-hop
+bandwidth, the coordinator's imposed-wait EWMA (the straggler signal the
+sentinel alerts on), and the fleet-wide sentinel alert counts.
+
+    python tools/fleet_top.py horovod_tpu_metrics.0.jsonl
+    python tools/fleet_top.py --once horovod_tpu_metrics.0.jsonl
+
+No curses, no dependencies: the redraw is ANSI cursor-home + clear-line
+per row, which survives dumb terminals and ``tee``.  ``--once`` prints a
+single table and exits (CI, bug reports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+try:
+    from horovod_tpu.observe import fleet_from_gauges
+except ImportError:   # monitoring box without jax: reshape locally
+    def fleet_from_gauges(gauges):
+        by_rank = {}
+        for name, value in gauges.items():
+            if not name.startswith("fleet.") or "#" not in name:
+                continue
+            family, _, label_part = name.partition("#")
+            labels = dict(kv.partition("=")[::2] for kv in
+                          label_part.split(","))
+            try:
+                rank = int(labels["rank"])
+            except (KeyError, ValueError):
+                continue
+            row = by_rank.setdefault(rank, {})
+            key = family[len("fleet."):]
+            if key == "bandwidth_bps":
+                row.setdefault("bandwidth_bps", {})[
+                    labels.get("leg", "?")] = value
+            else:
+                row[key] = value
+        return {"ranks": int(gauges.get("fleet.ranks", len(by_rank))),
+                "by_rank": by_rank}
+
+
+def human_rate(bps: float) -> str:
+    for unit in ("B/s", "KiB/s", "MiB/s", "GiB/s"):
+        if abs(bps) < 1024.0 or unit == "GiB/s":
+            return f"{bps:.1f}{unit}"
+        bps /= 1024.0
+    return f"{bps:.1f}GiB/s"
+
+
+def latest_snapshot(path: str, offset: int) -> tuple[dict | None, int]:
+    """Newest complete JSONL snapshot at or past ``offset``; returns
+    (snapshot-or-None, new offset).  Torn tail lines are left unread for
+    the next poll, exactly like metrics_watch's follow loop."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            raw = f.read()
+            offset = f.tell()
+    except OSError:
+        return None, offset
+    cut = raw.rfind(b"\n") + 1
+    if cut < len(raw):
+        offset -= len(raw) - cut
+        raw = raw[:cut]
+    snap = None
+    for line in raw.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+    return snap, offset
+
+
+def render_table(snap: dict) -> list[str]:
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    fleet = fleet_from_gauges(gauges)
+    ts = snap.get("ts")
+    when = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "--"
+    lines = [f"fleet_top — {fleet['ranks']} rank(s) @ {when}   "
+             f"(coordinator rank {snap.get('rank', '?')})"]
+    header = (f"{'rank':>4}  {'step_ms':>8}  {'compute%':>8}  "
+              f"{'exposed%':>8}  {'stall_ms':>8}  {'best_hop':>14}  "
+              f"{'wait_ms':>8}  {'steps':>8}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for rank in sorted(fleet["by_rank"]):
+        row = fleet["by_rank"][rank]
+        step = row.get("step_seconds", 0.0)
+        compute = row.get("compute_seconds", 0.0)
+        exposed_frac = row.get("exposed_comm_fraction", 0.0)
+        stall = row.get("stall_seconds", 0.0)
+        wait = row.get("wait_ewma_s", 0.0)
+        bw = row.get("bandwidth_bps", {})
+        best = max(bw.items(), key=lambda kv: kv[1]) if bw else None
+        best_text = (f"{best[0]}:{human_rate(best[1])}" if best
+                     else "-")
+        lines.append(
+            f"{rank:>4}  {step * 1e3:>8.2f}  "
+            f"{(compute / step if step else 0.0):>8.0%}  "
+            f"{exposed_frac:>8.0%}  {stall * 1e3:>8.2f}  "
+            f"{best_text:>14}  {wait * 1e3:>8.2f}  "
+            f"{int(row.get('steps', 0)):>8}")
+    if not fleet["by_rank"]:
+        lines.append("  (no fleet.* gauges yet — is the job running with "
+                     "HOROVOD_TPU_OBSERVE=1 and is this rank 0's file?)")
+    alert_prefix = "sentinel.alerts#kind="
+    alerts = {k[len(alert_prefix):]: v for k, v in counters.items()
+              if k.startswith(alert_prefix) and v}
+    if alerts:
+        lines.append("SENTINEL: " + "  ".join(
+            f"{kind}={n:g}" for kind, n in sorted(alerts.items())))
+    return lines
+
+
+def run(path: str, once: bool, poll_s: float) -> int:
+    offset = 0
+    snap = None
+    drawn = 0
+    while True:
+        fresh, offset = latest_snapshot(path, offset)
+        if fresh is not None:
+            snap = fresh
+        if snap is None:
+            if once:
+                print("fleet_top: no complete snapshots in " + path +
+                      " (is the emitter running with "
+                      "HOROVOD_TPU_METRICS_EVERY_S set?)", file=sys.stderr)
+                return 1
+        else:
+            lines = render_table(snap)
+            if once:
+                print("\n".join(lines))
+                return 0
+            # Redraw in place: move the cursor up over the previous
+            # frame, then clear-to-end-of-line per row so shorter frames
+            # leave no residue.
+            if drawn:
+                sys.stdout.write(f"\x1b[{drawn}F")
+            sys.stdout.write("".join(f"\x1b[2K{ln}\n" for ln in lines))
+            sys.stdout.flush()
+            drawn = len(lines)
+        try:
+            time.sleep(poll_s)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Live per-rank fleet dashboard from rank 0's metrics "
+                    "JSONL stream (see docs/observability.md).")
+    p.add_argument("file", help="rank 0's metrics .jsonl file")
+    p.add_argument("--once", action="store_true",
+                   help="print one table and exit")
+    p.add_argument("--poll", type=float, default=1.0,
+                   help="poll interval in seconds when following")
+    args = p.parse_args(argv)
+    if not os.path.isfile(args.file):
+        print("fleet_top: no such file: " + args.file, file=sys.stderr)
+        return 1
+    return run(args.file, args.once, args.poll)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -355,6 +355,69 @@ def render_integrity_summary(snap: dict, name_filter: str) -> list[str]:
     return ["  -- integrity --", f"  {name:<52} {text}"]
 
 
+def render_observatory_summary(snap: dict, name_filter: str) -> list[str]:
+    """Fleet-observatory digest (``HOROVOD_TPU_OBSERVE=1``,
+    docs/observability.md "Observatory"): one line per data-plane hop —
+    transfer count, bytes each way, the live bandwidth EWMA, and p50
+    latency per size class — plus the step-time decomposition (p50
+    compute/exposed/stall and the exposed-comm tail the steps actually
+    waited on) and the sentinel's alert count.  Alerts are loud
+    (upper-case, like FALLBACKS): a nonzero count means the coordinator
+    saw a sustained per-rank regression."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    lines = []
+    for leg in ("classic", "shm", "uring", "ctrl"):
+        name = f"xfer[{leg}]"
+        if name_filter and name_filter not in name:
+            continue
+        ops = counters.get(f"xfer.ops#leg={leg}", 0)
+        if not ops:
+            continue
+        text = (f"ops={ops:g}"
+                f" sent={human_bytes(counters.get(f'xfer.bytes_sent#leg={leg}', 0))}"
+                f" recv={human_bytes(counters.get(f'xfer.bytes_recv#leg={leg}', 0))}")
+        bw = gauges.get(f"xfer.bandwidth_bps#leg={leg}")
+        if bw:
+            text += f" bw={human_bytes(bw)}/s"
+        for size in ("small", "mid", "large"):
+            med = hist_median(
+                hists.get(f"xfer.latency_seconds#leg={leg},size={size}", {}))
+            if med is not None:
+                text += f" p50_{size}={med * 1e3:.3g}ms"
+        lines.append(f"  {name:<52} {text}")
+    steps = counters.get("step.count", 0)
+    if steps and not (name_filter and all(name_filter not in n for n in (
+            "step.count", "step.seconds", "step.compute_seconds",
+            "step.exposed_comm_seconds", "step.stall_seconds"))):
+        text = f"steps={steps:g}"
+        for series, label in (("step.seconds", "step"),
+                              ("step.compute_seconds", "compute"),
+                              ("step.exposed_comm_seconds", "exposed"),
+                              ("step.stall_seconds", "stall")):
+            med = hist_median(hists.get(series, {}))
+            if med is not None:
+                text += f" p50_{label}={med * 1e3:.3g}ms"
+        exposed = hists.get("step.exposed_comm_seconds", {})
+        if exposed.get("count"):
+            text += f" exposed_tail={exposed.get('sum', 0.0):.3g}s"
+        lines.append(f"  {'step':<52} {text}")
+    ranks = gauges.get("fleet.ranks")
+    if ranks and (not name_filter or name_filter in "fleet.ranks"):
+        lines.append(f"  {'fleet':<52} ranks={int(ranks)}")
+    alert_prefix = "sentinel.alerts#kind="
+    alerts = {k[len(alert_prefix):]: v for k, v in counters.items()
+              if k.startswith(alert_prefix) and v}
+    if alerts and (not name_filter or name_filter in alert_prefix):
+        text = " ".join(f"SENTINEL_ALERTS[{kind}]={n:g}"
+                        for kind, n in sorted(alerts.items()))
+        lines.append(f"  {'sentinel':<52} {text}")
+    if lines:
+        lines.insert(0, "  -- observatory --")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -408,6 +471,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_ckpt_summary(snap, name_filter))
     lines.extend(render_overlap_summary(snap, name_filter))
     lines.extend(render_tenant_summary(snap, name_filter))
+    lines.extend(render_observatory_summary(snap, name_filter))
     return "\n".join(lines)
 
 
