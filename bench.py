@@ -444,13 +444,17 @@ def bench_transformer(jax, hvd, mesh, nchips):
     # program except for the reduce_gradients compression, so step-time
     # deltas are the wire's own cost/benefit.  The fp32 row reuses the
     # main leg above (compression=none IS the fp32 wire).
+    # The A/B legs must not touch `params`: the donating main leg above
+    # consumed that buffer.  state[0] is the last step call's output and
+    # stays live (nothing donates it after the timed windows).
+    ab_params = state[0]
     wire_ab = None
     if (os.environ.get("BENCH_TLM_AB", "1") == "1" and nchips > 1):
         wire_ab = _injit_wire_ab(
             jax, np, build_step=lambda comp: make_train_step(
                 loss_fn, tx, mesh, sync_aux_state=False,
                 steps_per_call=spc, compression=comp, donate=False),
-            init_state=lambda: (params, {}, tx.init(params)),
+            init_state=lambda: (ab_params, {}, tx.init(ab_params)),
             data=tokens, nchips=nchips,
             iters=max(2, timed_batches // 2), spc=spc,
             fp32_sec_per_step=dt / (timed_batches * spc),
@@ -476,7 +480,7 @@ def bench_transformer(jax, hvd, mesh, nchips):
                                     sync_aux_state=False,
                                     steps_per_call=spc, donate=False,
                                     overlap=ov)
-            st = (params, {}, tx.init(params))
+            st = (ab_params, {}, tx.init(ab_params))
             ostep, _, _ = aot_compile(ostep, (*st, tokens))
             p, aux, o, loss = ostep(*st, tokens)   # warmup binds loss
             np.asarray(loss)
@@ -603,7 +607,78 @@ def _injit_wire_ab(jax, np, *, build_step, init_state, data, nchips,
             and "step_time_ms" in out.get("fp32", {})):
         out["int8_faster_than_fp32"] = (out["int8"]["step_time_ms"]
                                         < out["fp32"]["step_time_ms"])
+    # Autopilot leg (HOROVOD_TPU_PRECISION=auto + compression="auto"):
+    # warm the per-process ladder with the measured int8-grid residual of
+    # each param leaf (the stand-in for its gradient bucket at this
+    # shape), then time the step with the plan the ladder actually chose.
+    # The acceptance bar: within 5% of the best static wire above.
+    if os.environ.get("BENCH_TLM_AUTO", "1") == "1":
+        out["auto"] = _injit_auto_leg(np, params, leg_sec)
+        best = min((leg["step_time_ms"]
+                    for leg in (out.get(w) or {}
+                                for w in ("fp32", "bf16", "int8"))
+                    if "step_time_ms" in leg), default=None)
+        if best and "step_time_ms" in out["auto"]:
+            out["auto_vs_best_static"] = round(
+                out["auto"]["step_time_ms"] / best, 4)
     return out
+
+
+def _injit_auto_leg(np, params, leg_sec):
+    """One ``compression="auto"`` timing leg for the in-jit wire A/B."""
+    import jax.tree_util as jtu
+    from horovod_tpu import precision as _precision
+    from horovod_tpu.ops import quantized_collectives as qc
+    saved = {k: os.environ.get(k) for k in
+             ("HOROVOD_TPU_PRECISION", "HOROVOD_TPU_PRECISION_TICKS")}
+    os.environ["HOROVOD_TPU_PRECISION"] = "auto"
+    os.environ["HOROVOD_TPU_PRECISION_TICKS"] = "2"
+    _precision.reset_autopilot()
+    try:
+        pilot = _precision.get_autopilot()
+        rng = np.random.RandomState(0)
+        for path, leaf in jtu.tree_flatten_with_path(params)[0]:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = getattr(leaf, "dtype", None)
+            if (dtype is None or np.dtype(dtype) != np.float32
+                    or not qc.int8_eligible(shape, np.float32)):
+                continue
+            try:
+                g = np.asarray(leaf, dtype=np.float32)
+            except RuntimeError:
+                # The fp32 leg donated this buffer; a synthetic gradient
+                # at the same shape stands in — the int8-grid residual
+                # of gaussian data is representative for the codec.
+                g = rng.standard_normal(shape).astype(np.float32)
+            denom = float(np.linalg.norm(g.ravel()))
+            rel = (float(np.linalg.norm(
+                g - np.asarray(qc.snap_to_grid(g), dtype=np.float32)))
+                / denom) if denom > 0 else 0.0
+            name = f"grads{jtu.keystr(path)}"
+            for _ in range(4):   # enough healthy ticks to reach int8
+                pilot.note_residual(name, rel)
+        levels = {}
+        for path, leaf in jtu.tree_flatten_with_path(params)[0]:
+            lv = pilot.level_for(f"grads{jtu.keystr(path)}")
+            key = ("fp32", "bf16", "int8")[lv]
+            levels[key] = levels.get(key, 0) + 1
+        try:
+            sec = leg_sec("auto")
+        except Exception as exc:   # noqa: BLE001 — per-leg, not fatal
+            return {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        return {
+            "step_time_ms": round(sec * 1e3, 2),
+            "buckets_by_wire": levels,
+            "promotions": pilot.promotions,
+            "demotions": pilot.demotions,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _precision.reset_autopilot()
 
 
 def _pin_cpu_half(half: int) -> bool:
@@ -731,12 +806,20 @@ def tcp_worker():
     def _wire_bytes(wire):
         """Per-dtype bytes-on-wire from the unified metrics registry —
         the same counters the JSONL/Prometheus exporters publish, so the
-        bench numbers and the live telemetry can never disagree."""
+        bench numbers and the live telemetry can never disagree.
+        ``wire=None`` sums every wire (the autopilot leg's traffic moves
+        between dtypes as the ladder climbs)."""
         c = hvd_metrics.snapshot().get("counters", {})
+        if wire is None:
+            return (sum(v for k, v in c.items()
+                        if k.startswith("ring.allreduce.bytes_sent#wire=")),
+                    sum(v for k, v in c.items()
+                        if k.startswith("ring.allreduce.bytes_recv#wire=")))
         return (c.get(f"ring.allreduce.bytes_sent#wire={wire}", 0),
                 c.get(f"ring.allreduce.bytes_recv#wire={wire}", 0))
 
-    def measured_loop(params, opt_state, compression, wire):
+    def measured_loop(params, opt_state, compression, wire,
+                      name_prefix="DistributedOptimizer.grads"):
         """One timed window of the training loop; returns throughput,
         comm fraction, and the data-plane bytes that actually rode the
         ring wire (compressed bytes when a wire dtype is active)."""
@@ -748,7 +831,8 @@ def tcp_worker():
             jax.block_until_ready(grads)
             c0 = time.perf_counter()
             grads = hvd_jax.allreduce_gradients(grads,
-                                                compression=compression)
+                                                compression=compression,
+                                                name_prefix=name_prefix)
             jax.block_until_ready(grads)
             t_comm += time.perf_counter() - c0
             params, opt_state = apply_fn(params, opt_state, grads)
@@ -781,6 +865,37 @@ def tcp_worker():
             stats["bytes_ratio_vs_fp32"] = round(sent / raw_sent, 4)
             stats["faster_than_fp32"] = dt < dt_raw
         wire_stats[wire] = stats
+
+    # Autopilot leg (compression="auto", HOROVOD_TPU_PRECISION=auto):
+    # requests go out RAW with measured residual reports riding the
+    # request wire's precision ext; the coordinator climbs the ladder per
+    # bucket and stamps the negotiated dtype.  Runs LAST and under its
+    # own tensor names so a promoted auto bucket can never collide with
+    # the static legs' raw fp32 requests.  Headline: step time within 5%
+    # of the best static wire above.
+    from horovod_tpu import precision as _hvd_precision
+    if _hvd_precision.get_autopilot().enabled:
+        for _ in range(3):   # warmup: let the ladder climb pre-window
+            loss, grads = grads_fn(params)
+            grads = hvd_jax.allreduce_gradients(
+                grads, compression="auto", name_prefix="auto.grads")
+            params, opt_state = apply_fn(params, opt_state, grads)
+        np.asarray(loss)
+        params, opt_state, dt, t_comm, sent, recvd = measured_loop(
+            params, opt_state, "auto", None, name_prefix="auto.grads")
+        auto_stats = {
+            "images_per_sec_per_proc": round(batch * iters / dt, 2),
+            "step_time_ms": round(dt / iters * 1e3, 2),
+            "comm_fraction": round(t_comm / dt, 4),
+            "bytes_on_wire_sent": sent,
+            "bytes_on_wire_recvd": recvd,
+        }
+        best_static = min((w["step_time_ms"] for w in wire_stats.values()
+                           if "step_time_ms" in w), default=None)
+        if best_static:
+            auto_stats["vs_best_static"] = round(
+                auto_stats["step_time_ms"] / best_static, 4)
+        wire_stats["auto"] = auto_stats
 
     # Overlap A/B: the same loop with the bucketed-overlap scheduler off
     # (per-leaf allreduce after backward fully materializes) and on
@@ -1779,6 +1894,15 @@ def bench_scaling_tcp():
         # The worker sweeps wire dtypes itself; an exported process-wide
         # default would silently turn the "fp32" leg into a compressed one.
         env.pop("HOROVOD_TPU_WIRE_DTYPE", None)
+        # Adaptive-precision autopilot, armed for the whole worker run:
+        # the static legs pass explicit wire dtypes (their requests carry
+        # them, so the coordinator never stamps those), and the auto leg
+        # runs last under its own tensor names.  TICKS=2 lets the ladder
+        # climb within the short warmup window; the lowered int8 floor
+        # lets the small conv leg's buckets report residuals at all.
+        env["HOROVOD_TPU_PRECISION"] = "auto"
+        env["HOROVOD_TPU_PRECISION_TICKS"] = "2"
+        env.setdefault("HOROVOD_TPU_INJIT_INT8_FLOOR", "4096")
         if pin:
             env["BENCH_TCP_PIN"] = "1"
         else:
@@ -2357,14 +2481,16 @@ def write_bench_summary(report: dict,
 
     The raw ``BENCH_rNN`` files the growth driver captures are stdout
     tails — truncated, unparsed, and useless for trend lines.  This
-    writes ``BENCH_r06.json`` (override with ``BENCH_SUMMARY_FILE``; set
+    writes ``BENCH_r07.json`` (override with ``BENCH_SUMMARY_FILE``; set
     it empty to skip) holding just the judged numbers: single/virtual
     step times and MFU, TCP scaling efficiency, the zero-copy transport
-    speedup, the CRC integrity overhead, and the observatory's on/off
-    step-time overhead — each pulled from the full report when the
-    producing leg ran, ``None`` when it was skipped or failed."""
+    speedup, the CRC integrity overhead, the observatory's on/off
+    step-time overhead, and the adaptive-precision autopilot's A/B
+    against the best static wire on both planes — each pulled from the
+    full report when the producing leg ran, ``None`` when it was skipped
+    or failed."""
     if path is None:
-        path = os.environ.get("BENCH_SUMMARY_FILE", "BENCH_r06.json")
+        path = os.environ.get("BENCH_SUMMARY_FILE", "BENCH_r07.json")
     if not path:
         return None
 
@@ -2399,6 +2525,15 @@ def write_bench_summary(report: dict,
         # Observatory hot-path cost: off/on step time + overhead fraction
         # from the TCP leg's A/B (acceptance budget <= 2%).
         "observe_ab": tcp.get("observe_ab"),
+        # Adaptive-precision autopilot vs the best static wire, both
+        # planes (acceptance bar: ratio <= 1.05).
+        "precision_auto_tcp_vs_best_static": get(
+            "scaling_tcp_2proc", "wire_compression", "auto",
+            "vs_best_static"),
+        "precision_auto_injit_vs_best_static": get(
+            "transformer_lm", "injit_wire_ab", "auto_vs_best_static"),
+        "precision_auto_injit": get(
+            "transformer_lm", "injit_wire_ab", "auto"),
     }
     try:
         with open(path, "w") as f:

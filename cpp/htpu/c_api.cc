@@ -468,6 +468,31 @@ HTPU_API long long htpu_wire_decode(const char* wire_dtype, const void* in,
   return -1;
 }
 
+// Parse a serialized RequestList frame and re-serialize it — the
+// py<->cpp framing parity hook (distinct from htpu_wire_encode/decode,
+// which cover the PAYLOAD codec): a Python-built frame must survive the
+// native parse+serialize byte-for-byte, extensions included
+// (tests/test_precision.py drives the FLAG_PRECISION_EXT roundtrip
+// through this).  Returns bytes written to `out` (capacity `cap`), or
+// -1 on a parse failure / short buffer.
+HTPU_API long long htpu_wire_request_list_roundtrip(const void* in,
+                                                    long long len, void* out,
+                                                    long long cap) try {
+  htpu::RequestList list;
+  if (len < 0 ||
+      !htpu::ParseRequestList(static_cast<const uint8_t*>(in),
+                              size_t(len), &list)) {
+    return -1;
+  }
+  std::string blob;
+  htpu::SerializeRequestList(list, &blob);
+  if ((long long)blob.size() > cap) return -1;
+  std::memcpy(out, blob.data(), blob.size());
+  return (long long)blob.size();
+} catch (...) {
+  return -1;
+}
+
 // Direct SumInto hook (reduce.h): acc += in elementwise over nbytes of
 // `dtype`.  Exists so tests can pin the parallel reduction's bit-exactness
 // against the serial path (small slices stay serial; large calls engage
@@ -764,6 +789,48 @@ HTPU_API int htpu_policy_next_eviction_set(void* policy, int set,
                                            int seat_available) {
   return static_cast<htpu::FleetPolicy*>(policy)->NextEvictionSet(
       set, process_count, seat_available != 0);
+}
+
+// Precision controller (policy.h): the per-bucket wire-dtype ladder —
+// the third actuator on the same engine, exposed for the Python mirror
+// and the native-parity trace in tests/test_precision.py.
+
+HTPU_API int htpu_policy_precision_auto(void* policy) {
+  return static_cast<htpu::FleetPolicy*>(policy)->precision_auto() ? 1 : 0;
+}
+
+HTPU_API void htpu_policy_precision_observe(void* policy, const char* name,
+                                            double residual_norm) {
+  static_cast<htpu::FleetPolicy*>(policy)->ObservePrecision(
+      name ? name : "", residual_norm);
+}
+
+HTPU_API void htpu_policy_precision_bandwidth(void* policy,
+                                              double min_leg_bps) {
+  static_cast<htpu::FleetPolicy*>(policy)->NotePrecisionBandwidth(
+      min_leg_bps);
+}
+
+HTPU_API int htpu_policy_precision_level(void* policy, const char* name) {
+  return static_cast<htpu::FleetPolicy*>(policy)->PrecisionLevel(
+      name ? name : "");
+}
+
+HTPU_API double htpu_policy_precision_ewma(void* policy, const char* name) {
+  return static_cast<htpu::FleetPolicy*>(policy)->PrecisionEwma(
+      name ? name : "");
+}
+
+// counts[0] = promotions, counts[1] = demotions (lifetime).
+HTPU_API void htpu_policy_precision_counts(void* policy, long long* counts) {
+  auto* p = static_cast<htpu::FleetPolicy*>(policy);
+  counts[0] = p->precision_promotions();
+  counts[1] = p->precision_demotions();
+}
+
+HTPU_API int htpu_policy_precision_dirty(void* policy) {
+  return static_cast<htpu::FleetPolicy*>(policy)->TakePrecisionDirty() ? 1
+                                                                       : 0;
 }
 
 // ------------------------------------------------------------- process sets

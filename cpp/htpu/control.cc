@@ -266,9 +266,12 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
   cp->ParseFaultEnv();
   // Fleet policy (policy.h): the coordinator watches per-rank imposed
   // wait and drives planned reconfigures (straggler eviction, scripted
-  // autoscale).  Kept only when a policy knob is armed so unconfigured
-  // jobs skip it with one null check per tick.
-  if (cp->elastic_ && process_index == 0) {
+  // autoscale) plus the precision ladder.  Kept only when a policy knob
+  // is armed so unconfigured jobs skip it with one null check per tick.
+  // The reconfigure actuators stay elastic-gated at the RunFleetPolicy
+  // call site; a non-elastic coordinator instantiates the policy only
+  // for the precision controller (and harmless EWMA bookkeeping).
+  if (process_index == 0) {
     auto policy = std::make_unique<FleetPolicy>();
     if (policy->active()) cp->policy_ = std::move(policy);
   }
@@ -1235,6 +1238,10 @@ void ControlPlane::CompressRequestFrame(const std::string& in,
   outl.shutdown = list.shutdown;
   outl.abort_rank = list.abort_rank;
   outl.abort_reason = list.abort_reason;
+  // Precision telemetry rides every frame it arrived on — compressing
+  // the request vector must not drop the residual reports.
+  outl.has_precision_ext = list.has_precision_ext;
+  outl.precision = std::move(list.precision);
   // Stragglers keep their original submission order (fusion-plan
   // determinism); hit names compress to bits and are remembered for a
   // flush-triggered resend.
@@ -1589,6 +1596,29 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     }
     ObserveGatherSkew(arrival_us, have_arrival, set_attr);
     RunObservatory();
+    // Precision telemetry ingest: every gathered frame's residual-norm
+    // reports land on the controller's per-bucket EWMAs, and the
+    // observatory's slowest data-leg bandwidth feeds the promotion gate
+    // (EQuARX: only quantize when the wire is the bottleneck).
+    if (policy_ != nullptr && policy_->precision_auto()) {
+      double min_bps = 0.0;
+      for (int p = 0; p < process_count_; ++p) {
+        if (size_t(p) >= fleet_have_.size() || !fleet_have_[size_t(p)]) {
+          continue;
+        }
+        for (int l = 0; l < 3; ++l) {
+          const double bw = double(fleet_samples_[size_t(p)].bw_bps[l]);
+          if (bw > 0 && (min_bps <= 0 || bw < min_bps)) min_bps = bw;
+        }
+      }
+      if (min_bps > 0) policy_->NotePrecisionBandwidth(min_bps);
+      for (const RequestList& f : frames) {
+        if (!f.has_precision_ext) continue;
+        for (const auto& pr : f.precision) {
+          policy_->ObservePrecision(pr.first, pr.second);
+        }
+      }
+    }
   }
   {
     auto gather_t1 = std::chrono::steady_clock::now();
@@ -1727,6 +1757,13 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   static std::atomic<long long>* cache_evs =
       Metrics::Get().Counter("control.cache_evictions");
   if (CacheEnabled()) {
+    // A precision-ladder level change invalidates every stored response
+    // set: a cached frame replays its negotiated wire_dtype
+    // byte-for-byte, so the table must rebuild before the new dtype can
+    // be stamped (test-and-clear — one flush per level change).
+    if (policy_ != nullptr && policy_->TakePrecisionDirty()) {
+      cache_flush = true;
+    }
     // Epoch or bit-validity divergence (cannot happen in the lockstep
     // protocol; defensive): drop the whole slot table and have every
     // client resend its compressed names as full requests next tick —
@@ -1948,6 +1985,28 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     return it == first_request.end() ? std::string()
                                      : it->second.tensor_type;
   };
+  // Precision autopilot: stamp the controller's per-bucket wire dtype
+  // into each negotiated response BEFORE fusion — fusion merges only
+  // equal wire dtypes, and the response cache replays the stamped frame
+  // byte-for-byte (a level change flushed the table above).  Only
+  // fp32 ALLREDUCE responses whose requests left wire_dtype empty are
+  // eligible: an explicit static dtype stays authoritative, and
+  // compressed wire formats are defined over fp32 payloads only.
+  if (policy_ != nullptr && policy_->precision_auto()) {
+    for (Response& resp : out.responses) {
+      if (resp.response_type != ResponseType::ALLREDUCE ||
+          resp.tensor_names.size() != 1 || !resp.wire_dtype.empty()) {
+        continue;
+      }
+      auto it = first_request.find(resp.tensor_names[0]);
+      if (it == first_request.end() ||
+          it->second.tensor_type != "float32" ||
+          !it->second.wire_dtype.empty()) {
+        continue;
+      }
+      resp.wire_dtype = policy_->PrecisionWire(resp.tensor_names[0]);
+    }
+  }
   out.responses =
       PlanTick(out.responses, entry_bytes, entry_dtype, fusion_threshold);
   for (auto& r : set_responses) out.responses.push_back(std::move(r));

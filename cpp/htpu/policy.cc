@@ -50,6 +50,23 @@ FleetPolicy::FleetPolicy() {
   if (const char* e = getenv("HOROVOD_TPU_AUTOSCALE_FILE")) {
     autoscale_file_ = e;
   }
+  const char* pm = getenv("HOROVOD_TPU_PRECISION");
+  precision_auto_ = pm && std::string(pm) == "auto";
+  if (const char* e = getenv("HOROVOD_TPU_PRECISION_THRESHOLD")) {
+    char* end = nullptr;
+    double v = strtod(e, &end);
+    if (end && *end == '\0' && v > 0) precision_threshold_ = v;
+  }
+  if (const char* e = getenv("HOROVOD_TPU_PRECISION_TICKS")) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end && *end == '\0' && v > 0) precision_ticks_ = int(v);
+  }
+  if (const char* e = getenv("HOROVOD_TPU_PRECISION_BW_BPS")) {
+    char* end = nullptr;
+    double v = strtod(e, &end);
+    if (end && *end == '\0' && v >= 0) precision_bw_bps_ = v;
+  }
 }
 
 bool FleetPolicy::ParseAutoscaleScript(
@@ -276,6 +293,80 @@ int FleetPolicy::consecutive_slow_set(int32_t set, int proc) const {
   return proc >= 0 && size_t(proc) < procs.size()
              ? procs[size_t(proc)].consecutive
              : 0;
+}
+
+void FleetPolicy::NotePrecisionBandwidth(double min_leg_bps) {
+  if (precision_bw_bps_ <= 0 || min_leg_bps <= 0) return;
+  // EQuARX gate: when even the slowest observed leg moves bytes faster
+  // than the knob, the wire is not the bottleneck and quantization buys
+  // nothing — hold every bucket at its current level (promotion stalls,
+  // demotion still fires: correctness outranks the gate).
+  precision_bw_hold_ = min_leg_bps >= precision_bw_bps_;
+}
+
+void FleetPolicy::ObservePrecision(const std::string& name,
+                                   double residual_norm) {
+  if (!precision_auto_ || residual_norm < 0) return;
+  PrecState& ps = precision_[name];
+  ps.ewma = ps.ewma < 0 ? residual_norm
+                        : alpha_ * residual_norm + (1.0 - alpha_) * ps.ewma;
+  Metrics::Get().SetGauge("precision.residual#bucket=" + name, ps.ewma);
+  // Demotion is edge-triggered on the RAW sample, not the EWMA: one
+  // genuine spike must not hide behind seven smooth reports (lossy wire
+  // error compounds into the model, so react at worst-case speed).
+  if (residual_norm > precision_threshold_) {
+    ps.healthy = 0;
+    if (ps.level != 0) {
+      ps.level = 0;
+      precision_dirty_ = true;
+      ++precision_demotions_;
+      Metrics::Get().Counter("precision.demotions")
+          ->fetch_add(1, std::memory_order_relaxed);
+      fprintf(stderr,
+              "htpu policy: precision DEMOTE %s -> fp32 "
+              "(residual=%.4f > threshold=%.4f)\n",
+              name.c_str(), residual_norm, precision_threshold_);
+    }
+  } else {
+    // Promotion needs precision_ticks_ CONSECUTIVE healthy reports —
+    // the same hysteresis shape as eviction's consecutive-slow window —
+    // and a wire that is actually the bottleneck (bandwidth gate).
+    ++ps.healthy;
+    if (ps.level < 2 && !precision_bw_hold_ &&
+        ps.healthy >= precision_ticks_) {
+      ++ps.level;
+      ps.healthy = 0;
+      precision_dirty_ = true;
+      ++precision_promotions_;
+      Metrics::Get().Counter("precision.promotions")
+          ->fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  Metrics::Get().SetGauge("precision.level#bucket=" + name, ps.level);
+}
+
+int FleetPolicy::PrecisionLevel(const std::string& name) const {
+  auto it = precision_.find(name);
+  return it == precision_.end() ? 0 : it->second.level;
+}
+
+std::string FleetPolicy::PrecisionWire(const std::string& name) const {
+  switch (PrecisionLevel(name)) {
+    case 1: return "bf16";
+    case 2: return "int8";
+    default: return "";
+  }
+}
+
+double FleetPolicy::PrecisionEwma(const std::string& name) const {
+  auto it = precision_.find(name);
+  return it == precision_.end() ? -1.0 : it->second.ewma;
+}
+
+bool FleetPolicy::TakePrecisionDirty() {
+  bool d = precision_dirty_;
+  precision_dirty_ = false;
+  return d;
 }
 
 }  // namespace htpu

@@ -48,7 +48,9 @@ class FleetPolicy {
 
   // Any policy armed?  ControlPlane only keeps an instance when true,
   // so an unconfigured job pays nothing.
-  bool active() const { return evict_enabled() || autoscale_enabled(); }
+  bool active() const {
+    return evict_enabled() || autoscale_enabled() || precision_auto();
+  }
   bool evict_enabled() const { return threshold_s_ > 0; }
   bool autoscale_enabled() const {
     return !schedule_.empty() || !autoscale_file_.empty();
@@ -122,6 +124,46 @@ class FleetPolicy {
   int evict_max() const { return evict_max_; }
   int evictions() const { return evictions_; }
 
+  // ---- precision controller (the third actuator on the same engine) ----
+  // HOROVOD_TPU_PRECISION=auto arms a per-bucket wire-dtype ladder
+  // (fp32 -> bf16 -> int8) driven by worker-reported relative residual
+  // norms (FLAG_PRECISION_EXT).  Same machinery as eviction: EWMA with
+  // the shared alpha, promotion only after
+  // HOROVOD_TPU_PRECISION_TICKS consecutive healthy observations below
+  // HOROVOD_TPU_PRECISION_THRESHOLD, demotion to fp32 IMMEDIATELY on a
+  // residual spike (one bad sample outranks any history — lossy wire
+  // error is paid in model quality, not seconds).
+  bool precision_auto() const { return precision_auto_; }
+
+  // One residual-norm report for `name` (relative: ||residual|| /
+  // ||gradient||).  Updates the bucket's EWMA and ladder state; any
+  // level change marks the controller dirty (the coordinator flushes
+  // the response cache so stored sets cannot replay a stale dtype).
+  void ObservePrecision(const std::string& name, double residual_norm);
+
+  // Per-hop bandwidth gate (EQuARX: quantization only pays when the
+  // wire is the bottleneck): with HOROVOD_TPU_PRECISION_BW_BPS > 0,
+  // promotion is held while the slowest observed leg bandwidth is at or
+  // above the knob (the wire is fast enough for raw fp32).  0 disables
+  // the gate.  Fed from the PR 18 observatory's per-leg EWMAs.
+  void NotePrecisionBandwidth(double min_leg_bps);
+
+  // Current ladder level for `name`: 0 = fp32, 1 = bf16, 2 = int8.
+  // Unknown names are level 0 (never promoted without evidence).
+  int PrecisionLevel(const std::string& name) const;
+  // The level as the negotiated Response wire_dtype string ("" / "bf16"
+  // / "int8").
+  std::string PrecisionWire(const std::string& name) const;
+  // Residual-norm EWMA for `name` (-1 when no report seen).
+  double PrecisionEwma(const std::string& name) const;
+  // True once when any level changed since the last call (test-and-
+  // clear; the cache-flush edge).
+  bool TakePrecisionDirty();
+  double precision_threshold() const { return precision_threshold_; }
+  int precision_ticks() const { return precision_ticks_; }
+  long long precision_promotions() const { return precision_promotions_; }
+  long long precision_demotions() const { return precision_demotions_; }
+
   // "tick:N=S,tick:M=S2" -> sorted [(N, S), (M, S2)]; false on any
   // malformed entry (the strict Python parser in horovod_tpu/policy.py
   // rejects these at launch; this lenient half only sees raw env
@@ -156,6 +198,22 @@ class FleetPolicy {
   // Pod-level decisions (NextEviction, RerankOrder) read set 0 only.
   std::map<int32_t, std::vector<ProcState>> sets_;
   int evictions_ = 0;   // global budget, shared across all sets
+
+  // Per-bucket precision ladder state, keyed by tensor/bucket name.
+  struct PrecState {
+    double ewma = -1.0;    // relative residual-norm EWMA (-1 = no data)
+    int healthy = 0;       // consecutive reports under threshold
+    int level = 0;         // 0 = fp32, 1 = bf16, 2 = int8
+  };
+  bool precision_auto_ = false;       // HOROVOD_TPU_PRECISION == "auto"
+  double precision_threshold_ = 0.05;  // HOROVOD_TPU_PRECISION_THRESHOLD
+  int precision_ticks_ = 8;            // HOROVOD_TPU_PRECISION_TICKS
+  double precision_bw_bps_ = 0.0;      // HOROVOD_TPU_PRECISION_BW_BPS
+  bool precision_bw_hold_ = false;     // gate: wire fast enough for fp32
+  bool precision_dirty_ = false;       // any level changed since last take
+  long long precision_promotions_ = 0;
+  long long precision_demotions_ = 0;
+  std::map<std::string, PrecState> precision_;
 };
 
 }  // namespace htpu

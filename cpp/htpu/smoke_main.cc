@@ -747,6 +747,113 @@ int RunFleetPolicyPhase() {
   return rc;
 }
 
+// Precision phase: the adaptive-precision ladder under the sanitizers in
+// its live shape — the tick thread feeding ObservePrecision with a
+// planted residual spike (promote -> demote -> re-promote) while a
+// reader thread concurrently snapshots the metrics registry and retires
+// the per-bucket precision gauges, the same concurrency the
+// coordinator's tick loop and the metrics exporters run against each
+// other.  Also proves the bandwidth gate: a fat pipe holds promotion at
+// the current rung until the leg actually starves.
+int RunPrecisionPhase() {
+  setenv("HOROVOD_TPU_PRECISION", "auto", 1);
+  setenv("HOROVOD_TPU_PRECISION_TICKS", "3", 1);
+  setenv("HOROVOD_TPU_PRECISION_THRESHOLD", "0.05", 1);
+  int rc = 1;
+  do {
+    htpu::FleetPolicy policy;
+    if (!policy.active() || !policy.precision_auto()) {
+      fprintf(stderr, "smoke: precision knobs did not arm the engine\n");
+      break;
+    }
+    std::atomic<bool> done{false};
+    std::thread reader([&] {
+      while (!done.load()) {
+        void* buf = nullptr;
+        int len = htpu_metrics_snapshot(&buf);
+        if (len > 0 && buf != nullptr) htpu_free(buf);
+        htpu::Metrics::Get().RemoveMatching("precision.residual#bucket=");
+        std::this_thread::yield();
+      }
+    });
+    const std::string kBucket = "dense/kernel:0";
+    bool bad = false;
+    int flushes = 0;
+    // Healthy run: fp32 -> bf16 -> int8 (3 ticks per rung).
+    for (int t = 0; t < 6; ++t) {
+      policy.ObservePrecision(kBucket, 0.01);
+      if (policy.TakePrecisionDirty()) ++flushes;
+    }
+    if (policy.PrecisionLevel(kBucket) != 2 ||
+        policy.PrecisionWire(kBucket) != "int8") {
+      fprintf(stderr, "smoke: precision did not promote to int8 (level=%d)\n",
+              policy.PrecisionLevel(kBucket));
+      bad = true;
+    }
+    // Planted spike: one bad sample demotes to fp32 immediately.
+    if (!bad) {
+      policy.ObservePrecision(kBucket, 0.5);
+      if (policy.TakePrecisionDirty()) ++flushes;
+      if (policy.PrecisionLevel(kBucket) != 0 ||
+          !policy.PrecisionWire(kBucket).empty()) {
+        fprintf(stderr, "smoke: planted spike did not demote\n");
+        bad = true;
+      }
+    }
+    // Recovery: healthy samples climb the ladder again.
+    if (!bad) {
+      for (int t = 0; t < 3; ++t) {
+        policy.ObservePrecision(kBucket, 0.004);
+        if (policy.TakePrecisionDirty()) ++flushes;
+      }
+      if (policy.PrecisionLevel(kBucket) != 1 ||
+          policy.PrecisionWire(kBucket) != "bf16") {
+        fprintf(stderr, "smoke: ladder did not re-promote after recovery\n");
+        bad = true;
+      }
+    }
+    if (!bad && (policy.precision_promotions() != 3 ||
+                 policy.precision_demotions() != 1 || flushes != 4)) {
+      fprintf(stderr,
+              "smoke: precision counters wrong (promo=%lld demo=%lld "
+              "flushes=%d)\n",
+              policy.precision_promotions(), policy.precision_demotions(),
+              flushes);
+      bad = true;
+    }
+    done.store(true);
+    reader.join();
+    if (bad) break;
+    // Bandwidth gate: with a 1 GB/s floor armed, a fat pipe (2 GB/s)
+    // holds promotion; once the leg starves the accumulated healthy
+    // streak promotes on the next sample.
+    setenv("HOROVOD_TPU_PRECISION_BW_BPS", "1e9", 1);
+    htpu::FleetPolicy gated;
+    gated.NotePrecisionBandwidth(2e9);
+    for (int t = 0; t < 6; ++t) gated.ObservePrecision(kBucket, 0.01);
+    if (gated.PrecisionLevel(kBucket) != 0) {
+      fprintf(stderr, "smoke: bandwidth gate did not hold promotion\n");
+      unsetenv("HOROVOD_TPU_PRECISION_BW_BPS");
+      break;
+    }
+    gated.NotePrecisionBandwidth(1e8);
+    gated.ObservePrecision(kBucket, 0.01);
+    unsetenv("HOROVOD_TPU_PRECISION_BW_BPS");
+    if (gated.PrecisionLevel(kBucket) != 1) {
+      fprintf(stderr, "smoke: starved leg did not release the gate\n");
+      break;
+    }
+    fprintf(stderr,
+            "smoke: precision ladder OK (promote/demote/re-promote + "
+            "bandwidth gate)\n");
+    rc = 0;
+  } while (false);
+  unsetenv("HOROVOD_TPU_PRECISION");
+  unsetenv("HOROVOD_TPU_PRECISION_TICKS");
+  unsetenv("HOROVOD_TPU_PRECISION_THRESHOLD");
+  return rc;
+}
+
 // Process-set phase: the multi-tenant registry under the sanitizers in
 // its live shape — two disjoint tenants negotiating concurrently from
 // separate threads against the mutex-guarded ProcessSetTable, with a
@@ -1449,6 +1556,7 @@ int main() {
   }
   if (RunOverlapPlannerPhase() != 0) return 1;
   if (RunFleetPolicyPhase() != 0) return 1;
+  if (RunPrecisionPhase() != 0) return 1;
   if (RunProcessSetPhase() != 0) return 1;
   if (RunTransportPhase() != 0) return 1;
   int port = FreePort();

@@ -201,7 +201,8 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
                 | (with_algo ? kFlagAlgoExt : 0)
                 | (l.has_elastic_ext ? kFlagElasticExt : 0)
                 | (with_set ? kFlagSetExt : 0)
-                | (with_crc ? kFlagCrcExt : 0);
+                | (with_crc ? kFlagCrcExt : 0)
+                | (l.has_precision_ext ? kFlagPrecisionExt : 0);
   PutI8(out, flags);
   PutI32(out, l.abort_rank);
   PutStr(out, l.abort_reason);
@@ -213,6 +214,16 @@ void SerializeRequestList(const RequestList& l, std::string* out) {
     PutStr(out, l.cache_bits);
   }
   if (l.has_elastic_ext) PutI32(out, l.generation);
+  if (l.has_precision_ext) {
+    PutI32(out, int32_t(l.precision.size()));
+    for (const auto& p : l.precision) {
+      PutStr(out, p.first);
+      int64_t bits;
+      static_assert(sizeof(bits) == sizeof(p.second), "double is 64-bit");
+      std::memcpy(&bits, &p.second, sizeof(bits));
+      PutI64(out, bits);
+    }
+  }
   if (with_crc) PutCrcTrailer(out);
 }
 
@@ -244,6 +255,19 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
   out->generation = 0;
   if (out->has_elastic_ext) {
     if (!GetI32(data, len, &pos, &out->generation)) return false;
+  }
+  out->has_precision_ext = (flags & kFlagPrecisionExt) != 0;
+  out->precision.clear();
+  if (out->has_precision_ext) {
+    if (!GetI32(data, len, &pos, &n) || n < 0) return false;
+    out->precision.resize(size_t(n));
+    for (int32_t i = 0; i < n; ++i) {
+      auto& p = out->precision[size_t(i)];
+      int64_t bits;
+      if (!GetStr(data, len, &pos, &p.first)) return false;
+      if (!GetI64(data, len, &pos, &bits)) return false;
+      std::memcpy(&p.second, &bits, sizeof(bits));
+    }
   }
   if ((flags & kFlagCrcExt) && !CheckCrcTrailer(data, len, &pos))
     return false;

@@ -16,7 +16,7 @@
 //             sizes:vec<i64> wire_dtype:str [algo:str] [process_set:i32]
 // RequestList  := flags:i8 abort_rank:i32 abort_reason:str
 //                 requests:vec<Request> [cache_epoch:i32 bits:str]
-//                 [generation:i32]
+//                 [generation:i32] [precision:vec<name:str resid_bits:i64>]
 // ResponseList := flags:i8 abort_rank:i32 abort_reason:str
 //                 responses:vec<Response>
 //                 [cache_epoch:i32 cflags:i8
@@ -72,8 +72,16 @@ constexpr uint8_t kFlagSetExt = 0x10;
 // with integrity off never set the bit, so legacy control traffic stays
 // byte-identical (golden-frame guarded like kFlagSetExt).
 constexpr uint8_t kFlagCrcExt = 0x20;
+// Precision-telemetry extension (HOROVOD_TPU_PRECISION=auto only): the
+// RequestList carries per-bucket error-feedback residual-norm reports,
+// vec<(name:str, residual:f64 as IEEE-754 bits in i64)>, serialized after
+// the elastic extension and before the CRC trailer.  Autopilot-off frames
+// never set the bit, so static-precision traffic stays byte-identical
+// (golden-frame guarded like kFlagCrcExt).
+constexpr uint8_t kFlagPrecisionExt = 0x40;
 constexpr uint8_t kKnownFlags = kFlagShutdown | kFlagCacheExt | kFlagAlgoExt |
-                                kFlagElasticExt | kFlagSetExt | kFlagCrcExt;
+                                kFlagElasticExt | kFlagSetExt | kFlagCrcExt |
+                                kFlagPrecisionExt;
 constexpr uint8_t kCacheServed = 0x01;    // replay locally stored set
 constexpr uint8_t kCacheFlush = 0x02;     // drop all client cache state
 constexpr uint8_t kCacheStoreSet = 0x04;  // store this frame for the bits
@@ -147,6 +155,12 @@ struct RequestList {
   // from a stale generation (a worker that missed a RECONFIGURE).
   bool has_elastic_ext = false;
   int32_t generation = 0;
+  // Precision-telemetry extension (serialized only when has_precision_ext):
+  // per-bucket relative residual-norm reports for the coordinator's
+  // precision controller (policy.h).  Values are EWMA'd coordinator-side;
+  // the worker just forwards its latest measurements.
+  bool has_precision_ext = false;
+  std::vector<std::pair<std::string, double>> precision;
 };
 
 // One membership row of a RECONFIGURE frame: where the process identified

@@ -105,3 +105,48 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+
+
+# Canonical wire-compression names ("" = raw fp32) shared by BOTH planes:
+# the eager ring's ``HOROVOD_TPU_WIRE_DTYPE`` / ``compression=`` strings
+# and the in-jit ``HOROVOD_TPU_INJIT_WIRE_DTYPE`` / ``compression=``
+# strings resolve through this one table, so a name accepted on one plane
+# is accepted (with the same meaning and the same rejection message) on
+# the other.  Matches WireDtypeId in cpp/htpu/quantize.cc.
+WIRE_DTYPE_ALIASES = {
+    "": "", "fp32": "", "float32": "", "none": "",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp16": "fp16", "float16": "fp16",
+    "int8": "int8",
+}
+
+
+def canonical_wire_dtype(name, source: str = "wire dtype") -> str:
+    """Canonicalize a wire-compression name to ""/"bf16"/"fp16"/"int8".
+
+    ``source`` names the knob being parsed (e.g. ``"compression"`` or an
+    env var) so both planes reject unknown names with the identical
+    message shape: ``{source}={name!r}: expected none|fp32|bf16|fp16|int8``.
+    """
+    key = (name or "").strip().lower()
+    if key not in WIRE_DTYPE_ALIASES:
+        raise ValueError(
+            f"{source}={name!r}: expected none|fp32|bf16|fp16|int8")
+    return WIRE_DTYPE_ALIASES[key]
+
+
+def compressor_for_wire(wire: str):
+    """The Compressor implementing a canonical wire name (inverse of the
+    per-class ``wire_dtype`` mapping the eager plane stamps into
+    requests)."""
+    try:
+        return {
+            "": NoneCompressor,
+            "bf16": BF16Compressor,
+            "fp16": FP16Compressor,
+            "int8": Int8Compressor,
+        }[wire]
+    except KeyError:
+        raise ValueError(
+            f"compressor_for_wire({wire!r}): not a canonical wire dtype "
+            "(expected ''|bf16|fp16|int8)") from None

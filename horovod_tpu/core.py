@@ -334,31 +334,23 @@ def shape_debug_string(shape: Sequence[int]) -> str:
     return "[" + ", ".join(str(d) for d in shape) + "]"
 
 
-# Canonical ring wire-compression names ("" = raw fp32); alias mapping
-# matches WireDtypeId in cpp/htpu/quantize.cc so both sides agree on what
-# a request means before it hits the wire.
-_WIRE_DTYPE_ALIASES = {
-    "": "", "fp32": "", "float32": "", "none": "",
-    "bf16": "bf16", "bfloat16": "bf16",
-    "fp16": "fp16", "float16": "fp16",
-    "int8": "int8",
-}
-
-
 def normalize_wire_dtype(wire_dtype: str) -> str:
-    """Canonicalize a wire-compression name; raises on unknown names."""
-    key = (wire_dtype or "").strip().lower()
-    if key not in _WIRE_DTYPE_ALIASES:
-        raise ValueError(
-            f"Unknown wire dtype {wire_dtype!r}: expected one of "
-            "fp32/none, bf16, fp16, int8.")
-    return _WIRE_DTYPE_ALIASES[key]
+    """Canonicalize a wire-compression name; raises on unknown names.
+
+    Delegates to the shared canonicalizer in
+    :mod:`horovod_tpu.compression` so the eager ring and the in-jit
+    plane accept the same names with the same rejection message."""
+    from horovod_tpu.compression import canonical_wire_dtype
+    return canonical_wire_dtype(wire_dtype, source="wire dtype")
 
 
 def default_wire_dtype() -> str:
     """Process-wide ring compression default from HOROVOD_TPU_WIRE_DTYPE
     ("" when unset → raw fp32 wire)."""
-    return normalize_wire_dtype(os.environ.get("HOROVOD_TPU_WIRE_DTYPE", ""))
+    from horovod_tpu.compression import canonical_wire_dtype
+    return canonical_wire_dtype(
+        os.environ.get("HOROVOD_TPU_WIRE_DTYPE", ""),
+        source="HOROVOD_TPU_WIRE_DTYPE")
 
 
 # Canonical allreduce algorithm names.  "" = flat ring (the canonical form
@@ -1553,9 +1545,22 @@ class Controller:
                     tag = ps.name if ps is not None else str(r.process_set)
                     _metrics.registry.inc(
                         f"control.set_requests#process_set={tag}")
+        precision_ext = None
+        if not shutting:
+            # Adaptive-precision autopilot: piggyback the residual-norm
+            # reports measured since the last tick onto this request frame
+            # (FLAG_PRECISION_EXT).  Off (the default) contributes no
+            # bytes — frames stay byte-identical to pre-autopilot builds.
+            from horovod_tpu import precision as _precision
+            pilot = _precision.get_autopilot()
+            if pilot.enabled:
+                reports = pilot.drain_reports()
+                if reports:
+                    precision_ext = wire.RequestPrecisionExt(reports=reports)
         blob = wire.serialize_request_list(
             pending, shutdown=shutting,
-            abort_rank=abort_rank, abort_reason=abort_reason)
+            abort_rank=abort_rank, abort_reason=abort_reason,
+            precision_ext=precision_ext)
         resp_blob = self._control.tick(blob, self.fusion_threshold)
         (responses, remote_shutdown, abort, _cache_ext,
          elastic_ext) = wire.parse_response_list_elastic(resp_blob)

@@ -301,6 +301,33 @@ def _configure(lib) -> None:
         lib.htpu_policy_next_eviction_set.restype = ctypes.c_int
         lib.htpu_policy_next_eviction_set.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    # Precision controller (PR 19), same guard: a prebuilt .so from
+    # before the autopilot still loads for the rest of the surface.
+    if hasattr(lib, "htpu_policy_precision_auto"):
+        lib.htpu_policy_precision_auto.restype = ctypes.c_int
+        lib.htpu_policy_precision_auto.argtypes = [ctypes.c_void_p]
+        lib.htpu_policy_precision_observe.restype = None
+        lib.htpu_policy_precision_observe.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+        lib.htpu_policy_precision_bandwidth.restype = None
+        lib.htpu_policy_precision_bandwidth.argtypes = [
+            ctypes.c_void_p, ctypes.c_double]
+        lib.htpu_policy_precision_level.restype = ctypes.c_int
+        lib.htpu_policy_precision_level.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.htpu_policy_precision_ewma.restype = ctypes.c_double
+        lib.htpu_policy_precision_ewma.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.htpu_policy_precision_counts.restype = None
+        lib.htpu_policy_precision_counts.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong)]
+        lib.htpu_policy_precision_dirty.restype = ctypes.c_int
+        lib.htpu_policy_precision_dirty.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "htpu_wire_request_list_roundtrip"):
+        lib.htpu_wire_request_list_roundtrip.restype = ctypes.c_longlong
+        lib.htpu_wire_request_list_roundtrip.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+            ctypes.c_longlong]
     # Multi-tenant process-set registry (PR 15), same guard.
     if hasattr(lib, "htpu_process_sets_create"):
         lib.htpu_process_sets_create.restype = ctypes.c_void_p
@@ -744,6 +771,72 @@ class NativeFleetPolicy:
         return self._lib.htpu_policy_next_eviction_set(
             self._ptr, int(process_set), int(process_count),
             1 if seat_available else 0)
+
+    # -- precision controller (PR 19).  A stale .so without the
+    # precision endpoints raises, matching the parity tests' skip
+    # condition.
+
+    def _precision_lib(self):
+        if not hasattr(self._lib, "htpu_policy_precision_auto"):
+            raise RuntimeError("native precision controller not available")
+        return self._lib
+
+    def precision_auto(self) -> bool:
+        return bool(self._precision_lib().htpu_policy_precision_auto(
+            self._ptr))
+
+    def observe_precision(self, name: str, residual_norm: float) -> None:
+        self._precision_lib().htpu_policy_precision_observe(
+            self._ptr, name.encode(), float(residual_norm))
+
+    def note_precision_bandwidth(self, min_leg_bps: float) -> None:
+        self._precision_lib().htpu_policy_precision_bandwidth(
+            self._ptr, float(min_leg_bps))
+
+    def precision_level(self, name: str) -> int:
+        return self._precision_lib().htpu_policy_precision_level(
+            self._ptr, name.encode())
+
+    def precision_wire(self, name: str) -> str:
+        from .policy import PRECISION_WIRE
+        return PRECISION_WIRE[self.precision_level(name)]
+
+    def precision_ewma(self, name: str) -> float:
+        return float(self._precision_lib().htpu_policy_precision_ewma(
+            self._ptr, name.encode()))
+
+    @property
+    def precision_promotions(self) -> int:
+        counts = (ctypes.c_longlong * 2)()
+        self._precision_lib().htpu_policy_precision_counts(self._ptr, counts)
+        return int(counts[0])
+
+    @property
+    def precision_demotions(self) -> int:
+        counts = (ctypes.c_longlong * 2)()
+        self._precision_lib().htpu_policy_precision_counts(self._ptr, counts)
+        return int(counts[1])
+
+    def take_precision_dirty(self) -> bool:
+        return bool(self._precision_lib().htpu_policy_precision_dirty(
+            self._ptr))
+
+
+def wire_request_list_roundtrip(frame: bytes):
+    """Parse + re-serialize a RequestList frame through the native codec
+    (the py<->cpp framing parity hook; payload codecs have their own
+    htpu_wire_encode/decode endpoints).  Returns the re-serialized bytes,
+    or None when the loaded .so predates the endpoint.  Raises
+    ValueError when the native parser rejects the frame."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_wire_request_list_roundtrip"):
+        return None
+    cap = len(frame) + 64
+    out = ctypes.create_string_buffer(cap)
+    n = lib.htpu_wire_request_list_roundtrip(frame, len(frame), out, cap)
+    if n < 0:
+        raise ValueError("native RequestList parse rejected the frame")
+    return out.raw[:n]
 
 
 def _process_sets_lib():

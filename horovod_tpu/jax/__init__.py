@@ -105,6 +105,14 @@ def DistributedOptimizer(
     ``overlap`` (default: the ``HOROVOD_TPU_OVERLAP`` knob) enables
     backward-overlap on the eager path: see
     :func:`allreduce_gradients`.
+
+    ``compression="auto"`` hands the wire-dtype choice to the adaptive
+    precision autopilot (``HOROVOD_TPU_PRECISION=auto``,
+    :mod:`horovod_tpu.precision`): requests go out raw, measured residual
+    norms ride the request wire to the coordinator, and the negotiated
+    Response carries the per-bucket dtype every rank honors.  The
+    ``error_feedback`` residual carry is a no-op under ``"auto"`` (the
+    ladder demotes on residual spikes instead of carrying them).
     """
 
     def _residual_leaf(p):
@@ -192,6 +200,14 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
     materializes on device, instead of after the whole tree is reduced
     leaf-by-leaf.  Payload packing is identical whether the bucket is
     issued early or late, so overlap changes timing, never math.
+
+    ``compression="auto"`` engages the adaptive-precision autopilot: on
+    the eager path requests are submitted raw (``wire_dtype=""``), the
+    measured int8-grid residual norm of each reduced bucket is queued
+    for the next request frame's precision ext, and the coordinator's
+    negotiated Response decides the wire dtype; in SPMD context the
+    process-local mirror (:func:`horovod_tpu.precision.get_autopilot`)
+    supplies a per-leaf plan at trace time instead.
     """
     from horovod_tpu import sparse as _sparse
     if sparse_as_dense:
@@ -202,11 +218,17 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
     # both the SPMD branch and the eager fallback below need a real
     # Compressor for the non-fp32 compress/decompress calls.
     compression = _qc.resolve_injit_compression(compression)
+    auto = _qc.is_auto(compression)
+    if auto:
+        # Adaptive-precision autopilot: eager requests go out RAW
+        # (wire_dtype="") and the negotiated Response carries the
+        # coordinator's per-bucket choice; the SPMD branch reads the
+        # process-local mirror per leaf at trace time instead.
+        compression = NoneCompressor
     if _in_spmd_context(axis_name):
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-        comp = compression
 
-        def one(g):
+        def one(g, comp):
             if _is_sparse(g):
                 return _sparse.allreduce(g, average=average,
                                          axis_name=axis_name)
@@ -235,7 +257,23 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
                 red = (lax.pmean(c, axis_name) if average
                        else lax.psum(c, axis_name))
             return leaf_comp.decompress(red, ctx)
-        return jax.tree.map(one, grads, is_leaf=_is_sparse)
+        if auto:
+            # Per-leaf wire dtype from the autopilot mirror, read at
+            # TRACE time (the compiled program bakes the plan in; the
+            # caller retraces when the mirror's plan_version moves —
+            # make_train_step(compression="auto") does this itself).
+            import jax.tree_util as jtu
+            from horovod_tpu import precision as _precision
+            from horovod_tpu.compression import compressor_for_wire
+            pilot = _precision.get_autopilot()
+            return jtu.tree_map_with_path(
+                lambda path, g: one(g, compressor_for_wire(
+                    pilot.wire_dtype_for(
+                        f"{name_prefix}{jtu.keystr(path)}"))),
+                grads, is_leaf=_is_sparse)
+        comp = compression
+        return jax.tree.map(lambda g: one(g, comp), grads,
+                            is_leaf=_is_sparse)
     # Eager path: compression is applied per-leaf around the negotiated op.
     leaves, treedef = jax.tree.flatten(grads, is_leaf=_is_sparse)
     flat_arrays = [a for l in leaves
@@ -252,7 +290,7 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
     if _sched.overlap_enabled(overlap):
         return _overlapped_allreduce(leaves, treedef, average=average,
                                      compression=compression,
-                                     name_prefix=name_prefix)
+                                     name_prefix=name_prefix, auto=auto)
     handles, ctxs = [], []
     for i, leaf in enumerate(leaves):
         if _is_sparse(leaf):
@@ -292,7 +330,42 @@ def allreduce_gradients(grads, *, axis_name=RANKS_AXIS, average: bool = True,
         else:
             outs.append(compression.decompress(
                 jnp.asarray(_eager.synchronize(h)), ctx))
+    if auto:
+        for i, (leaf, out) in enumerate(zip(leaves, outs)):
+            if not _is_sparse(leaf):
+                _note_auto_residual(f"{name_prefix}.{i}", out)
     return jax.tree.unflatten(treedef, outs)
+
+
+def _note_auto_residual(name: str, reduced, flat_ok: bool = False) -> None:
+    """Feed the adaptive-precision autopilot one measured residual: the
+    relative norm of the error the int8 grid (the ladder's most
+    aggressive rung) would introduce on this reduced gradient.  bf16's
+    error is strictly smaller, so one measurement bounds the whole
+    ladder.  Reduced gradients are identical on every rank, so every
+    process reports the same value and per-process mirrors stay in
+    lockstep.  No-op unless ``HOROVOD_TPU_PRECISION=auto``."""
+    from horovod_tpu import precision as _precision
+    pilot = _precision.get_autopilot()
+    if not pilot.enabled:
+        return
+    if jnp.result_type(reduced) != jnp.float32:
+        return
+    if flat_ok:
+        # Fused overlap bucket: already a bulk 1-D payload — apply the
+        # size floor only (int8_eligible's >=2-D test is a per-leaf rule).
+        size = int(np.prod(jnp.shape(reduced))) if jnp.shape(reduced) else 1
+        if size * 4 < _qc.int8_floor_bytes():
+            return
+    elif not _qc.int8_eligible(jnp.shape(reduced), jnp.result_type(reduced)):
+        return
+    g = jnp.asarray(reduced, dtype=jnp.float32)
+    denom = float(jnp.linalg.norm(g.ravel()))
+    if denom <= 0.0:
+        pilot.note_residual(name, 0.0)
+        return
+    r = g - _qc.snap_to_grid(g)
+    pilot.note_residual(name, float(jnp.linalg.norm(r.ravel())) / denom)
 
 
 def _leaf_is_ready(arr) -> bool:
@@ -308,7 +381,7 @@ def _leaf_is_ready(arr) -> bool:
 
 
 def _overlapped_allreduce(leaves, treedef, *, average, compression,
-                          name_prefix):
+                          name_prefix, auto: bool = False):
     """Backward-overlap eager reduction (HOROVOD_TPU_OVERLAP).
 
     float32 leaves are packed into scheduler buckets and each bucket's
@@ -396,6 +469,12 @@ def _overlapped_allreduce(leaves, treedef, *, average, compression,
     for b in issue_seq:
         red = np.asarray(_eager.synchronize(bucket_handles[b]))
         planner.note_complete(b)
+        if auto:
+            # The negotiated name under overlap is the BUCKET, so the
+            # residual report (and the coordinator's dtype choice) is
+            # per bucket too.
+            _note_auto_residual(f"{name_prefix}.bucket{b}", red,
+                                flat_ok=True)
         off = 0
         for i in bucket_leaves[b]:
             n = arrs[i].size

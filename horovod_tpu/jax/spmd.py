@@ -81,6 +81,8 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
     compression = _qc.resolve_injit_compression(compression)
     bucket_bytes = _sched.bucket_bytes_from_env(bucket_bytes)
     overlap = _sched.overlap_enabled(overlap)
+    if compression is _AUTO_FROZEN or _qc.is_auto(compression):
+        return _reduce_auto(grads, axis_names, average=average)
     hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
     if (_qc.is_int8(compression) and not hierarchical
             and len(axis_names) == 1):
@@ -142,6 +144,61 @@ def reduce_gradients(grads, axis_names: Tuple[str, ...], *,
     return jax.tree.unflatten(treedef, [
         compression.decompress(r, ctx)
         for r, (_, ctx) in zip(wire, compressed)])
+
+
+class _AutoPlanFrozen:
+    """Internal marker: one frozen trace of the adaptive-precision
+    autopilot's CURRENT per-leaf plan.  ``make_train_step`` passes it to
+    its inner build so the recursive call does not re-enter the auto
+    dispatch wrapper; ``resolve_injit_compression`` passes it through
+    untouched (it is neither a string nor the default compressor)."""
+
+
+_AUTO_FROZEN = _AutoPlanFrozen()
+
+
+def _reduce_auto(grads, axis_names, *, average: bool):
+    """Per-leaf reduction under the adaptive-precision autopilot
+    (``compression="auto"``).
+
+    Each leaf's wire dtype is read from the process-local mirror
+    (:func:`horovod_tpu.precision.get_autopilot`) at TRACE time and
+    baked into the compiled program — ``make_train_step`` retraces when
+    the mirror's ``plan_version`` moves.  Reduction is per leaf (no
+    concat staging): on the flat mesh XLA's AllReduce combiner batches
+    adjacent same-dtype collectives itself, and int8 leaves ride the
+    quantized ring individually.  Leaves are named by their tree path
+    (``grads['layer']['w']``) — the bucket key the mirror's ladder and
+    the ``precision.*`` metrics use on this plane.
+    """
+    import jax.tree_util as jtu
+    from horovod_tpu import precision as _precision
+    from horovod_tpu.compression import compressor_for_wire
+    pilot = _precision.get_autopilot()
+    hierarchical = set(axis_names) == {DCN_AXIS, ICI_AXIS}
+
+    def one(path, g):
+        comp = compressor_for_wire(
+            pilot.wire_dtype_for(f"grads{jtu.keystr(path)}"))
+        if (_qc.is_int8(comp) and not hierarchical
+                and len(axis_names) == 1
+                and _qc.int8_eligible(g.shape, g.dtype)):
+            flat = g.ravel().astype(jnp.float32)
+            red = _qc.quantized_ring_allreduce(flat, axis_names[0],
+                                               average=average)
+            return red.reshape(g.shape).astype(g.dtype)
+        if _qc.is_int8(comp) and not _qc.int8_eligible(g.shape, g.dtype):
+            comp = NoneCompressor
+        c, ctx = comp.compress(g)
+        if hierarchical:
+            red = hierarchical_allreduce(c, average=average)
+        elif average:
+            red = lax.pmean(c, axis_names)
+        else:
+            red = lax.psum(c, axis_names)
+        return comp.decompress(red, ctx)
+
+    return jtu.tree_map_with_path(one, grads)
 
 
 def _reduce_flat_int8(grads, axis: str, *, average: bool, fuse: bool,
@@ -520,7 +577,39 @@ def make_train_step(
     ``overlap`` (default: the ``HOROVOD_TPU_OVERLAP`` knob) stages
     bucket collectives in backward order so they interleave with the
     remaining backprop — see :func:`reduce_gradients`.
+
+    ``compression="auto"`` (pair with ``HOROVOD_TPU_PRECISION=auto``)
+    lets the adaptive-precision autopilot pick each leaf's wire dtype:
+    the returned step rebuilds its compiled program whenever the
+    autopilot's plan changes (one retrace per promote/demote).  AOT
+    ``.lower()`` is unavailable in this mode.
     """
+    if _qc.is_auto(compression):
+        # Adaptive-precision autopilot: the per-leaf wire plan is read
+        # from the process-local mirror at trace time, so the compiled
+        # program goes stale when the ladder moves a bucket.  Wrap the
+        # build in a dispatcher that rebuilds (one retrace) whenever the
+        # mirror's plan_version changes — promote/demote between steps,
+        # not within one.  AOT ``.lower()`` is not supported here: an
+        # ahead-of-time program cannot follow the ladder.
+        from horovod_tpu import precision as _precision
+        cell = {"v": None, "step": None}
+
+        def _rebuild(version):
+            cell["v"] = version
+            cell["step"] = make_train_step(
+                loss_fn, optimizer, mesh, average=average,
+                compression=_AUTO_FROZEN, sync_aux_state=sync_aux_state,
+                donate=donate, batch_spec=batch_spec,
+                steps_per_call=steps_per_call, fuse=fuse, overlap=overlap)
+
+        def dispatch(params, aux_state, opt_state, batch):
+            v = _precision.get_autopilot().plan_version
+            if cell["step"] is None or cell["v"] != v:
+                _rebuild(v)
+            return cell["step"](params, aux_state, opt_state, batch)
+
+        return dispatch
     axes = tuple(mesh.axis_names)
     compression = _qc.resolve_injit_compression(compression)
     overlap = _sched.overlap_enabled(overlap)

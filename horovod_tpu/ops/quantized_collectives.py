@@ -86,34 +86,34 @@ def resolve_injit_compression(compression):
     does (``"none"``/``"bf16"``/``"fp16"``/``"int8"``); an explicit
     ``"none"`` string pins the raw wire regardless of the env.
     """
-    from horovod_tpu.compression import Compression, NoneCompressor
+    from horovod_tpu.compression import (
+        NoneCompressor, canonical_wire_dtype, compressor_for_wire)
+    if is_auto(compression):
+        # Adaptive-precision autopilot (HOROVOD_TPU_PRECISION=auto): not a
+        # static compressor — callers resolve per bucket at trace/submit
+        # time through horovod_tpu.precision.  Passed through unchanged.
+        return compression
     if isinstance(compression, str):
-        name = compression.strip().lower()
-        if name in ("none", "fp32", "float32"):
-            return NoneCompressor
-        if name in ("bf16", "bfloat16"):
-            return Compression.bf16
-        if name in ("fp16", "float16"):
-            return Compression.fp16
-        if name == "int8":
-            return Compression.int8
-        raise ValueError(
-            f"compression={name!r}: expected none|fp32|bf16|fp16|int8")
+        # Explicit string wins outright — including "none", which pins the
+        # raw wire regardless of the env knob.
+        return compressor_for_wire(canonical_wire_dtype(
+            compression.strip().lower(), source="compression"))
     is_default = (compression is NoneCompressor
                   or isinstance(compression, NoneCompressor))
     if not is_default:
         return compression
     name = os.environ.get(_ENV_WIRE, "").strip().lower()
-    if name in ("", "none", "fp32", "float32"):
+    wire = canonical_wire_dtype(name, source=_ENV_WIRE)
+    if wire == "":
         return compression
-    if name in ("bf16", "bfloat16"):
-        return Compression.bf16
-    if name in ("fp16", "float16"):
-        return Compression.fp16
-    if name == "int8":
-        return Compression.int8
-    raise ValueError(
-        f"{_ENV_WIRE}={name!r}: expected none|fp32|bf16|fp16|int8")
+    return compressor_for_wire(wire)
+
+
+def is_auto(compression) -> bool:
+    """True for the ``compression="auto"`` marker — wire dtype chosen per
+    bucket by the adaptive-precision autopilot rather than statically."""
+    return (isinstance(compression, str)
+            and compression.strip().lower() == "auto")
 
 
 def is_int8(compression) -> bool:

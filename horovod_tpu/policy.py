@@ -113,6 +113,53 @@ def rerank_enabled_from_env() -> bool:
     return os.environ.get("HOROVOD_TPU_POLICY_RERANK", "1") != "0"
 
 
+def precision_auto_from_env() -> bool:
+    """``HOROVOD_TPU_PRECISION``: ``auto`` arms the per-bucket wire-dtype
+    ladder; anything else (default ``static``) keeps the static
+    ``compression=`` knobs authoritative."""
+    return os.environ.get("HOROVOD_TPU_PRECISION", "static") == "auto"
+
+
+def precision_threshold_from_env() -> float:
+    """``HOROVOD_TPU_PRECISION_THRESHOLD``: relative residual-norm
+    ceiling — one raw sample above it demotes the bucket to fp32."""
+    raw = os.environ.get("HOROVOD_TPU_PRECISION_THRESHOLD", "0.05")
+    try:
+        v = float(raw)
+        return v if v > 0 else 0.05
+    except ValueError:
+        return 0.05
+
+
+def precision_ticks_from_env() -> int:
+    """``HOROVOD_TPU_PRECISION_TICKS``: consecutive healthy reports
+    before a bucket is promoted one ladder level (the hysteresis
+    window, same shape as ``HOROVOD_TPU_EVICT_TICKS``)."""
+    raw = os.environ.get("HOROVOD_TPU_PRECISION_TICKS", "8")
+    try:
+        v = int(raw)
+        return v if v > 0 else 8
+    except ValueError:
+        return 8
+
+
+def precision_bw_bps_from_env() -> float:
+    """``HOROVOD_TPU_PRECISION_BW_BPS``: bandwidth gate — promotion is
+    held while the slowest observed leg is at or above this many
+    bytes/s (the wire is not the bottleneck, so quantization buys
+    nothing — the EQuARX rationale).  0 (default) disables the gate."""
+    raw = os.environ.get("HOROVOD_TPU_PRECISION_BW_BPS", "0")
+    try:
+        v = float(raw)
+        return v if v >= 0 else 0.0
+    except ValueError:
+        return 0.0
+
+
+#: Ladder level -> negotiated wire dtype ("" = raw fp32).
+PRECISION_WIRE = ("", "bf16", "int8")
+
+
 class _ProcState:
     __slots__ = ("ewma", "valid", "consecutive", "suppress_logged")
 
@@ -121,6 +168,15 @@ class _ProcState:
         self.valid = False
         self.consecutive = 0
         self.suppress_logged = False
+
+
+class _PrecState:
+    __slots__ = ("ewma", "healthy", "level")
+
+    def __init__(self):
+        self.ewma = -1.0    # relative residual-norm EWMA (-1 = no data)
+        self.healthy = 0    # consecutive reports under threshold
+        self.level = 0      # 0 = fp32, 1 = bf16, 2 = int8
 
 
 class FleetPolicy:
@@ -148,6 +204,16 @@ class FleetPolicy:
         # collectives is never nominated for eviction from another's.
         self._sets: Dict[int, List[_ProcState]] = {}
         self._evictions = 0   # global budget, shared across all sets
+        # Precision ladder (the third actuator on the same engine).
+        self._precision_auto = precision_auto_from_env()
+        self._precision_threshold = precision_threshold_from_env()
+        self._precision_ticks = precision_ticks_from_env()
+        self._precision_bw_bps = precision_bw_bps_from_env()
+        self._precision_bw_hold = False
+        self._precision_dirty = False
+        self._precision_promotions = 0
+        self._precision_demotions = 0
+        self._precision: Dict[str, _PrecState] = {}
 
     # ------------------------------------------------------- arming state
 
@@ -158,7 +224,11 @@ class FleetPolicy:
         return bool(self._schedule) or bool(self._autoscale_file)
 
     def active(self) -> bool:
-        return self.evict_enabled() or self.autoscale_enabled()
+        return (self.evict_enabled() or self.autoscale_enabled()
+                or self.precision_auto())
+
+    def precision_auto(self) -> bool:
+        return self._precision_auto
 
     def rerank_enabled(self) -> bool:
         return self._rerank and self.active()
@@ -355,6 +425,91 @@ class FleetPolicy:
             except (OSError, ValueError, IndexError):
                 pass
         return target
+
+    # ------------------------------------------------ precision controller
+
+    @property
+    def precision_threshold(self) -> float:
+        return self._precision_threshold
+
+    @property
+    def precision_ticks(self) -> int:
+        return self._precision_ticks
+
+    @property
+    def precision_promotions(self) -> int:
+        return self._precision_promotions
+
+    @property
+    def precision_demotions(self) -> int:
+        return self._precision_demotions
+
+    def note_precision_bandwidth(self, min_leg_bps: float) -> None:
+        """EQuARX gate: when even the slowest observed leg moves bytes
+        faster than ``HOROVOD_TPU_PRECISION_BW_BPS``, the wire is not
+        the bottleneck and quantization buys nothing — promotion stalls
+        (demotion still fires: correctness outranks the gate)."""
+        if self._precision_bw_bps <= 0 or min_leg_bps <= 0:
+            return
+        self._precision_bw_hold = min_leg_bps >= self._precision_bw_bps
+
+    def observe_precision(self, name: str, residual_norm: float) -> None:
+        """One residual-norm report for bucket ``name`` (relative:
+        ``||residual|| / ||gradient||``).  Demotion is edge-triggered on
+        the RAW sample, not the EWMA: one genuine spike must not hide
+        behind seven smooth reports.  Promotion needs
+        ``precision_ticks`` CONSECUTIVE healthy reports — the same
+        hysteresis shape as eviction's consecutive-slow window."""
+        if not self._precision_auto or residual_norm < 0:
+            return
+        ps = self._precision.setdefault(name, _PrecState())
+        ps.ewma = (residual_norm if ps.ewma < 0
+                   else EWMA_ALPHA * residual_norm
+                   + (1.0 - EWMA_ALPHA) * ps.ewma)
+        from .metrics import registry
+        registry.set_gauge(f"precision.residual#bucket={name}", ps.ewma)
+        if residual_norm > self._precision_threshold:
+            ps.healthy = 0
+            if ps.level != 0:
+                ps.level = 0
+                self._precision_dirty = True
+                self._precision_demotions += 1
+                registry.inc("precision.demotions")
+                print(f"horovod_tpu policy: precision DEMOTE {name} -> "
+                      f"fp32 (residual={residual_norm:.4f} > threshold="
+                      f"{self._precision_threshold:.4f})", file=sys.stderr)
+        else:
+            ps.healthy += 1
+            if (ps.level < 2 and not self._precision_bw_hold
+                    and ps.healthy >= self._precision_ticks):
+                ps.level += 1
+                ps.healthy = 0
+                self._precision_dirty = True
+                self._precision_promotions += 1
+                registry.inc("precision.promotions")
+        registry.set_gauge(f"precision.level#bucket={name}", ps.level)
+
+    def precision_level(self, name: str) -> int:
+        """Ladder level for ``name``: 0 = fp32, 1 = bf16, 2 = int8.
+        Unknown names are level 0 (never promoted without evidence)."""
+        ps = self._precision.get(name)
+        return ps.level if ps is not None else 0
+
+    def precision_wire(self, name: str) -> str:
+        """The level as the negotiated Response wire_dtype string."""
+        return PRECISION_WIRE[self.precision_level(name)]
+
+    def precision_ewma(self, name: str) -> float:
+        """Residual-norm EWMA for ``name`` (-1 when no report seen)."""
+        ps = self._precision.get(name)
+        return ps.ewma if ps is not None else -1.0
+
+    def take_precision_dirty(self) -> bool:
+        """True once when any level changed since the last call
+        (test-and-clear; the coordinator's cache-flush edge)."""
+        d = self._precision_dirty
+        self._precision_dirty = False
+        return d
 
     def on_reconfigure(self, old_to_new: Sequence[int],
                        new_count: int) -> None:

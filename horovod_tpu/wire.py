@@ -46,8 +46,16 @@ FLAG_SET_EXT = 0x10
 # with integrity off never set the bit, so legacy control traffic stays
 # byte-identical (golden-frame guarded like FLAG_SET_EXT).
 FLAG_CRC_EXT = 0x20
+# Precision-telemetry extension (HOROVOD_TPU_PRECISION=auto only): the
+# RequestList carries per-bucket error-feedback residual-norm reports,
+# vec<(name:str, residual:f64)>, serialized after the elastic extension and
+# before the CRC trailer.  Autopilot-off frames never set the bit, so
+# static-precision traffic stays byte-identical (golden-frame guarded like
+# FLAG_CRC_EXT).
+FLAG_PRECISION_EXT = 0x40
 _KNOWN_FLAGS = (FLAG_SHUTDOWN | FLAG_CACHE_EXT | FLAG_ALGO_EXT
-                | FLAG_ELASTIC_EXT | FLAG_SET_EXT | FLAG_CRC_EXT)
+                | FLAG_ELASTIC_EXT | FLAG_SET_EXT | FLAG_CRC_EXT
+                | FLAG_PRECISION_EXT)
 
 # Response-cache extension cflags (ResponseList direction only).
 CACHE_SERVED = 0x01   # replay the locally stored response set for the bits
@@ -86,6 +94,19 @@ class RequestElasticExt:
     sender's membership generation, so the coordinator can reject frames
     from a worker that missed a RECONFIGURE."""
     generation: int = 0
+
+
+@dataclasses.dataclass
+class RequestPrecisionExt:
+    """Trailing RequestList precision extension:
+    ``vec<(name:str, residual:f64)>`` — this worker's latest per-bucket
+    relative residual-norm measurements (||error-feedback residual|| /
+    ||gradient||).  The coordinator's precision controller EWMAs them and
+    picks the wire dtype per bucket; the worker just forwards raw
+    measurements.  The f64 is the IEEE-754 bit pattern little-endian, so
+    the value survives the py↔cpp boundary exactly."""
+    reports: List[Tuple[str, float]] = dataclasses.field(
+        default_factory=list)
 
 
 @dataclasses.dataclass
@@ -319,6 +340,7 @@ def serialize_request_list(requests: List[Request],
                            abort_reason: str = "",
                            cache_ext: Optional[RequestCacheExt] = None,
                            elastic_ext: Optional[RequestElasticExt] = None,
+                           precision_ext: Optional[RequestPrecisionExt] = None,
                            ) -> bytes:
     # Without a cache extension the output is byte-identical to the legacy
     # (pre-cache) format, so HOROVOD_TPU_CACHE_CAPACITY=0 stays on the old
@@ -337,6 +359,8 @@ def serialize_request_list(requests: List[Request],
     with_crc = integrity_enabled()
     if with_crc:
         flags |= FLAG_CRC_EXT
+    if precision_ext is not None:
+        flags |= FLAG_PRECISION_EXT
     out = bytearray()
     out += struct.pack("<B", flags)
     out += struct.pack("<i", abort_rank)
@@ -350,14 +374,19 @@ def serialize_request_list(requests: List[Request],
         out += cache_ext.bits
     if elastic_ext is not None:
         out += struct.pack("<i", elastic_ext.generation)
+    if precision_ext is not None:
+        out += struct.pack("<i", len(precision_ext.reports))
+        for name, residual in precision_ext.reports:
+            _put_str(out, name)
+            out += struct.pack("<d", residual)
     if with_crc:
         _put_crc_trailer(out)
     return bytes(out)
 
 
-def parse_request_list_elastic(data: bytes) -> Tuple[
+def parse_request_list_precision(data: bytes) -> Tuple[
         List[Request], bool, Abort, Optional[RequestCacheExt],
-        Optional[RequestElasticExt]]:
+        Optional[RequestElasticExt], Optional[RequestPrecisionExt]]:
     rd = _Reader(data)
     flags = rd.i8()
     _check_flags(flags, "request list")
@@ -377,6 +406,15 @@ def parse_request_list_elastic(data: bytes) -> Tuple[
     elastic = None
     if flags & FLAG_ELASTIC_EXT:
         elastic = RequestElasticExt(generation=rd.i32())
+    precision = None
+    if flags & FLAG_PRECISION_EXT:
+        reports = []
+        for _ in range(rd.i32()):
+            name = rd.str_()
+            (residual,) = struct.unpack_from("<d", rd.data, rd.pos)
+            rd.pos += 8
+            reports.append((name, residual))
+        precision = RequestPrecisionExt(reports=reports)
     if flags & FLAG_CRC_EXT:
         _check_crc_trailer(rd, "request list")
     if rd.pos != len(data):
@@ -384,6 +422,15 @@ def parse_request_list_elastic(data: bytes) -> Tuple[
             f"trailing bytes in request list: parsed {rd.pos} of "
             f"{len(data)} bytes (corrupt or truncated frame)")
     abort = (abort_rank, abort_reason) if abort_rank >= 0 else None
+    return reqs, shutdown, abort, ext, elastic, precision
+
+
+def parse_request_list_elastic(data: bytes) -> Tuple[
+        List[Request], bool, Abort, Optional[RequestCacheExt],
+        Optional[RequestElasticExt]]:
+    """Precision-agnostic view: tolerates (and discards) the v4 extension."""
+    reqs, shutdown, abort, ext, elastic, _ = (
+        parse_request_list_precision(data))
     return reqs, shutdown, abort, ext, elastic
 
 

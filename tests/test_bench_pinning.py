@@ -142,12 +142,22 @@ class TestBenchSummary:
     REPORT = {
         "step_time_ms": 123.4,
         "mfu": 0.33,
-        "transformer_lm": {"step_time_ms": 516.9, "mfu": 0.74},
+        "transformer_lm": {
+            "step_time_ms": 516.9, "mfu": 0.74,
+            "injit_wire_ab": {
+                "fp32": {"step_time_ms": 50.0},
+                "auto": {"step_time_ms": 49.0,
+                         "buckets_by_wire": {"bf16": 3, "fp32": 1}},
+                "auto_vs_best_static": 1.02,
+            },
+        },
         "scaling_virtual_8dev": {"scaling_efficiency": 0.12},
         "scaling_tcp_2proc": {
             "scaling_efficiency": 0.33,
             "comm_fraction": 0.35,
-            "wire_compression": {"fp32": {"step_time_ms": 42.0}},
+            "wire_compression": {"fp32": {"step_time_ms": 42.0},
+                                 "auto": {"step_time_ms": 41.0,
+                                          "vs_best_static": 1.01}},
             "overlap_ab": {"off": {}, "on": {}},
             "xport_sweep": {"shm_vs_uds_speedup_256k_plus": 1.4,
                             "crc_overhead_256k_plus": {"max": 0.03}},
@@ -157,9 +167,21 @@ class TestBenchSummary:
         },
     }
 
+    # The r07 artifact schema: trend lines parse these exact keys, so a
+    # rename or drop is an interface break, not a refactor.
+    R07_KEYS = {
+        "resnet_step_time_ms", "resnet_mfu",
+        "transformer_step_time_ms", "transformer_mfu",
+        "virtual_scaling_efficiency", "tcp_scaling_efficiency",
+        "tcp_step_time_ms", "tcp_comm_fraction", "overlap_ab",
+        "shm_vs_uds_speedup_256k_plus", "crc_overhead_256k_plus",
+        "observe_ab", "precision_auto_tcp_vs_best_static",
+        "precision_auto_injit_vs_best_static", "precision_auto_injit",
+    }
+
     def test_headlines_extracted(self, tmp_path, bench_mod):
         import json
-        path = str(tmp_path / "BENCH_r06.json")
+        path = str(tmp_path / "BENCH_r07.json")
         assert bench_mod.write_bench_summary(self.REPORT, path) == path
         s = json.loads(open(path).read())
         assert s["resnet_step_time_ms"] == 123.4
@@ -168,6 +190,23 @@ class TestBenchSummary:
         assert s["tcp_step_time_ms"] == 42.0
         assert s["crc_overhead_256k_plus"] == 0.03
         assert s["observe_ab"]["overhead_fraction"] == 0.01
+        assert s["precision_auto_tcp_vs_best_static"] == 1.01
+        assert s["precision_auto_injit_vs_best_static"] == 1.02
+        assert s["precision_auto_injit"]["buckets_by_wire"] == {
+            "bf16": 3, "fp32": 1}
+
+    def test_r07_schema_pinned(self, tmp_path, bench_mod):
+        import json
+        path = str(tmp_path / "BENCH_r07.json")
+        bench_mod.write_bench_summary(self.REPORT, path)
+        assert set(json.loads(open(path).read())) == self.R07_KEYS
+
+    def test_default_artifact_name_is_r07(self, bench_mod, monkeypatch,
+                                          tmp_path):
+        monkeypatch.delenv("BENCH_SUMMARY_FILE", raising=False)
+        monkeypatch.chdir(tmp_path)
+        assert bench_mod.write_bench_summary({}) == "BENCH_r07.json"
+        assert (tmp_path / "BENCH_r07.json").exists()
 
     def test_missing_legs_become_none_not_errors(self, tmp_path, bench_mod):
         import json

@@ -213,6 +213,44 @@ class TestObservatoryDigest:
         assert "-- observatory --" in out
 
 
+class TestPrecisionDigest:
+    """Adaptive-precision digest (PR: adaptive precision autopilot)."""
+
+    def _snap(self):
+        return {"rank": 0, "ts": 100,
+                "counters": {"precision.promotions": 3,
+                             "precision.demotions": 1},
+                "gauges": {
+                    "precision.level#bucket=dense/kernel:0": 2,
+                    "precision.residual#bucket=dense/kernel:0": 0.012,
+                    "precision.level#bucket=dense/bias:0": 0,
+                    "precision.residual#bucket=dense/bias:0": 0.21},
+                "histograms": {}}
+
+    def test_one_line_per_bucket_with_wire_dtype(self):
+        lines = metrics_watch.render_precision_summary(self._snap(), "")
+        text = "\n".join(lines)
+        assert "-- adaptive precision --" in text
+        kernel = next(ln for ln in lines if "dense/kernel:0" in ln)
+        assert "wire=int8" in kernel and "residual_ewma=0.012" in kernel
+        bias = next(ln for ln in lines if "dense/bias:0" in ln)
+        assert "wire=fp32" in bias and "residual_ewma=0.21" in bias
+
+    def test_demotions_are_loud(self):
+        lines = metrics_watch.render_precision_summary(self._snap(), "")
+        fleet = next(ln for ln in lines if "promotions" in ln)
+        assert "promotions=3" in fleet and "DEMOTIONS=1" in fleet
+
+    def test_absent_when_autopilot_never_engaged(self):
+        snap = {"counters": {"control.ticks": 3}, "gauges": {},
+                "histograms": {}}
+        assert metrics_watch.render_precision_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(), None, "")
+        assert "-- adaptive precision --" in out
+
+
 class TestBadInputs:
     """Missing/empty inputs produce a one-line error, not a traceback or
     silence (PR: static analysis)."""

@@ -94,8 +94,10 @@ class TestWireCacheExt:
         assert shutdown and got is None
 
     def test_unknown_flag_bits_rejected(self):
+        # 0x80 is the last unassigned flag bit (0x40 became
+        # FLAG_PRECISION_EXT in PR 19).
         blob = bytearray(wire.serialize_request_list([req(0)]))
-        blob[0] |= 0x40
+        blob[0] |= 0x80
         with pytest.raises(ValueError, match="unknown flag bits"):
             wire.parse_request_list_ex(bytes(blob))
         blob = bytearray(wire.serialize_response_list([]))

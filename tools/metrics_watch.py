@@ -418,6 +418,52 @@ def render_observatory_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def render_precision_summary(snap: dict, name_filter: str) -> list[str]:
+    """Adaptive-precision autopilot digest (``HOROVOD_TPU_PRECISION=auto``,
+    docs/observability.md): one line per negotiated bucket joining the
+    ``precision.level#bucket=`` gauge (the ladder rung the coordinator
+    stamped, shown as its wire dtype) with the ``precision.residual#bucket=``
+    EWMA it was judged on, plus the fleet-wide promotion/demotion
+    counters.  Demotions are loud (upper-case, like FALLBACKS): a nonzero
+    count means a residual spike forced at least one bucket back to
+    fp32."""
+    level_prefix = "precision.level#bucket="
+    resid_prefix = "precision.residual#bucket="
+    wire_by_level = {0: "fp32", 1: "bf16", 2: "int8"}
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    buckets = sorted({k[len(level_prefix):] for k in gauges
+                      if k.startswith(level_prefix)}
+                     | {k[len(resid_prefix):] for k in gauges
+                        if k.startswith(resid_prefix)})
+    promos = counters.get("precision.promotions", 0)
+    demos = counters.get("precision.demotions", 0)
+    if not buckets and not promos and not demos:
+        return []
+    lines = []
+    for bucket in buckets:
+        name = f"precision[{bucket}]"
+        if name_filter and name_filter not in name:
+            continue
+        level = gauges.get(level_prefix + bucket)
+        text = (f"wire={wire_by_level.get(int(level), f'level{level:g}')}"
+                if level is not None else "wire=?")
+        resid = gauges.get(resid_prefix + bucket)
+        if resid is not None:
+            text += f" residual_ewma={resid:.3g}"
+        lines.append(f"  {name:<52} {text}")
+    if (promos or demos) and (not name_filter
+                              or name_filter in "precision.promotions"
+                              or name_filter in "precision.demotions"):
+        text = f"promotions={promos:g}"
+        if demos:
+            text += f" DEMOTIONS={demos:g}"
+        lines.append(f"  {'precision':<52} {text}")
+    if lines:
+        lines.insert(0, "  -- adaptive precision --")
+    return lines
+
+
 def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     rank = snap.get("rank", "?")
     ts = snap.get("ts")
@@ -470,6 +516,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_elastic_summary(snap, name_filter))
     lines.extend(render_ckpt_summary(snap, name_filter))
     lines.extend(render_overlap_summary(snap, name_filter))
+    lines.extend(render_precision_summary(snap, name_filter))
     lines.extend(render_tenant_summary(snap, name_filter))
     lines.extend(render_observatory_summary(snap, name_filter))
     return "\n".join(lines)
