@@ -1876,6 +1876,180 @@ def _policy_drill():
         f"{out0[-2000:]}")
 
 
+def ctrl_worker():
+    """One process of the control-plane tick sweep (``ctrl_sweep`` leg):
+    no data plane, no model — just the native negotiation tick in
+    lockstep with every peer, driven straight through ctypes.  Every
+    tick sends the canonical EMPTY RequestList (a heartbeat — the frame
+    a response-cache-served steady-state tick degenerates to), so the
+    sweep isolates pure control fan-in/fan-out cost; under
+    ``HOROVOD_TPU_CONTROL_TOPO=hier`` the byte-identical member frames
+    also exercise the aggregation container's template/roster
+    compression, which is what keeps root ingress bytes ~flat however
+    many processes each host runs.  Process 0 prints one ``CTRLLEG``
+    JSON line with the per-tick wall time and the root-side counters."""
+    from horovod_tpu import cpp_core, wire
+
+    pidx = int(os.environ["BENCH_CTRL_PIDX"])
+    pcount = int(os.environ["BENCH_CTRL_PCOUNT"])
+    port = int(os.environ["BENCH_CTRL_PORT"])
+    ticks = int(os.environ.get("BENCH_CTRL_TICKS", "30"))
+    warm = int(os.environ.get("BENCH_CTRL_WARM", "5"))
+    # Generous rendezvous budget: every loopback process pays the Python
+    # import serially when cores are scarce, and Create blocks until the
+    # whole job is connected.
+    timeout_ms = int(os.environ.get("BENCH_CTRL_TIMEOUT_MS", "240000"))
+    ctl = cpp_core.CppControlPlane(pidx, pcount, "127.0.0.1", port,
+                                   pidx, pcount, timeout_ms=timeout_ms)
+    blob = wire.serialize_request_list([])
+    for _ in range(warm):
+        ctl.tick(blob, 1 << 20)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        ctl.tick(blob, 1 << 20)
+    dt = time.perf_counter() - t0
+    if pidx == 0:
+        snap = cpp_core.metrics_snapshot()
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        print("CTRLLEG " + json.dumps({
+            "tick_us": dt / ticks * 1e6,
+            # Counters cover warm + timed ticks; the parent divides by
+            # total_ticks for per-tick rates.
+            "total_ticks": warm + ticks,
+            "root_gather_bytes": counters.get(
+                "control.root_gather_bytes", 0),
+            "merged_frames": counters.get("control.merged_frames", 0),
+            "agg_depth": gauges.get("control.agg_depth", 0),
+        }), flush=True)
+    ctl.close()
+
+
+def _ctrl_sweep():
+    """Flat-vs-hier control tick latency at 8/32/128 loopback processes
+    (``BENCH_CTRL_PROCS``), the world spread over four fake member hosts
+    plus a root-only host (fingerprints, not real machines — every
+    socket is loopback, what differs is the gather topology: the root
+    reads O(procs) sockets flat, O(hosts) hier).
+
+    Reuses the transport microbench's interleaved-window trick: each
+    timing window runs the flat leg and the hier leg back to back, so
+    both topologies sample the same wall clock and machine noise cancels
+    out of the ratio; the per-topology estimate is the best window.
+    Headline: ``hier_tick_speedup_128p`` (flat tick / hier tick at the
+    largest world)."""
+    import socket
+    import subprocess
+    import sys
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    procs_list = [int(s) for s in os.environ.get(
+        "BENCH_CTRL_PROCS", "8,32,128").split(",")]
+    windows = int(os.environ.get("BENCH_CTRL_WINDOWS", "2"))
+    ticks = int(os.environ.get("BENCH_CTRL_TICKS", "30"))
+    n_hosts = int(os.environ.get("BENCH_CTRL_HOSTS", "4"))
+
+    def leg(nproc, topo):
+        port = free_port()
+        # Contiguous pidx blocks per fake host: matches a real
+        # one-launcher-per-host layout and lets the container's roster
+        # runs stay O(1) per host.
+        chunk = max(1, -(-(nproc - 1) // n_hosts))
+        children = []
+        for p in range(nproc):
+            fp = ("ctrl-root-host" if p == 0
+                  else f"ctrl-member-host-{(p - 1) // chunk}")
+            env = dict(os.environ)
+            # A clean control-plane environment: inherited knobs (cache
+            # capacity, elastic, integrity...) must not skew the A/B.
+            for k in list(env):
+                if k.startswith("HOROVOD_TPU_"):
+                    del env[k]
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "HOROVOD_TPU_CONTROL_TOPO": topo,
+                "HOROVOD_TPU_HOST_FINGERPRINT": fp,
+                "BENCH_CTRL_PIDX": str(p),
+                "BENCH_CTRL_PCOUNT": str(nproc),
+                "BENCH_CTRL_PORT": str(port),
+                "BENCH_CTRL_TICKS": str(ticks),
+            })
+            env.pop("XLA_FLAGS", None)
+            children.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--ctrl-worker"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env))
+        line = None
+        try:
+            for p, child in enumerate(children):
+                out, _ = child.communicate(timeout=600)
+                if child.returncode != 0:
+                    raise RuntimeError(
+                        f"ctrl leg {nproc}p/{topo}: process {p} exited "
+                        f"{child.returncode}:\n{out[-1500:]}")
+                if p == 0:
+                    for ln in out.splitlines():
+                        if ln.startswith("CTRLLEG "):
+                            line = json.loads(ln[len("CTRLLEG "):])
+        finally:
+            for child in children:
+                if child.poll() is None:
+                    child.kill()
+        if line is None:
+            raise RuntimeError(
+                f"ctrl leg {nproc}p/{topo} produced no CTRLLEG line")
+        return line
+
+    legs = {}
+    speedup_by_n = {}
+    for nproc in procs_list:
+        best = {}
+        for _ in range(windows):
+            for topo in ("flat", "hier"):   # interleaved within the window
+                res = leg(nproc, topo)
+                cur = best.get(topo)
+                if cur is None or res["tick_us"] < cur["tick_us"]:
+                    best[topo] = res
+        flat, hier = best["flat"], best["hier"]
+        speedup = (flat["tick_us"] / hier["tick_us"]
+                   if hier["tick_us"] > 0 else None)
+        speedup_by_n[nproc] = speedup
+        legs[f"{nproc}p"] = {
+            "flat_tick_us": round(flat["tick_us"], 1),
+            "hier_tick_us": round(hier["tick_us"], 1),
+            "hier_tick_speedup": round(speedup, 3) if speedup else None,
+            "flat_root_gather_bytes_per_tick": round(
+                flat["root_gather_bytes"] / flat["total_ticks"], 1),
+            "hier_root_gather_bytes_per_tick": round(
+                hier["root_gather_bytes"] / hier["total_ticks"], 1),
+            "hier_merged_frames_per_tick": round(
+                hier["merged_frames"] / hier["total_ticks"], 1),
+            "flat_agg_depth": flat["agg_depth"],
+            "hier_agg_depth": hier["agg_depth"],
+        }
+    top = max(procs_list)
+    return {
+        "legs": legs,
+        "windows": windows,
+        "ticks_per_window": ticks,
+        "fake_member_hosts": n_hosts,
+        "hier_tick_speedup_128p": (
+            round(speedup_by_n[top], 3)
+            if top == 128 and speedup_by_n.get(top) else None),
+        "note": ("empty-frame lockstep ticks over loopback; hosts are "
+                 "fingerprints, so the hier win measured here is the "
+                 "root's O(hosts)-vs-O(procs) fan-in, not network "
+                 "locality"),
+    }
+
+
 def bench_scaling_tcp():
     """Disjoint-runtime scaling leg on localhost: the same worker loop at
     1 process (no communication) and at 2 processes under the
@@ -2481,16 +2655,17 @@ def write_bench_summary(report: dict,
 
     The raw ``BENCH_rNN`` files the growth driver captures are stdout
     tails — truncated, unparsed, and useless for trend lines.  This
-    writes ``BENCH_r07.json`` (override with ``BENCH_SUMMARY_FILE``; set
+    writes ``BENCH_r08.json`` (override with ``BENCH_SUMMARY_FILE``; set
     it empty to skip) holding just the judged numbers: single/virtual
     step times and MFU, TCP scaling efficiency, the zero-copy transport
     speedup, the CRC integrity overhead, the observatory's on/off
-    step-time overhead, and the adaptive-precision autopilot's A/B
-    against the best static wire on both planes — each pulled from the
-    full report when the producing leg ran, ``None`` when it was skipped
-    or failed."""
+    step-time overhead, the adaptive-precision autopilot's A/B against
+    the best static wire on both planes, and the hierarchical control
+    topology's tick speedup at the 128-process sweep point — each pulled
+    from the full report when the producing leg ran, ``None`` when it
+    was skipped or failed."""
     if path is None:
-        path = os.environ.get("BENCH_SUMMARY_FILE", "BENCH_r07.json")
+        path = os.environ.get("BENCH_SUMMARY_FILE", "BENCH_r08.json")
     if not path:
         return None
 
@@ -2534,6 +2709,11 @@ def write_bench_summary(report: dict,
             "transformer_lm", "injit_wire_ab", "auto_vs_best_static"),
         "precision_auto_injit": get(
             "transformer_lm", "injit_wire_ab", "auto"),
+        # Hierarchical control plane: flat-vs-hier negotiation tick at
+        # the sweep's 128-process point (acceptance bar: > 1, i.e. the
+        # per-host aggregation tier beats the flat O(procs) root gather).
+        "hier_tick_speedup_128p": get(
+            "ctrl_sweep", "hier_tick_speedup_128p"),
     }
     try:
         with open(path, "w") as f:
@@ -2562,7 +2742,13 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--publish-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--ctrl-worker", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.ctrl_worker:
+        ctrl_worker()
+        return
 
     if args.tcp_worker:
         tcp_worker()
@@ -2605,6 +2791,15 @@ def main():
     # localhost approximations of it (virtual mesh + 2-process TCP).
     if os.environ.get("BENCH_SCALING", "1") == "1":
         report.update(_scaling_legs())
+    # Control-plane tick sweep: flat-vs-hier negotiation round-trip at
+    # 8/32/128 loopback processes (no data plane — the leg needs only
+    # subprocesses and sockets).  BENCH_CTRL=0 skips it.
+    if os.environ.get("BENCH_CTRL", "1") == "1":
+        try:
+            report["ctrl_sweep"] = _ctrl_sweep()
+        except Exception as exc:   # noqa: BLE001 — recorded, not fatal
+            report["ctrl_sweep"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:1000]}
     write_bench_summary(report)
     print(json.dumps(report))
 

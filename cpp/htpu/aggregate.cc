@@ -1,0 +1,233 @@
+#include "htpu/aggregate.h"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+
+namespace htpu {
+namespace {
+
+void PutU8(std::string* out, uint8_t v) {
+  out->push_back(char(v));
+}
+
+void PutI32(std::string* out, int32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out->append(b, 4);
+}
+
+void PutU32(std::string* out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out->append(b, 4);
+}
+
+void PutStr(std::string* out, const std::string& s) {
+  PutI32(out, int32_t(s.size()));
+  out->append(s);
+}
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool ok = true;
+
+  uint8_t U8() {
+    if (pos + 1 > n) {
+      ok = false;
+      return 0;
+    }
+    return p[pos++];
+  }
+  int32_t I32() {
+    if (pos + 4 > n) {
+      ok = false;
+      return 0;
+    }
+    int32_t v;
+    memcpy(&v, p + pos, 4);
+    pos += 4;
+    return v;
+  }
+  uint32_t U32() { return uint32_t(I32()); }
+  bool Str(std::string* s) {
+    int32_t len = I32();
+    if (!ok || len < 0 || pos + size_t(len) > n) {
+      ok = false;
+      return false;
+    }
+    s->assign(reinterpret_cast<const char*>(p) + pos, size_t(len));
+    pos += size_t(len);
+    return true;
+  }
+};
+
+// The collision rule: max status wins, equal statuses keep the smaller
+// frame.  A selection under a total order, hence associative,
+// commutative, and idempotent.
+const AggMember& Winner(const AggMember& a, const AggMember& b) {
+  if (a.status != b.status) return a.status > b.status ? a : b;
+  return a.frame <= b.frame ? a : b;
+}
+
+}  // namespace
+
+void AggregateRequests(const AggFrame& in, AggFrame* acc) {
+  if (in.members.empty()) return;
+  std::map<int32_t, AggMember> merged;
+  for (const auto& m : acc->members) {
+    auto it = merged.find(m.pidx);
+    if (it == merged.end()) {
+      merged.emplace(m.pidx, m);
+    } else {
+      it->second = Winner(it->second, m);
+    }
+  }
+  for (const auto& m : in.members) {
+    auto it = merged.find(m.pidx);
+    if (it == merged.end()) {
+      merged.emplace(m.pidx, m);
+    } else {
+      it->second = Winner(it->second, m);
+    }
+  }
+  acc->members.clear();
+  acc->members.reserve(merged.size());
+  for (auto& kv : merged) acc->members.push_back(std::move(kv.second));
+}
+
+std::string MergeCacheBits(const std::string& a, const std::string& b) {
+  std::string out(std::max(a.size(), b.size()), '\0');
+  for (size_t i = 0; i < out.size(); ++i) {
+    uint8_t v = 0;
+    if (i < a.size()) v |= uint8_t(a[i]);
+    if (i < b.size()) v |= uint8_t(b[i]);
+    out[i] = char(v);
+  }
+  while (!out.empty() && out.back() == '\0') out.pop_back();
+  return out;
+}
+
+void SerializeAggFrame(const AggFrame& f, std::string* out) {
+  // Canonicalize: sort by pidx, drop duplicate pidxs via the merge rule
+  // so equal member sets serialize to equal bytes regardless of input
+  // order.
+  AggFrame canon;
+  AggregateRequests(f, &canon);
+
+  // Template election: the frame shared by the largest number of Ok
+  // members, ties to the lexicographically smallest, and only when at
+  // least two members share it (a singleton template saves nothing and
+  // would perturb single-member containers).
+  std::map<std::string, int> freq;
+  for (const auto& m : canon.members) {
+    if (m.status == kAggOk) ++freq[m.frame];
+  }
+  std::string tmpl;
+  int best = 1;
+  for (const auto& kv : freq) {
+    if (kv.second > best) {
+      best = kv.second;
+      tmpl = kv.first;
+    }
+  }
+  const bool has_tmpl = best > 1;
+
+  out->clear();
+  PutU32(out, kAggMagic);
+  PutU8(out, kAggVersion);
+  PutU8(out, has_tmpl ? kAggHasTemplate : 0);
+  if (has_tmpl) PutStr(out, tmpl);
+
+  // Rosters: maximal runs of consecutive pidxs whose frame matches the
+  // template.  The steady-state cache-served tick is one roster per
+  // container — O(1) bytes however many processes the host runs.
+  std::vector<std::pair<int32_t, int32_t>> rosters;
+  std::vector<const AggMember*> rest;
+  for (const auto& m : canon.members) {
+    if (has_tmpl && m.status == kAggOk && m.frame == tmpl) {
+      if (!rosters.empty() &&
+          rosters.back().first + rosters.back().second == m.pidx) {
+        ++rosters.back().second;
+      } else {
+        rosters.emplace_back(m.pidx, 1);
+      }
+    } else {
+      rest.push_back(&m);
+    }
+  }
+  PutI32(out, int32_t(rosters.size()));
+  for (const auto& r : rosters) {
+    PutI32(out, r.first);
+    PutI32(out, r.second);
+  }
+  PutI32(out, int32_t(rest.size()));
+  for (const AggMember* m : rest) {
+    PutI32(out, m->pidx);
+    PutU8(out, m->status);
+    if (m->status == kAggOk) PutStr(out, m->frame);
+  }
+}
+
+bool ParseAggFrame(const uint8_t* data, size_t len, AggFrame* out) {
+  Reader rd{data, len};
+  if (rd.U32() != kAggMagic) return false;
+  if (rd.U8() != kAggVersion) return false;
+  const uint8_t flags = rd.U8();
+  if (flags & ~kAggHasTemplate) return false;
+  std::string tmpl;
+  if (flags & kAggHasTemplate) {
+    if (!rd.Str(&tmpl)) return false;
+  }
+  AggFrame f;
+  const int32_t nrosters = rd.I32();
+  if (!rd.ok || nrosters < 0) return false;
+  for (int32_t i = 0; i < nrosters; ++i) {
+    const int32_t first = rd.I32();
+    const int32_t count = rd.I32();
+    if (!rd.ok || count <= 0 || first < 0 ||
+        !(flags & kAggHasTemplate)) {
+      return false;
+    }
+    // A count larger than the remaining bytes could never have been
+    // produced by the serializer; bound it so a corrupt frame cannot
+    // balloon memory.
+    if (size_t(count) > len) return false;
+    for (int32_t k = 0; k < count; ++k) {
+      AggMember m;
+      m.pidx = first + k;
+      m.status = kAggOk;
+      m.frame = tmpl;
+      f.members.push_back(std::move(m));
+    }
+  }
+  const int32_t nrest = rd.I32();
+  if (!rd.ok || nrest < 0 || size_t(nrest) > len) return false;
+  for (int32_t i = 0; i < nrest; ++i) {
+    AggMember m;
+    m.pidx = rd.I32();
+    m.status = rd.U8();
+    if (!rd.ok || m.status > kAggStale) return false;
+    if (m.status == kAggOk && !rd.Str(&m.frame)) return false;
+    f.members.push_back(std::move(m));
+  }
+  if (!rd.ok || rd.pos != len) return false;
+  // Re-canonicalize (rosters and rest interleave in pidx order only
+  // within themselves).
+  out->members.clear();
+  AggregateRequests(f, out);
+  return true;
+}
+
+std::vector<std::pair<int32_t, std::string>> SplitResponses(
+    const std::string& response_frame, const AggFrame& members) {
+  std::vector<std::pair<int32_t, std::string>> out;
+  for (const auto& m : members.members) {
+    if (m.status == kAggOk) out.emplace_back(m.pidx, response_frame);
+  }
+  return out;
+}
+
+}  // namespace htpu

@@ -1,0 +1,113 @@
+// Control-plane aggregation tier: the pure merge/split functions the
+// hierarchical coordinator topology (HOROVOD_TPU_CONTROL_TOPO=hier) is
+// built from.
+//
+// The flat coordinator gathers one RequestList frame per process —
+// O(world) fan-in at the root, the scaling wall the reference's
+// coordinator design hits past a few hundred ranks.  Under the
+// hierarchical topology each host's leader (the data plane's
+// fingerprint-elected leader, control.cc EnsureHierarchy) collects its
+// members' frames locally and forwards ONE combined container to the
+// root, so root fan-in is O(hosts).  This header is the container: a
+// stateless, order-canonical multiset of (process index → opaque frame)
+// entries.  Member frames stay byte-opaque — the root expands the
+// container back into the exact per-process frames the flat gather would
+// have produced and runs the unchanged decision tier, which is what
+// makes hier responses bit-identical to flat by construction.
+//
+// Merge is a pure function (no coordinator state), so it composes at any
+// tree depth: AggregateRequests is associative, commutative, and
+// idempotent (property-tested in tests/test_aggregate.py, against the
+// Python mirror horovod_tpu/aggregate.py).
+//
+// Wire format (little-endian, str = i32 length + bytes):
+//   AggFrame := magic:u32("HAGG") version:u8 flags:u8
+//               [template:str]                       (flags bit 0)
+//               rosters:vec<first_pidx:i32 count:i32>
+//               members:vec<pidx:i32 status:u8 [frame:str if status==Ok]>
+//
+// The template + roster pair is the steady-state compression: on a
+// response-cache-served tick every member submits the identical
+// bits-only frame, so the container carries the frame ONCE plus
+// [first,count) pidx ranges — O(1) bytes per host regardless of
+// processes per host, which is why `control.root_gather_bytes` stays
+// ~flat as procs-per-host grows.  Serialization is canonical (members
+// sorted by pidx, template = the most shared frame, deterministic
+// tie-break), so equal member sets serialize to equal bytes no matter
+// the merge order.
+#ifndef HTPU_AGGREGATE_H_
+#define HTPU_AGGREGATE_H_
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace htpu {
+
+// "HAGG" read as a little-endian u32.  Deliberately NOT a RequestList
+// flag bit: the container is a distinct frame format that only ever
+// travels leader→root, so the member-frame wire (and the flat topology)
+// stays byte-identical to the pre-aggregation protocol.
+constexpr uint32_t kAggMagic = 0x47474148u;
+constexpr uint8_t kAggVersion = 1;
+constexpr uint8_t kAggHasTemplate = 0x01;
+
+// Member status.  Ok carries the frame; Dead is a member that missed its
+// sub-coordinator's gather deadline (the root synthesizes the same
+// attributed heartbeat error the flat gather would have); Stale is
+// reserved for aggregators that pre-screen membership generations (the
+// current root re-derives staleness from the frame's own elastic
+// extension, so leaders never emit it).
+enum AggStatus : uint8_t {
+  kAggOk = 0,
+  kAggDead = 1,
+  kAggStale = 2,
+};
+
+struct AggMember {
+  int32_t pidx = -1;
+  uint8_t status = kAggOk;
+  // Opaque RequestList bytes exactly as the member sent them, minus the
+  // outermost clock trailer (member↔leader clock offsets are meaningless
+  // to the root; the leader's own offset rides the container's trailer).
+  // Empty when status != kAggOk.
+  std::string frame;
+};
+
+// A canonical member set: sorted by pidx, one entry per pidx.
+struct AggFrame {
+  std::vector<AggMember> members;
+};
+
+// Fold `in` into `acc`: map union keyed by pidx.  On a pidx collision
+// the entry with the greater status wins (a death report beats a frame);
+// equal statuses keep the lexicographically smaller frame — a total
+// order, so the merge is associative, commutative, and idempotent no
+// matter how the tree delivers the pieces.
+void AggregateRequests(const AggFrame& in, AggFrame* acc);
+
+// OR-merge two response-cache hit-slot bitvectors (LSB of byte 0 = slot
+// 0), trimming trailing zero bytes back to the canonical client form.
+// Associative/commutative/idempotent like the container merge — the
+// property that would let a deeper tree fold bits-only ticks without
+// expanding them.
+std::string MergeCacheBits(const std::string& a, const std::string& b);
+
+// Canonical bytes for `f` (members need not be pre-sorted).
+void SerializeAggFrame(const AggFrame& f, std::string* out);
+
+// Parse + validate; false on a short/corrupt/unknown-version container.
+bool ParseAggFrame(const uint8_t* data, size_t len, AggFrame* out);
+
+// Fan a response frame down the tree: one (pidx, frame) pair per Ok
+// member of `members`.  Responses are coordinator broadcasts, so every
+// member receives the identical bytes — the function exists as the
+// decision-tier counterpart of AggregateRequests so a deeper tree (or a
+// future per-member response diff) has one seam to change.
+std::vector<std::pair<int32_t, std::string>> SplitResponses(
+    const std::string& response_frame, const AggFrame& members);
+
+}  // namespace htpu
+
+#endif  // HTPU_AGGREGATE_H_

@@ -14,6 +14,7 @@
 #include <string>
 #include <unordered_map>
 
+#include "htpu/aggregate.h"
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
@@ -987,6 +988,49 @@ HTPU_API int htpu_observe_trailer_probe(const void* buf, int len,
            s.steps, double(s.bw_bps[0]), double(s.bw_bps[1]),
            double(s.bw_bps[2]), double(s.bw_bps[3]));
   return CopyOut(std::string(js), out);
+}
+
+// ---- aggregation tier (hierarchical control topology) ----------------
+//
+// Native seam for the Python mirror (horovod_tpu/aggregate.py): the
+// parity tests drive the SAME merge through both implementations and
+// pin the bytes equal.
+
+// Fold container `b` into container `a` (both serialized AggFrames) and
+// write the canonical merged container into *out; returns its length,
+// or -1 if either input fails to parse.
+HTPU_API int htpu_agg_merge(const void* a, int a_len, const void* b,
+                            int b_len, void** out) {
+  htpu::AggFrame acc;
+  if (!htpu::ParseAggFrame(static_cast<const uint8_t*>(a),
+                           size_t(a_len < 0 ? 0 : a_len), &acc)) {
+    return -1;
+  }
+  htpu::AggFrame in;
+  if (!htpu::ParseAggFrame(static_cast<const uint8_t*>(b),
+                           size_t(b_len < 0 ? 0 : b_len), &in)) {
+    return -1;
+  }
+  htpu::AggregateRequests(in, &acc);
+  std::string buf;
+  htpu::SerializeAggFrame(acc, &buf);
+  return CopyOut(buf, out);
+}
+
+// Parse + re-serialize one container: the canonicalization round-trip
+// (members sorted, duplicates merged, template re-elected).  Returns the
+// canonical length into *out, or -1 on a corrupt container — the seam
+// the property tests use to pin Python serialization byte-equal to
+// native.
+HTPU_API int htpu_agg_roundtrip(const void* buf, int len, void** out) {
+  htpu::AggFrame f;
+  if (!htpu::ParseAggFrame(static_cast<const uint8_t*>(buf),
+                           size_t(len < 0 ? 0 : len), &f)) {
+    return -1;
+  }
+  std::string s;
+  htpu::SerializeAggFrame(f, &s);
+  return CopyOut(s, out);
 }
 
 }  // extern "C"

@@ -15,6 +15,7 @@
 #include <stdexcept>
 #include <thread>
 
+#include "htpu/aggregate.h"
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
 #include "htpu/observe.h"
@@ -314,6 +315,42 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
       return nullptr;
     }
   }
+  // Control-plane topology: flat (every process ticks the root directly
+  // — byte-identical to the legacy protocol) or hier (per-host
+  // sub-coordinator aggregation: members tick their host leader, leaders
+  // forward one merged container to the root, so root fan-in scales with
+  // hosts, not processes).  Validated job-wide during SetupRing like the
+  // transport knob.
+  if (const char* e = getenv("HOROVOD_TPU_CONTROL_TOPO")) {
+    const std::string m(e);
+    if (m.empty() || m == "flat") {
+      cp->ctrl_topo_ = 0;
+    } else if (m == "hier") {
+      cp->ctrl_topo_ = 1;
+    } else {
+      fprintf(stderr,
+              "htpu control: unknown HOROVOD_TPU_CONTROL_TOPO=%s "
+              "(want flat|hier)\n", e);
+      return nullptr;
+    }
+  }
+  // Sub-coordinator member-gather deadline: half the heartbeat by
+  // default (clamped to it), so the root's per-leader heartbeat budget
+  // strictly covers a leader's own wait — worst-case dead-member
+  // detection is one leader deadline plus the root's, ~1.5 heartbeats
+  // end to end.
+  {
+    long agg_s = 0;
+    if (const char* e = getenv("HOROVOD_TPU_CONTROL_AGG_TIMEOUT_S")) {
+      char* end = nullptr;
+      long v = strtol(e, &end, 10);
+      if (end && *end == '\0' && v > 0) agg_s = v;
+    }
+    cp->agg_timeout_ms_ =
+        agg_s > 0 ? int(std::min<long long>(agg_s * 1000LL,
+                                            cp->heartbeat_ms_))
+                  : cp->heartbeat_ms_ / 2;
+  }
   // Intra-host shm sub-slot size; the depth-2 pipeline maps two of these
   // per member plus two for the result.  Must stay element-aligned for
   // every dtype, hence the multiple-of-64 floor.
@@ -430,6 +467,18 @@ std::unique_ptr<ControlPlane> ControlPlane::Create(
     Metrics::Get().SetGauge("membership.generation", 0.0);
   }
   if (process_count > 1 && !cp->SetupRing(coord_host)) return nullptr;
+  // Hierarchical control topology: bring the per-host tree up at
+  // bootstrap (the data plane reuses the same leader sockets lazily).
+  // A setup failure is a hard bootstrap error — a half-built tree would
+  // strand members waiting on a sub-coordinator that never gathers them.
+  if (cp->ctrl_topo_ == 1 && process_count > 1 && !cp->EnsureHierarchy()) {
+    fprintf(stderr,
+            "htpu control: HOROVOD_TPU_CONTROL_TOPO=hier requested but "
+            "the per-host tree failed to bootstrap\n");
+    return nullptr;
+  }
+  Metrics::Get().SetGauge("control.agg_depth",
+                          cp->CtrlHierActive() ? 2.0 : 1.0);
   if (cp->table_) {
     // Algo-selection inputs for resolving "auto": distinct hosts from the
     // ring-setup fingerprint book, plus the size crossover below which the
@@ -497,6 +546,13 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   if (xport_mode_ != 0) {
     record += std::string("\txport=") + kXportNames[xport_mode_];
   }
+  // Control-topology selection rides the book the same way: a
+  // HOROVOD_TPU_CONTROL_TOPO mismatch would leave some processes ticking
+  // the root directly while others wait on a sub-coordinator that never
+  // gathers them.  Default-flat books keep their legacy byte shape.
+  if (ctrl_topo_ != 0) {
+    record += "\tctopo=hier";
+  }
 
   auto cleanup = [&]() {
     CloseFd(ring_listen);
@@ -537,7 +593,7 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
   // 4. Parse the book (one tab-separated record per process).  Fields
   // past the fixed five are recognised by shape: "xport=..." carries the
   // transport selection, a bare number is the elastic failover port.
-  std::vector<std::string> hosts, fps, uds_paths, fo_ports, xports;
+  std::vector<std::string> hosts, fps, uds_paths, fo_ports, xports, ctopos;
   std::vector<int> ports;
   all_first_ranks_.clear();
   size_t pos = 0;
@@ -563,16 +619,19 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
     all_first_ranks_.push_back(std::stoi(fields[2]));
     fps.push_back(fields[3]);
     uds_paths.push_back(fields[4]);
-    std::string fo, xp = "auto";
+    std::string fo, xp = "auto", ct = "flat";
     for (size_t fi = 5; fi < fields.size(); ++fi) {
       if (fields[fi].rfind("xport=", 0) == 0) {
         xp = fields[fi].substr(6);
+      } else if (fields[fi].rfind("ctopo=", 0) == 0) {
+        ct = fields[fi].substr(6);
       } else {
         fo = fields[fi];
       }
     }
     fo_ports.push_back(fo);
     xports.push_back(xp);
+    ctopos.push_back(ct);
     if (nl == std::string::npos) break;
     pos = nl + 1;
   }
@@ -601,6 +660,31 @@ bool ControlPlane::SetupRing(const std::string& coord_host) {
         last_error_gen_ = generation_;
       }
       FlightRecorder::Get().Record("xport.mismatch", err.c_str(), 0, i);
+      cleanup();
+      return false;
+    }
+  }
+
+  // Coordinated control-topology validation, same contract as the
+  // transport knob above: half a job on the hier tree and half on the
+  // flat star would deadlock the first tick, so surface the divergence
+  // as one attributed bootstrap error.
+  for (int i = 1; i < process_count_; ++i) {
+    if (ctopos[size_t(i)] != ctopos[0]) {
+      const int32_t rank = all_first_ranks_[size_t(i)];
+      std::string err =
+          "HOROVOD_TPU_CONTROL_TOPO mismatch: process of rank " +
+          std::to_string(rank) + " selected '" + ctopos[size_t(i)] +
+          "' while rank " + std::to_string(all_first_ranks_[0]) +
+          " selected '" + ctopos[0] + "' — the knob must agree job-wide";
+      fprintf(stderr, "htpu control: %s\n", err.c_str());
+      {
+        std::lock_guard<std::mutex> lock(err_mu_);
+        last_error_rank_ = rank;
+        last_error_ = err;
+        last_error_gen_ = generation_;
+      }
+      FlightRecorder::Get().Record("ctopo.mismatch", err.c_str(), 0, i);
       cleanup();
       return false;
     }
@@ -1345,6 +1429,289 @@ bool ControlPlane::ApplyResponseFrame(const ResponseList& parsed,
 
 // --------------------------------------------------------------------- tick
 
+namespace {
+
+// Wait up to timeout_ms for one complete frame on either fd (an fd < 0
+// is not watched).  An fd that errors or hangs up stops being watched;
+// returns false once neither is watchable or the deadline expires.
+// *src_fd gets the fd the frame arrived on.  The hier member's response
+// wait: the normal response comes down the leader socket, but aborts and
+// RECONFIGUREs are root broadcasts over the star — either may arrive
+// first, and after a leader death only the star ever speaks again.
+bool RecvFrameDual(int fd_a, int fd_b, int timeout_ms, std::string* out,
+                   int* src_fd) {
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+  bool watch_a = fd_a >= 0, watch_b = fd_b >= 0;
+  while (watch_a || watch_b) {
+    const auto now = std::chrono::steady_clock::now();
+    const long long remain_ms =
+        std::chrono::duration_cast<std::chrono::milliseconds>(deadline -
+                                                              now)
+            .count();
+    if (remain_ms <= 0) return false;
+    struct pollfd pfds[2];
+    int n = 0, ia = -1, ib = -1;
+    if (watch_a) {
+      pfds[n].fd = fd_a;
+      pfds[n].events = POLLIN;
+      pfds[n].revents = 0;
+      ia = n++;
+    }
+    if (watch_b) {
+      pfds[n].fd = fd_b;
+      pfds[n].events = POLLIN;
+      pfds[n].revents = 0;
+      ib = n++;
+    }
+    const int rc = poll(pfds, nfds_t(n), int(remain_ms));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (rc == 0) continue;
+    // Star first: in the (protocol-impossible, defensive) case both are
+    // readable, an abort/RECONFIGURE beats a normal forward.
+    const short kReady = POLLIN | POLLERR | POLLHUP;
+    if (ib >= 0 && (pfds[ib].revents & kReady)) {
+      if (RecvFrame(fd_b, out, int(remain_ms))) {
+        *src_fd = fd_b;
+        return true;
+      }
+      watch_b = false;
+      continue;
+    }
+    if (ia >= 0 && (pfds[ia].revents & kReady)) {
+      if (RecvFrame(fd_a, out, int(remain_ms))) {
+        *src_fd = fd_a;
+        return true;
+      }
+      watch_a = false;
+      continue;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+bool ControlPlane::WorkerApplyResponse(std::string* response_list_blob) {
+  // Latch a broadcast ABORT natively so the data plane fails fast too.
+  ResponseList parsed;
+  if (ParseResponseList(
+          reinterpret_cast<const uint8_t*>(response_list_blob->data()),
+          response_list_blob->size(), &parsed)) {
+    if (elastic_) AdoptDigest(parsed);
+    if (parsed.abort_rank >= 0) {
+      LatchAbort(parsed.abort_rank, parsed.abort_reason);
+    } else if (elastic_ && parsed.has_elastic_ext && parsed.reconfigure) {
+      // Coordinated reconfiguration: adopt the new membership (or
+      // self-abort if evicted) and rebuild the data plane before
+      // handing the frame up — by the time Python sees it, the new
+      // ring is live and the next tick runs at the new generation.
+      ApplyReconfigure(parsed, response_list_blob);
+    } else if (elastic_ && parsed.has_elastic_ext &&
+               parsed.generation != generation_) {
+      LatchAbort(first_rank_,
+                 "stale membership generation: coordinator is at "
+                 "generation " + std::to_string(parsed.generation) +
+                     ", this worker at " + std::to_string(generation_));
+      SerializeAbort(response_list_blob);
+    } else if (!ApplyResponseFrame(parsed, response_list_blob)) {
+      LatchAbort(first_rank_,
+                 "response cache protocol error: coordinator replayed a "
+                 "set this worker never stored");
+      SerializeAbort(response_list_blob);
+    }
+  }
+  return true;
+}
+
+bool ControlPlane::TickHierMember(const std::string& request_list_blob,
+                                  std::string* response_list_blob) {
+  static std::atomic<long long>* neg_bytes =
+      Metrics::Get().Counter("control.negotiation_bytes");
+  // The frame is constructed exactly like the flat worker's — the leader
+  // forwards it to the root byte-opaque (minus the clock trailer, whose
+  // stamps only describe the member↔leader hop), which is what keeps
+  // hier negotiation bit-identical to flat.
+  std::string frame;
+  CompressRequestFrame(request_list_blob, &frame);
+  if (elastic_) StampElasticRequest(&frame);
+  if (ObserveEnabled()) AppendObserveTrailer(&frame);
+  AppendClockTrailer(last_resp_recv_us_, &frame);
+  auto w0 = std::chrono::steady_clock::now();
+  FlightRecorder::Get().Record("tick.send", "hier member",
+                               int64_t(frame.size()), 0, leader_fd_);
+  int lfd = leader_fd_;
+  if (lfd < 0 || !SendFrame(lfd, frame)) {
+    FlightRecorder::Get().Record("tick.fail", "sub-coordinator link lost",
+                                 0, lfd, errno);
+    // Keep waiting on the star: the root detects the dead leader within
+    // its heartbeat deadline and (elastic) broadcasts the RECONFIGURE
+    // that re-elects our sub-tree, or (classic) the attributed abort.
+    lfd = -1;
+  }
+  // Budget: the root's normal response relays within one leader gather,
+  // but a dead-leader recovery takes the root's heartbeat deadline plus
+  // the coordinator-silence window — cover both before declaring the
+  // coordinator itself lost.
+  const int wait_ms =
+      elastic_ ? coord_timeout_ms_ + heartbeat_ms_ : timeout_ms_;
+  int src_fd = -1;
+  if (!RecvFrameDual(lfd, coord_fd_, wait_ms, response_list_blob,
+                     &src_fd)) {
+    FlightRecorder::Get().Record("tick.fail", "no response from leader or "
+                                 "coordinator", 0, coord_fd_, errno);
+    if (FailoverOnCoordLoss(response_list_blob)) return true;
+    const int leader_pidx = group_.empty() ? 0 : group_.front();
+    const int32_t blame =
+        lfd < 0 && size_t(leader_pidx) < all_first_ranks_.size()
+            ? all_first_ranks_[size_t(leader_pidx)]
+            : (all_first_ranks_.empty() ? 0 : all_first_ranks_[0]);
+    LatchAbort(blame, lfd < 0
+                          ? "lost connection to the control "
+                            "sub-coordinator (rank " +
+                                std::to_string(blame) + ", process " +
+                                std::to_string(leader_pidx) + ")"
+                          : "lost connection to the coordinator (rank " +
+                                std::to_string(blame) + ", process 0)");
+    SerializeAbort(response_list_blob);
+    return true;
+  }
+  last_resp_recv_us_ = WallClockUs();
+  FlightRecorder::Get().Record("tick.recv", "",
+                               int64_t(response_list_blob->size()), 0,
+                               src_fd);
+  if (Timeline* tl = timeline_.load(std::memory_order_acquire)) {
+    tl->TickSpan(tick_count_,
+                 std::chrono::duration_cast<std::chrono::microseconds>(
+                     std::chrono::steady_clock::now() - w0)
+                     .count());
+  }
+  neg_bytes->fetch_add(
+      (long long)(frame.size() + response_list_blob->size()),
+      std::memory_order_relaxed);
+  return WorkerApplyResponse(response_list_blob);
+}
+
+bool ControlPlane::TickHierLeader(const std::string& request_list_blob,
+                                  std::string* response_list_blob) {
+  static std::atomic<long long>* neg_bytes =
+      Metrics::Get().Counter("control.negotiation_bytes");
+  static std::atomic<long long>* merged_frames =
+      Metrics::Get().Counter("control.merged_frames");
+  // Own frame: compressed + stamped + telemetry like the flat worker's,
+  // but NO clock trailer — the inner frames travel inside the container,
+  // whose own trailer carries the leader↔root clock sample.
+  std::string self;
+  CompressRequestFrame(request_list_blob, &self);
+  if (elastic_) StampElasticRequest(&self);
+  if (ObserveEnabled()) AppendObserveTrailer(&self);
+  AggFrame agg;
+  {
+    AggMember m;
+    m.pidx = process_index_;
+    m.status = kAggOk;
+    m.frame = std::move(self);
+    agg.members.push_back(std::move(m));
+  }
+  // Sub-gather: one frame per host member.  A member silent past the
+  // aggregation deadline is reported upward as dead; the root
+  // synthesizes the same attributed heartbeat failure the flat gather
+  // would have produced and (elastic) evicts it.
+  for (size_t k = 0; k + 1 < group_.size() && k < member_fds_.size();
+       ++k) {
+    const int mp = group_[k + 1];
+    AggMember m;
+    m.pidx = mp;
+    std::string mf;
+    if (member_fds_[k] >= 0 &&
+        RecvFrame(member_fds_[k], &mf, agg_timeout_ms_)) {
+      int64_t t1_us = 0, t4_us = 0;
+      // Member↔leader clock stamps describe the wrong hop for the
+      // root's estimator — strip and drop them.
+      StripClockTrailer(&mf, &t4_us, &t1_us);
+      m.status = kAggOk;
+      m.frame = std::move(mf);
+    } else {
+      m.status = kAggDead;
+      FlightRecorder::Get().Record("gather.fail",
+                                   "member missed the sub-gather deadline",
+                                   0, mp, errno);
+    }
+    agg.members.push_back(std::move(m));
+  }
+  merged_frames->fetch_add((long long)agg.members.size(),
+                           std::memory_order_relaxed);
+  std::string frame;
+  SerializeAggFrame(agg, &frame);
+  FlightRecorder::Get().Record("AGG_MERGE", "forward to root",
+                               int64_t(frame.size()),
+                               int(agg.members.size()), process_index_);
+  AppendClockTrailer(last_resp_recv_us_, &frame);
+  auto w0 = std::chrono::steady_clock::now();
+  FlightRecorder::Get().Record("tick.send", "hier leader",
+                               int64_t(frame.size()), 0, coord_fd_);
+  const int coord_deadline = elastic_ ? coord_timeout_ms_ : timeout_ms_;
+  if (!SendFrame(coord_fd_, frame) ||
+      !RecvFrame(coord_fd_, response_list_blob, coord_deadline)) {
+    FlightRecorder::Get().Record("tick.fail", "coordinator link lost", 0,
+                                 coord_fd_, errno);
+    if (FailoverOnCoordLoss(response_list_blob)) return true;
+    const int32_t coord_rank =
+        all_first_ranks_.empty() ? 0 : all_first_ranks_[0];
+    LatchAbort(coord_rank,
+               "lost connection to the coordinator (rank " +
+                   std::to_string(coord_rank) + ", process 0)");
+    SerializeAbort(response_list_blob);
+    // Our members are blocked on us: fan the attributed abort down so
+    // they latch the same error instead of timing out one by one.
+    for (int fd : member_fds_) {
+      if (fd >= 0) SendFrame(fd, *response_list_blob);
+    }
+    return true;
+  }
+  last_resp_recv_us_ = WallClockUs();
+  FlightRecorder::Get().Record("tick.recv", "",
+                               int64_t(response_list_blob->size()), 0,
+                               coord_fd_);
+  if (Timeline* tl = timeline_.load(std::memory_order_acquire)) {
+    tl->TickSpan(tick_count_,
+                 std::chrono::duration_cast<std::chrono::microseconds>(
+                     std::chrono::steady_clock::now() - w0)
+                     .count());
+  }
+  neg_bytes->fetch_add(
+      (long long)(frame.size() + response_list_blob->size()),
+      std::memory_order_relaxed);
+  // Fan the response down to the members that fed this tick — EXCEPT
+  // aborts and RECONFIGUREs, which the root delivers to every process
+  // over the star itself (forwarding them again would hand a member two
+  // frames for one tick and desynchronize every later one).
+  ResponseList peeked;
+  const bool peeked_ok = ParseResponseList(
+      reinterpret_cast<const uint8_t*>(response_list_blob->data()),
+      response_list_blob->size(), &peeked);
+  const bool star_delivered =
+      peeked_ok && (peeked.abort_rank >= 0 ||
+                    (peeked.has_elastic_ext && peeked.reconfigure));
+  if (!star_delivered) {
+    const auto fan = SplitResponses(*response_list_blob, agg);
+    for (size_t k = 0; k + 1 < group_.size() && k < member_fds_.size();
+         ++k) {
+      if (agg.members[k + 1].status != kAggOk) continue;
+      if (member_fds_[k] >= 0) {
+        // Best effort: a member dead at fan-down time is the next
+        // sub-gather's deadline miss, attributed then.
+        SendFrame(member_fds_[k], fan.empty() ? *response_list_blob
+                                              : fan[0].second);
+      }
+    }
+  }
+  return WorkerApplyResponse(response_list_blob);
+}
+
 bool ControlPlane::Tick(const std::string& request_list_blob,
                         int64_t fusion_threshold,
                         std::string* response_list_blob) {
@@ -1353,6 +1720,14 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
       Metrics::Get().Counter("control.ticks");
   static std::atomic<long long>* neg_bytes =
       Metrics::Get().Counter("control.negotiation_bytes");
+  // Inter-host star ingress at the root, both topologies: the series the
+  // ctrl_sweep bench watches to show hier fan-in is O(hosts) — under
+  // hier it counts merged containers from remote leaders, under flat the
+  // individual frames from processes on other hosts.
+  static std::atomic<long long>* root_gather_bytes =
+      Metrics::Get().Counter("control.root_gather_bytes");
+  static std::atomic<long long>* merged_frames =
+      Metrics::Get().Counter("control.merged_frames");
   ticks->fetch_add(1, std::memory_order_relaxed);
   ++tick_count_;
   FlightRecorder::Get().SetTick(tick_count_);
@@ -1365,6 +1740,15 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   }
 
   if (!is_coordinator()) {
+    if (CtrlHierActive()) {
+      // Hierarchical topology: members tick their host's sub-coordinator,
+      // leaders gather their members and forward one merged container to
+      // the root.  Both paths share WorkerApplyResponse with the flat
+      // worker below, so the response semantics are identical.
+      return is_leader_
+                 ? TickHierLeader(request_list_blob, response_list_blob)
+                 : TickHierMember(request_list_blob, response_list_blob);
+    }
     // Worker: send our (bit-compressed when cached) request list with the
     // clock trailer, wait for the response list.
     std::string frame;
@@ -1417,35 +1801,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
     neg_bytes->fetch_add(
         (long long)(frame.size() + response_list_blob->size()),
         std::memory_order_relaxed);
-    // Latch a broadcast ABORT natively so the data plane fails fast too.
-    ResponseList parsed;
-    if (ParseResponseList(
-            reinterpret_cast<const uint8_t*>(response_list_blob->data()),
-            response_list_blob->size(), &parsed)) {
-      if (elastic_) AdoptDigest(parsed);
-      if (parsed.abort_rank >= 0) {
-        LatchAbort(parsed.abort_rank, parsed.abort_reason);
-      } else if (elastic_ && parsed.has_elastic_ext && parsed.reconfigure) {
-        // Coordinated reconfiguration: adopt the new membership (or
-        // self-abort if evicted) and rebuild the data plane before
-        // handing the frame up — by the time Python sees it, the new
-        // ring is live and the next tick runs at the new generation.
-        ApplyReconfigure(parsed, response_list_blob);
-      } else if (elastic_ && parsed.has_elastic_ext &&
-                 parsed.generation != generation_) {
-        LatchAbort(first_rank_,
-                   "stale membership generation: coordinator is at "
-                   "generation " + std::to_string(parsed.generation) +
-                       ", this worker at " + std::to_string(generation_));
-        SerializeAbort(response_list_blob);
-      } else if (!ApplyResponseFrame(parsed, response_list_blob)) {
-        LatchAbort(first_rank_,
-                   "response cache protocol error: coordinator replayed a "
-                   "set this worker never stored");
-        SerializeAbort(response_list_blob);
-      }
-    }
-    return true;
+    return WorkerApplyResponse(response_list_blob);
   }
 
   // Coordinator: gather lists (own + one frame per worker, any order of
@@ -1504,6 +1860,170 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
   // remaining survivors' frames — they are needed intact so no tick-N
   // request poisons the post-reconfigure stream.
   std::vector<int> dead_procs;
+  if (CtrlHierActive()) {
+    // Hierarchical gather: one merged container per remote leader plus
+    // one raw frame per own-host member, expanded back into the same
+    // per-process `frames[]` the flat gather fills — the decision tier
+    // below runs unchanged on identical inputs, which is what pins hier
+    // responses bit-identical to flat.
+    const size_t P = size_t(process_count_);
+    std::vector<std::string> raw(P);
+    std::vector<bool> got(P, false);
+    // A whole sub-tree silenced by its leader's death is `absent`, not
+    // attributed: the leader takes the heartbeat blame (and the elastic
+    // eviction); its members rejoin at the rebuilt generation.
+    std::vector<bool> absent(P, false);
+    std::vector<int64_t> t1v(P, 0), t4v(P, 0), t2v(P, 0);
+    std::vector<bool> have_tr(P, false);
+    for (int L : leaders_) {
+      if (L == process_index_) continue;
+      std::string cblob;
+      const bool cgot =
+          RecvFrame(worker_fds_[size_t(L)], &cblob, heartbeat_ms_);
+      const int64_t t2_us = WallClockUs();
+      int64_t t1_us = 0, t4_prev_us = 0;
+      const bool have_trailer =
+          cgot && StripClockTrailer(&cblob, &t4_prev_us, &t1_us);
+      AggFrame agg;
+      const bool cparsed =
+          cgot &&
+          ParseAggFrame(reinterpret_cast<const uint8_t*>(cblob.data()),
+                        cblob.size(), &agg);
+      if (!cparsed) {
+        // Sub-coordinator lost: its whole host is unreachable this tick.
+        // got[L] stays false, so the processing pass below attributes
+        // the leader with the standard heartbeat failure.
+        FlightRecorder::Get().Record("gather.fail",
+                                     "sub-coordinator lost", 0, L,
+                                     cgot ? 0 : errno);
+        for (int p = 1; p < process_count_; ++p) {
+          if (p != L && size_t(p) < host_fps_.size() &&
+              size_t(L) < host_fps_.size() &&
+              host_fps_[size_t(p)] == host_fps_[size_t(L)]) {
+            absent[size_t(p)] = true;
+          }
+        }
+        continue;
+      }
+      root_gather_bytes->fetch_add((long long)cblob.size(),
+                                   std::memory_order_relaxed);
+      neg_bytes->fetch_add((long long)cblob.size(),
+                           std::memory_order_relaxed);
+      merged_frames->fetch_add((long long)agg.members.size(),
+                               std::memory_order_relaxed);
+      FlightRecorder::Get().Record("AGG_MERGE", "container expanded",
+                                   int64_t(cblob.size()),
+                                   int(agg.members.size()), L);
+      if (have_trailer) {
+        t1v[size_t(L)] = t1_us;
+        t4v[size_t(L)] = t4_prev_us;
+        t2v[size_t(L)] = t2_us;
+        have_tr[size_t(L)] = true;
+      }
+      for (auto& m : agg.members) {
+        if (m.pidx <= 0 || m.pidx >= process_count_) continue;
+        if (m.status == kAggOk) {
+          raw[size_t(m.pidx)] = std::move(m.frame);
+          got[size_t(m.pidx)] = true;
+        }
+        // kAggDead: got stays false — the processing pass synthesizes
+        // the identical attributed heartbeat failure the flat gather
+        // would have produced.
+      }
+    }
+    // Own-host members feed the root directly (the root is its own
+    // host's sub-coordinator) over the member sockets.
+    for (size_t k = 0; k + 1 < group_.size() && k < member_fds_.size();
+         ++k) {
+      const int mp = group_[k + 1];
+      if (mp <= 0 || mp >= process_count_) continue;
+      std::string blob;
+      const bool g = member_fds_[k] >= 0 &&
+                     RecvFrame(member_fds_[k], &blob, heartbeat_ms_);
+      const int64_t t2_us = WallClockUs();
+      int64_t t1_us = 0, t4_prev_us = 0;
+      const bool have_trailer =
+          g && StripClockTrailer(&blob, &t4_prev_us, &t1_us);
+      if (g) {
+        neg_bytes->fetch_add((long long)blob.size(),
+                             std::memory_order_relaxed);
+        raw[size_t(mp)] = std::move(blob);
+        got[size_t(mp)] = true;
+        if (have_trailer) {
+          t1v[size_t(mp)] = t1_us;
+          t4v[size_t(mp)] = t4_prev_us;
+          t2v[size_t(mp)] = t2_us;
+          have_tr[size_t(mp)] = true;
+        }
+      }
+    }
+    merged_frames->fetch_add((long long)group_.size(),
+                             std::memory_order_relaxed);
+    // Processing pass: process-index ascending, replicating the flat
+    // loop's decisions (parse, staleness, attribution precedence)
+    // verbatim so every failure string and fold order matches flat.
+    for (int i = 1; i < process_count_; ++i) {
+      if (!elastic_ && abort_rank >= 0) break;  // legacy: first failure wins
+      if (absent[size_t(i)]) continue;
+      std::string blob = std::move(raw[size_t(i)]);
+      const bool g = got[size_t(i)];
+      ObserveSample obs_sample;
+      bool have_obs = g && StripObserveTrailer(&blob, &obs_sample);
+      bool parsed_ok =
+          g &&
+          ParseRequestList(reinterpret_cast<const uint8_t*>(blob.data()),
+                           blob.size(), &frames[size_t(i)]);
+      bool stale = parsed_ok && elastic_ &&
+                   (!frames[size_t(i)].has_elastic_ext ||
+                    frames[size_t(i)].generation != generation_);
+      if (!parsed_ok || stale) {
+        if (abort_rank < 0) {
+          abort_rank = worker_first_rank_[size_t(i)];
+          abort_reason =
+              stale ? "rank " + std::to_string(abort_rank) +
+                          " (process " + std::to_string(i) +
+                          ") sent a frame from stale membership generation " +
+                          std::to_string(frames[size_t(i)].generation) +
+                          " (current " + std::to_string(generation_) + ")"
+                    : "rank " + std::to_string(abort_rank) +
+                          " (process " + std::to_string(i) +
+                          ") missed the " +
+                          std::to_string(heartbeat_ms_ / 1000) +
+                          "s heartbeat deadline (crashed, hung, or sent a "
+                          "corrupt frame)";
+        }
+        FlightRecorder::Get().Record(
+            "gather.fail",
+            (stale ? "stale generation"
+                   : "missed heartbeat / corrupt frame"),
+            0, i, g ? 0 : errno);
+        if (elastic_) dead_procs.push_back(i);
+      } else {
+        FlightRecorder::Get().Record("gather.recv", "",
+                                     int64_t(blob.size()), i,
+                                     worker_fds_[size_t(i)]);
+        if (have_tr[size_t(i)]) {
+          NoteClockSample(i, t1v[size_t(i)], t4v[size_t(i)],
+                          t2v[size_t(i)]);
+          const ClockEst& est = clock_sync_[size_t(i)].est;
+          if (est.valid) {
+            arrival_us[size_t(i)] =
+                t1v[size_t(i)] - int64_t(est.offset_us);
+            have_arrival[size_t(i)] = true;
+          }
+        }
+        if (have_obs) NoteFleetSample(i, obs_sample);
+        shutdown = shutdown || frames[size_t(i)].shutdown;
+        if (frames[size_t(i)].abort_rank >= 0 &&
+            (abort_rank < 0 ||
+             (is_root_cause(frames[size_t(i)].abort_reason) &&
+              !is_root_cause(abort_reason)))) {
+          abort_rank = frames[size_t(i)].abort_rank;
+          abort_reason = frames[size_t(i)].abort_reason;
+        }
+      }
+    }
+  } else {
   for (int i = 1; i < process_count_; ++i) {
     if (!elastic_ && abort_rank >= 0) break;   // legacy: first failure wins
     std::string blob;
@@ -1552,6 +2072,10 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
                                    worker_fds_[size_t(i)]);
       neg_bytes->fetch_add((long long)blob.size(),
                            std::memory_order_relaxed);
+      if (size_t(i) < host_fps_.size() && host_fps_[size_t(i)] != my_fp_) {
+        root_gather_bytes->fetch_add((long long)blob.size(),
+                                     std::memory_order_relaxed);
+      }
       if (have_trailer) {
         NoteClockSample(i, t1_us, t4_prev_us, t2_us);
         const ClockEst& est = clock_sync_[size_t(i)].est;
@@ -1571,6 +2095,7 @@ bool ControlPlane::Tick(const std::string& request_list_blob,
         abort_reason = frames[size_t(i)].abort_reason;
       }
     }
+  }
   }
   if (abort_rank < 0) {
     // Straggler attribution per tenant: a process whose frame carried
@@ -2095,6 +2620,77 @@ bool ControlPlane::BroadcastResponse(std::string* response_list_blob) {
   static std::atomic<long long>* neg_bytes =
       Metrics::Get().Counter("control.negotiation_bytes");
   ScopedTimer bcast_timer("control.bcast_seconds");
+  if (CtrlHierActive()) {
+    // Hierarchical fan-out: one send per remote leader (each forwards to
+    // its own members) plus one per own-host member — O(hosts) sends at
+    // the root, mirroring the gather.  Aborts and RECONFIGUREs never
+    // take this path: their broadcasts go star-wide from their own call
+    // sites, and leaders skip forwarding them (members dual-poll the
+    // star), so every member still sees exactly one frame per tick.
+    bool ok = true;
+    for (int L : leaders_) {
+      if (L == process_index_) continue;
+      if (!SendFrame(worker_fds_[size_t(L)], *response_list_blob)) {
+        FlightRecorder::Get().Record("bcast.fail",
+                                     "sub-coordinator link lost", 0, L,
+                                     worker_fds_[size_t(L)]);
+        if (!elastic_) {
+          LatchAbort(worker_first_rank_[size_t(L)],
+                     "rank " +
+                         std::to_string(worker_first_rank_[size_t(L)]) +
+                         " (process " + std::to_string(L) +
+                         ") dropped its coordinator connection");
+          SerializeAbort(response_list_blob);
+          ok = false;
+          break;
+        }
+        // Elastic: next gather confirms the death and reconfigures.
+        continue;
+      }
+      neg_bytes->fetch_add((long long)response_list_blob->size(),
+                           std::memory_order_relaxed);
+    }
+    if (ok) {
+      for (size_t k = 0; k + 1 < group_.size() && k < member_fds_.size();
+           ++k) {
+        const int mp = group_[k + 1];
+        if (member_fds_[k] < 0 ||
+            !SendFrame(member_fds_[k], *response_list_blob)) {
+          FlightRecorder::Get().Record("bcast.fail", "member link lost",
+                                       0, mp, member_fds_[k]);
+          if (!elastic_) {
+            LatchAbort(worker_first_rank_[size_t(mp)],
+                       "rank " +
+                           std::to_string(
+                               worker_first_rank_[size_t(mp)]) +
+                           " (process " + std::to_string(mp) +
+                           ") dropped its coordinator connection");
+            SerializeAbort(response_list_blob);
+            ok = false;
+            break;
+          }
+          continue;
+        }
+        neg_bytes->fetch_add((long long)response_list_blob->size(),
+                             std::memory_order_relaxed);
+      }
+    }
+    if (!ok) {
+      // The abort fallback is star-wide: every process (leader or
+      // member) dual-polls its direct root socket exactly for this.
+      for (int j = 1; j < process_count_; ++j) {
+        if (worker_fds_[size_t(j)] >= 0) {
+          SendFrame(worker_fds_[size_t(j)], *response_list_blob);
+        }
+      }
+      return false;
+    }
+    last_bcast_us_ = WallClockUs();
+    FlightRecorder::Get().Record("bcast.send", "hier",
+                                 int64_t(response_list_blob->size()), 0,
+                                 process_count_ - 1);
+    return true;
+  }
   for (int i = 1; i < process_count_; ++i) {
     if (!SendFrame(worker_fds_[size_t(i)], *response_list_blob)) {
       if (elastic_) {
@@ -2822,7 +3418,14 @@ bool ControlPlane::RebuildDataPlane() {
   uring_.reset();
   uring_state_ = 0;
   if (process_count_ <= 1) return true;
-  return SetupRing(coord_host_);
+  if (!SetupRing(coord_host_)) return false;
+  // The hierarchical control topology needs the tree live before the
+  // first post-reconfigure tick (members tick their leader, not the
+  // root), so re-elect eagerly instead of lazily like the data plane.
+  if (ctrl_topo_ == 1 && !EnsureHierarchy()) return false;
+  Metrics::Get().SetGauge("control.agg_depth",
+                          CtrlHierActive() ? 2.0 : 1.0);
+  return true;
 }
 
 void ControlPlane::FlushMembershipState() {

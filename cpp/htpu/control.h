@@ -469,6 +469,31 @@ class ControlPlane {
   int leader_next_fd_ = -1;             // leader -> next leader (dialed)
   int leader_prev_fd_ = -1;             // leader <- prev leader (accepted)
 
+  // ---- hierarchical control topology (HOROVOD_TPU_CONTROL_TOPO) ----
+  // hier deploys the aggregation tier (htpu/aggregate.h) over the same
+  // per-host tree: members tick their host leader, leaders forward ONE
+  // merged container to the root, responses fan back down — root fan-in
+  // is O(hosts), not O(procs).  flat (default) keeps every control frame
+  // byte-identical to the legacy protocol.
+  int ctrl_topo_ = 0;                   // 0 flat / 1 hier
+  int agg_timeout_ms_ = 0;              // leader's member-gather deadline
+  bool CtrlHierActive() const {
+    return ctrl_topo_ == 1 && process_count_ > 1 && hier_state_ == 1;
+  }
+  // Worker tick halves of the hier topology: a member ticks its host
+  // leader (responses normally return down the same socket; aborts and
+  // RECONFIGUREs arrive over the star, so the wait polls both); a leader
+  // gathers its members, forwards the merged container to the root, and
+  // fans the response down.
+  bool TickHierMember(const std::string& request_list_blob,
+                      std::string* response_list_blob);
+  bool TickHierLeader(const std::string& request_list_blob,
+                      std::string* response_list_blob);
+  // Shared worker-side response handling (parse, digest adoption, abort
+  // latch, RECONFIGURE application, stale-generation check, cache apply)
+  // — identical across the flat and hier worker paths.
+  bool WorkerApplyResponse(std::string* response_list_blob);
+
   // Data-plane scratch pool: buffers are reused (never shrunk) across
   // collectives so steady-state allreduces allocate nothing.
   std::vector<char> rbuf_[2];           // double-buffered receive slots

@@ -25,10 +25,12 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "htpu/aggregate.h"
 #include "htpu/control.h"
 #include "htpu/flight_recorder.h"
 #include "htpu/integrity.h"
@@ -592,6 +594,169 @@ int RunFailoverProcess(int pidx, int port) {
 // completions, tail first) while another drains the issue queue and
 // completes buckets.  TSan proves the planner's locking; ASan the
 // lifecycle.
+// Aggregation-tier phase: the hierarchical control plane's merge path in
+// its live shape under the sanitizers — per-host feeder threads
+// serializing partial HAGG containers into a shared queue (the
+// member→sub-coordinator feed), a merger thread folding them with
+// ParseAggFrame + AggregateRequests and periodically round-tripping the
+// accumulator through SerializeAggFrame (the leader→root forward), then
+// a teardown round that kills the merger mid-stream while feeders are
+// still producing — the reconfigure/eviction shutdown ordering tsan has
+// to prove clean.
+int RunAggregatePhase() {
+  constexpr int kFeeders = 4;    // fake hosts
+  constexpr int kPerHost = 8;    // members per host
+  std::mutex mu;
+  std::vector<std::string> queue;      // serialized partial containers
+  std::atomic<bool> feeding{true};
+  std::atomic<bool> stop{false};
+
+  auto member_frame = [](int pidx) {
+    // Half the fleet submits the identical bits-only frame (the
+    // cache-served steady state the template/roster compression exists
+    // for); the rest are unique, and every third member is a death
+    // report.
+    htpu::AggMember m;
+    m.pidx = pidx;
+    if (pidx % 3 == 2) {
+      m.status = htpu::kAggDead;
+    } else if (pidx % 2 == 0) {
+      m.frame = "tick-bits-only";
+    } else {
+      m.frame = "frame-p" + std::to_string(pidx);
+    }
+    return m;
+  };
+
+  // Single-threaded reference: the canonical bytes every merge order
+  // must reproduce.
+  htpu::AggFrame expect;
+  for (int p = 0; p < kFeeders * kPerHost; ++p)
+    expect.members.push_back(member_frame(p));
+  std::string expect_bytes;
+  htpu::SerializeAggFrame(expect, &expect_bytes);
+
+  // Corrupt-input sweep first (pure, single-threaded): every proper
+  // prefix of a valid container must be rejected, never over-read.
+  for (size_t cut = 0; cut < expect_bytes.size(); ++cut) {
+    htpu::AggFrame junk;
+    if (htpu::ParseAggFrame(
+            reinterpret_cast<const uint8_t*>(expect_bytes.data()), cut,
+            &junk)) {
+      fprintf(stderr, "smoke: agg parse accepted truncation at %zu\n", cut);
+      return 1;
+    }
+  }
+
+  auto feeder = [&](int host, bool duplicate) {
+    // Ship the host's members in little 3-member partial containers,
+    // and (round 1) ship every container twice — the merge is
+    // idempotent, so duplicates must not change the canonical result.
+    htpu::AggFrame part;
+    for (int i = 0; i < kPerHost; ++i) {
+      if (stop.load(std::memory_order_acquire)) return;
+      part.members.push_back(member_frame(host * kPerHost + i));
+      if (static_cast<int>(part.members.size()) == 3 || i == kPerHost - 1) {
+        std::string bytes;
+        htpu::SerializeAggFrame(part, &bytes);
+        std::lock_guard<std::mutex> lk(mu);
+        queue.push_back(bytes);
+        if (duplicate) queue.push_back(bytes);
+        part.members.clear();
+      }
+    }
+  };
+
+  auto run_round = [&](bool teardown) -> bool {
+    feeding.store(true);
+    stop.store(false);
+    queue.clear();
+    htpu::AggFrame acc;
+    std::thread merger([&] {
+      int folded = 0;
+      for (;;) {
+        std::string bytes;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          if (!queue.empty()) {
+            bytes = std::move(queue.back());
+            queue.pop_back();
+          }
+        }
+        if (bytes.empty()) {
+          if (stop.load(std::memory_order_acquire)) return;
+          if (!feeding.load()) {
+            std::lock_guard<std::mutex> lk(mu);
+            if (queue.empty()) return;
+          }
+          std::this_thread::yield();
+          continue;
+        }
+        htpu::AggFrame part;
+        if (!htpu::ParseAggFrame(
+                reinterpret_cast<const uint8_t*>(bytes.data()),
+                bytes.size(), &part)) {
+          fprintf(stderr, "smoke: agg merger parse failed\n");
+          _exit(1);
+        }
+        htpu::AggregateRequests(part, &acc);
+        if (++folded % 4 == 0) {
+          // Leader→root forward: the accumulator must survive a
+          // serialize/parse round trip bit-exactly.
+          std::string fwd;
+          htpu::SerializeAggFrame(acc, &fwd);
+          htpu::AggFrame back;
+          if (!htpu::ParseAggFrame(
+                  reinterpret_cast<const uint8_t*>(fwd.data()), fwd.size(),
+                  &back)) {
+            fprintf(stderr, "smoke: agg forward re-parse failed\n");
+            _exit(1);
+          }
+          acc = std::move(back);
+        }
+      }
+    });
+    std::vector<std::thread> feeders;
+    for (int h = 0; h < kFeeders; ++h)
+      feeders.emplace_back(feeder, h, /*duplicate=*/!teardown);
+    if (teardown) stop.store(true, std::memory_order_release);
+    for (auto& t : feeders) t.join();
+    feeding.store(false);
+    merger.join();
+    if (teardown) return true;  // raced shutdown: only cleanliness matters
+    std::string got;
+    htpu::SerializeAggFrame(acc, &got);
+    if (got != expect_bytes) {
+      fprintf(stderr, "smoke: agg merge not canonical (%zu vs %zu bytes)\n",
+              got.size(), expect_bytes.size());
+      return false;
+    }
+    // Decision-tier counterpart: one response pair per surviving member.
+    auto fanout = htpu::SplitResponses("resp-frame", acc);
+    size_t ok = 0;
+    for (const auto& m : acc.members) ok += m.status == htpu::kAggOk;
+    if (fanout.size() != ok) {
+      fprintf(stderr, "smoke: agg split %zu pairs for %zu ok members\n",
+              fanout.size(), ok);
+      return false;
+    }
+    return true;
+  };
+
+  for (int round = 0; round < 4; ++round) {
+    if (!run_round(/*teardown=*/false)) return 1;
+    if (!run_round(/*teardown=*/true)) return 1;
+  }
+  if (htpu::MergeCacheBits("\x05", std::string("\x22\x00", 2)) != "\x27") {
+    fprintf(stderr, "smoke: cache-bits merge wrong\n");
+    return 1;
+  }
+  fprintf(stderr,
+          "smoke: aggregation OK (%d members x 4 rounds + teardown)\n",
+          kFeeders * kPerHost);
+  return 0;
+}
+
 int RunOverlapPlannerPhase() {
   htpu::BucketPlanner planner(64);
   constexpr int kLeaves = 32;
@@ -1554,6 +1719,7 @@ int main() {
       return 1;
     }
   }
+  if (RunAggregatePhase() != 0) return 1;
   if (RunOverlapPlannerPhase() != 0) return 1;
   if (RunFleetPolicyPhase() != 0) return 1;
   if (RunPrecisionPhase() != 0) return 1;

@@ -1692,9 +1692,6 @@ class Controller:
             f"Horovod membership reconfigured at generation "
             f"{ext.generation}: {cause}. Restore from the latest "
             "checkpoint and retry.")
-        # Completes in-flight entries, clears the queue/tensor table and
-        # negotiation state — same quiesce as an abort, different status.
-        self._fail_all(status)
         with self._lock:
             # Failure reports attributed under the OLD generation must not
             # ride the next tick — the coordinator already acted on them.
@@ -1744,6 +1741,21 @@ class Controller:
         except Exception:   # noqa: BLE001 — tenant bookkeeping must not
             pass            # block pod survival
         _metrics.registry.set_gauge("membership.generation", generation)
+        # Published LAST, after rank()/size() report the new world: the
+        # seam elastic.generation() reads.  Training threads poll it to
+        # detect a between-steps reconfigure; publishing the native value
+        # early would let them observe the new generation while the
+        # framework rank is still the old one and enqueue a request
+        # stamped with an out-of-range rank into a new-generation frame.
+        self._adopted_generation = generation
+        # Quiesce LAST, once rank()/size() and the adopted generation all
+        # describe the new world: _fail_all completes every in-flight
+        # entry RETRYABLE (the elastic driver restores from the latest
+        # checkpoint and re-submits), and the woken training threads
+        # immediately rebuild their requests from the framework identity.
+        # Waking them before the identity update would let a retry stamp
+        # an out-of-range old-world rank into a new-generation frame.
+        self._fail_all(status)
         cpp_core.flight_record(
             "elastic.adopted", f"gen={generation}", first_rank, new_size)
         if pidx == 0 and old_pidx != 0:

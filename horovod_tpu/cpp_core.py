@@ -223,6 +223,18 @@ def _configure(lib) -> None:
         lib.htpu_observe_trailer_probe.argtypes = [
             ctypes.c_char_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_void_p)]
+    # Aggregation tier (guarded: a prebuilt .so predating the
+    # hierarchical control topology still loads for the rest of the
+    # surface).
+    if hasattr(lib, "htpu_agg_merge"):
+        lib.htpu_agg_merge.restype = ctypes.c_int
+        lib.htpu_agg_merge.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.htpu_agg_roundtrip.restype = ctypes.c_int
+        lib.htpu_agg_roundtrip.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p)]
     # Scheduler API (guarded: a prebuilt .so predating the plane-agnostic
     # scheduler still loads for the rest of the surface).
     if hasattr(lib, "htpu_sched_create"):
@@ -1037,6 +1049,38 @@ def metrics_reset() -> None:
     lib = load()
     if lib is not None:
         lib.htpu_metrics_reset()
+
+
+def agg_merge(a: bytes, b: bytes):
+    """Fold serialized aggregation container ``b`` into ``a`` through the
+    native merge (cpp/htpu/aggregate.cc) and return the canonical merged
+    container bytes.  ``None`` when the native core is unavailable or
+    predates the aggregation tier; raises ``ValueError`` on a corrupt
+    container — the parity seam tests/test_aggregate.py drives against
+    the Python mirror (horovod_tpu/aggregate.py)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_agg_merge"):
+        return None
+    out = ctypes.c_void_p()
+    n = lib.htpu_agg_merge(a, len(a), b, len(b), ctypes.byref(out))
+    if n < 0:
+        raise ValueError("corrupt aggregation container")
+    return _take_buffer(lib, out, n)
+
+
+def agg_roundtrip(buf: bytes):
+    """Parse + canonically re-serialize one aggregation container through
+    the native code.  ``None`` when the native core is unavailable or
+    predates the aggregation tier; raises ``ValueError`` on a corrupt
+    container."""
+    lib = load()
+    if lib is None or not hasattr(lib, "htpu_agg_roundtrip"):
+        return None
+    out = ctypes.c_void_p()
+    n = lib.htpu_agg_roundtrip(buf, len(buf), ctypes.byref(out))
+    if n < 0:
+        raise ValueError("corrupt aggregation container")
+    return _take_buffer(lib, out, n)
 
 
 def observe_enabled():

@@ -58,9 +58,21 @@ def generation() -> int:
     control plane is attached (single-process jobs)."""
     if not basics.is_initialized():
         return -1
-    ctl = getattr(basics.controller(), "_control", None)
+    controller = basics.controller()
+    ctl = getattr(controller, "_control", None)
     if ctl is None:
         return -1
+    # Read the PYTHON-ADOPTED generation (published by the controller
+    # thread after it refreshed rank()/size()), not the native plane's:
+    # the native value bumps inside the tick that applies the
+    # reconfigure, a moment before the framework identity updates.  A
+    # training thread polling the native value could observe the new
+    # generation, retry, and build requests stamped with its OLD rank
+    # into a new-generation frame — which the coordinator rejects as a
+    # rank out of range.
+    adopted = getattr(controller, "_adopted_generation", None)
+    if adopted is not None:
+        return adopted
     return ctl.membership()[3]
 
 

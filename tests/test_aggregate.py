@@ -1,0 +1,513 @@
+"""Aggregation-tier semantics (hierarchical control topology).
+
+Fast half: property tests for the container merge — associativity,
+commutativity, idempotence (the algebra that lets the control tree fold
+request frames at any depth without coordinator state) — plus wire
+round-trips, corrupt-container rejection, and byte parity between the
+Python mirror (``horovod_tpu/aggregate.py``) and the native code
+(``cpp/htpu/aggregate.cc``, through ``cpp_core.agg_merge`` /
+``agg_roundtrip``).
+
+Slow half: real multi-process jobs on faked 2-host topologies pinning
+``HOROVOD_TPU_CONTROL_TOPO=hier`` BIT-identical to ``flat`` — same
+allreduce bytes across cache-served ticks and per-set traffic — and the
+failure matrix: a dead member is evicted by an elastic reconfigure
+mid-run, and a dead sub-coordinator's host re-elects after the rebuild.
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import aggregate as agg
+from horovod_tpu import cpp_core
+
+
+def member(pidx, status=agg.AGG_OK, frame=b""):
+    return agg.AggMember(pidx, status, frame)
+
+
+def rand_members(rng, npidx=8):
+    """A random member multiset: duplicate pidxs, shared frames (to
+    exercise template election), dead entries."""
+    frames = [bytes(rng.getrandbits(8) for _ in range(rng.randrange(12)))
+              for _ in range(3)]
+    out = []
+    for _ in range(rng.randrange(1, 10)):
+        status = rng.choice([agg.AGG_OK, agg.AGG_OK, agg.AGG_OK,
+                             agg.AGG_DEAD, agg.AGG_STALE])
+        out.append(member(rng.randrange(npidx), status,
+                          rng.choice(frames) if status == agg.AGG_OK
+                          else b""))
+    return out
+
+
+def fold(*sets):
+    acc = []
+    for s in sets:
+        acc = agg.aggregate_requests(s, acc)
+    return acc
+
+
+class TestMergeAlgebra:
+    def test_associative_and_commutative(self):
+        rng = random.Random(7)
+        for _ in range(200):
+            a, b, c = (rand_members(rng) for _ in range(3))
+            left = agg.serialize_agg_frame(fold(fold(a, b), c))
+            right = agg.serialize_agg_frame(fold(a, fold(b, c)))
+            swapped = agg.serialize_agg_frame(fold(c, b, a))
+            assert left == right == swapped
+
+    def test_idempotent(self):
+        rng = random.Random(8)
+        for _ in range(100):
+            a = rand_members(rng)
+            once = agg.serialize_agg_frame(fold(a))
+            twice = agg.serialize_agg_frame(fold(a, a))
+            assert once == twice
+
+    def test_death_report_beats_frame(self):
+        # A leader that saw the member's frame AND a later death report
+        # must resolve to dead regardless of fold order.
+        alive = [member(3, agg.AGG_OK, b"req")]
+        dead = [member(3, agg.AGG_DEAD)]
+        for order in ((alive, dead), (dead, alive)):
+            (m,) = fold(*order)
+            assert m.status == agg.AGG_DEAD and m.frame == b""
+
+    def test_equal_status_keeps_smaller_frame(self):
+        a = [member(1, agg.AGG_OK, b"bbb")]
+        b = [member(1, agg.AGG_OK, b"aaa")]
+        for order in ((a, b), (b, a)):
+            (m,) = fold(*order)
+            assert m.frame == b"aaa"
+
+    def test_cache_bits_or_merge_algebra(self):
+        rng = random.Random(9)
+        for _ in range(200):
+            a, b, c = (bytes(rng.getrandbits(8)
+                             for _ in range(rng.randrange(6)))
+                       for _ in range(3))
+            left = agg.merge_cache_bits(agg.merge_cache_bits(a, b), c)
+            right = agg.merge_cache_bits(a, agg.merge_cache_bits(b, c))
+            assert left == right
+            assert (agg.merge_cache_bits(a, b)
+                    == agg.merge_cache_bits(b, a))
+            once = agg.merge_cache_bits(a, b)
+            assert agg.merge_cache_bits(once, once) == once
+
+    def test_cache_bits_trim_trailing_zeros(self):
+        assert agg.merge_cache_bits(b"\x01\x00\x00", b"\x00") == b"\x01"
+        assert agg.merge_cache_bits(b"", b"") == b""
+        assert agg.merge_cache_bits(b"\x80", b"\x01") == b"\x81"
+
+
+class TestWireFormat:
+    def test_roundtrip_random(self):
+        rng = random.Random(10)
+        for _ in range(200):
+            members = rand_members(rng)
+            canon = fold(members)
+            buf = agg.serialize_agg_frame(members)
+            assert agg.parse_agg_frame(buf) == canon
+            # Canonical serialization is a fixed point.
+            assert agg.serialize_agg_frame(agg.parse_agg_frame(buf)) == buf
+
+    def test_template_roster_compresses_uniform_tick(self):
+        # The steady-state cache-served tick: every member submits the
+        # identical bits-only frame.  The container must carry the frame
+        # ONCE plus one [first, count) roster — O(1) in member count.
+        frame = b"\x02" + b"\x07" * 30
+        small = agg.serialize_agg_frame(
+            [member(p, agg.AGG_OK, frame) for p in range(4)])
+        big = agg.serialize_agg_frame(
+            [member(p, agg.AGG_OK, frame) for p in range(64)])
+        assert len(big) == len(small)
+        assert big.count(frame) == 1
+
+    def test_ragged_pidx_runs_split_rosters(self):
+        frame = b"same"
+        buf = agg.serialize_agg_frame(
+            [member(p, agg.AGG_OK, frame) for p in (0, 1, 3, 4, 5)])
+        parsed = agg.parse_agg_frame(buf)
+        assert [m.pidx for m in parsed] == [0, 1, 3, 4, 5]
+        assert all(m.frame == frame for m in parsed)
+
+    def test_no_singleton_template(self):
+        # One member sharing with nobody: flags byte 0, frame inline.
+        buf = agg.serialize_agg_frame([member(2, agg.AGG_OK, b"only")])
+        assert buf[5] == 0
+        assert agg.parse_agg_frame(buf) == [member(2, agg.AGG_OK, b"only")]
+
+    @pytest.mark.parametrize("mutate", [
+        lambda b: b"XXXX" + b[4:],                      # bad magic
+        lambda b: b[:4] + b"\x63" + b[5:],              # unknown version
+        lambda b: b[:5] + b"\x82" + b[6:],              # unknown flags
+        lambda b: b[:-1],                               # truncated
+        lambda b: b + b"\x00",                          # trailing bytes
+        lambda b: b"",                                  # empty
+    ])
+    def test_corrupt_containers_rejected(self, mutate):
+        buf = agg.serialize_agg_frame(
+            [member(0, agg.AGG_OK, b"f"), member(1, agg.AGG_DEAD)])
+        with pytest.raises(ValueError):
+            agg.parse_agg_frame(mutate(buf))
+
+    def test_negative_roster_count_rejected(self):
+        head = struct.pack("<IBB", agg.AGG_MAGIC, agg.AGG_VERSION, 0)
+        with pytest.raises(ValueError):
+            agg.parse_agg_frame(head + struct.pack("<i", -1)
+                                + struct.pack("<i", 0))
+
+    def test_split_responses_targets_ok_members_only(self):
+        members = [member(0, agg.AGG_OK, b"a"), member(1, agg.AGG_DEAD),
+                   member(2, agg.AGG_OK, b"b")]
+        assert agg.split_responses(b"resp", members) == [(0, b"resp"),
+                                                         (2, b"resp")]
+
+
+@pytest.mark.skipif(not cpp_core.available(),
+                    reason="native core not built")
+class TestNativeParity:
+    def test_merge_parity_random(self):
+        rng = random.Random(11)
+        for _ in range(100):
+            a = agg.serialize_agg_frame(rand_members(rng))
+            b = agg.serialize_agg_frame(rand_members(rng))
+            py = agg.serialize_agg_frame(
+                fold(agg.parse_agg_frame(a), agg.parse_agg_frame(b)))
+            nat = cpp_core.agg_merge(b, a)   # note: folds a INTO b
+            if nat is None:
+                pytest.skip("prebuilt core predates the aggregation tier")
+            assert nat == py
+
+    def test_roundtrip_parity_random(self):
+        rng = random.Random(12)
+        for _ in range(100):
+            buf = agg.serialize_agg_frame(rand_members(rng))
+            nat = cpp_core.agg_roundtrip(buf)
+            if nat is None:
+                pytest.skip("prebuilt core predates the aggregation tier")
+            assert nat == buf
+
+    def test_native_rejects_corrupt(self):
+        if cpp_core.agg_roundtrip(agg.serialize_agg_frame([])) is None:
+            pytest.skip("prebuilt core predates the aggregation tier")
+        with pytest.raises(ValueError):
+            cpp_core.agg_roundtrip(b"XXXXgarbage")
+        good = agg.serialize_agg_frame([member(0, agg.AGG_OK, b"f")])
+        with pytest.raises(ValueError):
+            cpp_core.agg_merge(good, good[:-1])
+
+
+# ------------------------------------------------------- slow multi-process
+
+# Mixed workload covering every negotiation regime the aggregation tier
+# must keep bit-identical: fresh requests, cache-served replay ticks
+# (uniform bits-only frames — the roster fast path), and set-tagged
+# traffic (never cached, so the container carries it as a non-template
+# member).  Prints a digest of every result plus metrics.
+TOPO_WORKER = textwrap.dedent("""
+    import hashlib, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    digest = hashlib.sha256()
+    for i in range(4):
+        rng = np.random.RandomState(2000 + i)
+        base = rng.randint(-1000, 1000, size=4096).astype(np.float32)
+        out = np.asarray(hvd.allreduce(base + float(rank * (i + 1)),
+                                       average=False, name=f"topo.{i}"))
+        want = base * n + float(sum(r * (i + 1) for r in range(n)))
+        if not np.array_equal(out, want):
+            raise AssertionError(f"rank {rank} payload {i}: wrong sum")
+        digest.update(out.tobytes())
+    # Cache-served replay: uniform bits-only frames, the container's
+    # template/roster fast path.
+    fixed = np.full(4096, 3.0, np.float32)
+    for j in range(8):
+        out = np.asarray(hvd.allreduce(fixed, average=False,
+                                       name="topo.replay"))
+        if not np.array_equal(out, np.full(4096, 3.0 * n, np.float32)):
+            raise AssertionError(f"rank {rank} replay {j}: wrong sum")
+        digest.update(out.tobytes())
+    # Per-set traffic (set-tagged requests never cache): singleton sets
+    # so the eager data plane stays process-local.
+    me = hvd.process_set_by_name(f"solo{rank}")
+    for j in range(2):
+        out = np.asarray(hvd.allreduce(np.full(64, float(rank + j), np.float32),
+                                       average=False, name=f"topo.set.{j}",
+                                       process_set=me))
+        if not np.array_equal(out, np.full(64, float(rank + j), np.float32)):
+            raise AssertionError(f"rank {rank} set {j}: wrong sum")
+        digest.update(out.tobytes())
+    # Drain barrier: one last GLOBAL collective so no rank reaches
+    # shutdown while a peer is still negotiating its solo-set ops above
+    # (solo sets are per-rank, so they run after the last global sync
+    # point — rank 0 exiting first would tear down the coordinator under
+    # the straggler).  Launcher hygiene, identical in both topologies.
+    out = np.asarray(hvd.allreduce(np.ones(16, np.float32),
+                                   average=False, name="topo.drain"))
+    digest.update(out.tobytes())
+    print("DIGEST", digest.hexdigest(), flush=True)
+    snap = {"counters": hvd.metrics()["counters"],
+            "gauges": hvd.metrics()["gauges"]}
+    print("SNAP", json.dumps(snap), flush=True)
+    hvd.shutdown()
+""")
+
+# Elastic loop: one process SIGKILLs itself mid-run; survivors must ride
+# the reconfigure (never HorovodAbortedError) and finish at the shrunken
+# world.
+ELASTIC_TOPO_WORKER = textwrap.dedent("""
+    import os, signal, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint, elastic
+
+    elastic.init()
+    ckpt = os.environ["TEST_CKPT_DIR"]
+    die_rank = int(os.environ.get("TEST_DIE_RANK", "-1"))
+    expect_size = int(os.environ.get("TEST_EXPECT_SIZE", "1"))
+    w0 = np.arange(8, dtype=np.float32)
+
+    def train(state, resume_epoch):
+        gen = elastic.generation()
+        if gen == 0:
+            checkpoint.save(ckpt, state, 0)
+        if gen == 0 or hvd.size() != expect_size:
+            t0 = time.monotonic()
+            i = 0
+            while time.monotonic() - t0 < 90:
+                if elastic.generation() != gen:
+                    raise hvd.HorovodRetryableError(
+                        "membership changed between steps")
+                if hvd.rank() == die_rank and i == 5:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                hvd.allreduce(np.ones(8, np.float32), name=f"et.{gen}.{i}")
+                i += 1
+            print(f"NO_RECONFIG rank={hvd.rank()}", flush=True)
+            sys.exit(5)
+        print(f"RESUMED rank={hvd.rank()} size={hvd.size()} gen={gen}",
+              flush=True)
+        return state
+
+    try:
+        elastic.run_elastic(train, directory=ckpt, like={"w": w0})
+    except hvd.HorovodAbortedError as e:
+        print(f"ABORTED rank={hvd.rank()} msg={e}", flush=True)
+        sys.exit(3)
+    print("DONE", flush=True)
+""")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(fingerprints, topo, script=TOPO_WORKER, extra_env=None,
+           timeout=150):
+    nprocs = len(fingerprints)
+    port = free_port()
+    procs = []
+    for i, fp in enumerate(fingerprints):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_TPU_PROCESS_INDEX": str(i),
+            "HOROVOD_TPU_PROCESS_COUNT": str(nprocs),
+            "HOROVOD_TPU_SIZE": str(nprocs),
+            "HOROVOD_TPU_RANK": str(i),
+            "HOROVOD_TPU_CONTROL_TIMEOUT_S": "60",
+            "HOROVOD_TPU_CYCLE_TIME_MS": "2",
+            "HOROVOD_TPU_HOST_FINGERPRINT": fp,
+            "HOROVOD_TPU_CONTROL_TOPO": topo,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        env.pop("HOROVOD_TPU_TIMELINE", None)
+        env.pop("HOROVOD_TPU_FAULT", None)
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
+def parse(out):
+    digest = snap = None
+    for line in out.splitlines():
+        if line.startswith("DIGEST "):
+            digest = line.split()[1]
+        elif line.startswith("SNAP "):
+            snap = json.loads(line[len("SNAP "):])
+    return digest, snap
+
+
+def run_topo(fingerprints, topo, **kw):
+    sets = ";".join(f"solo{r}:{r}" for r in range(len(fingerprints)))
+    extra = {"HOROVOD_TPU_PROCESS_SETS": sets}
+    extra.update(kw.pop("extra_env", {}))
+    results = launch(fingerprints, topo, extra_env=extra, **kw)
+    parsed = []
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {i} (topo={topo!r}) failed:\n{out}"
+        digest, snap = parse(out)
+        assert digest and snap is not None, out
+        parsed.append((digest, snap))
+    return parsed
+
+
+slow_native = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not cpp_core.available(),
+                       reason="native core not built"),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not cpp_core.available(),
+                    reason="native core not built")
+class TestHierTopology:
+    def test_hier_bit_identical_to_flat_two_fake_hosts(self):
+        fps = ["hostA", "hostA", "hostB", "hostB"]
+        flat = run_topo(fps, "flat")
+        hier = run_topo(fps, "hier")
+        # The whole point: identical collective results on every rank,
+        # cached ticks and (rank-local, hence per-rank digests) per-set
+        # traffic included.
+        for i in range(len(fps)):
+            assert flat[i][0] == hier[i][0], f"rank {i} diverged"
+        root_flat, root_hier = flat[0][1], hier[0][1]
+        # Topology depth gauge: 2 tiers under hier, 1 under flat.
+        assert root_hier["gauges"].get("control.agg_depth") == 2.0
+        assert root_flat["gauges"].get("control.agg_depth") == 1.0
+        # Containers actually merged frames at both tiers...
+        assert root_hier["counters"].get("control.merged_frames", 0) > 0
+        assert root_flat["counters"].get("control.merged_frames", 0) == 0
+        leader_b = hier[2][1]["counters"]
+        assert leader_b.get("control.merged_frames", 0) > 0
+        # ...and both modes moved real bytes over the inter-host star.
+        flat_ingress = root_flat["counters"].get(
+            "control.root_gather_bytes", 0)
+        hier_ingress = root_hier["counters"].get(
+            "control.root_gather_bytes", 0)
+        assert flat_ingress > 0 and hier_ingress > 0
+        # Members ticked their sub-coordinator, not the root, yet the
+        # response cache still served replay ticks everywhere.
+        for _, snap in flat + hier:
+            assert snap["counters"].get("control.cache_hits", 0) > 0
+
+    def test_hier_member_death_reconfigures_elastic(self, tmp_path):
+        # proc 3 is host B's member (its leader is proc 2): its death is
+        # reported upward inside the container as a Dead entry and the
+        # elastic reconfigure evicts exactly that process.
+        fps = ["hostA", "hostA", "hostB", "hostB"]
+        results = launch(
+            fps, "hier", script=ELASTIC_TOPO_WORKER,
+            extra_env={"HOROVOD_TPU_ELASTIC": "1",
+                       "TEST_CKPT_DIR": str(tmp_path),
+                       "TEST_DIE_RANK": "3",
+                       "TEST_EXPECT_SIZE": "3"})
+        assert results[3][0] == -signal.SIGKILL
+        for i in (0, 1, 2):
+            rc, out = results[i]
+            assert rc == 0, f"proc {i}:\n{out}"
+            assert "ABORTED" not in out, out
+            assert f"RESUMED rank={i} size=3 gen=1" in out, out
+
+    def test_hier_leader_death_reelects_elastic(self, tmp_path):
+        # proc 2 is host B's sub-coordinator.  Its death silences the
+        # whole host for one tick: the root attributes the LEADER (its
+        # member is absent, not blamed), evicts it, and the rebuild
+        # re-runs the hierarchy bootstrap so proc 3 is re-elected as its
+        # host's leader and rejoins.
+        fps = ["hostA", "hostA", "hostB", "hostB"]
+        results = launch(
+            fps, "hier", script=ELASTIC_TOPO_WORKER,
+            extra_env={"HOROVOD_TPU_ELASTIC": "1",
+                       "TEST_CKPT_DIR": str(tmp_path),
+                       "TEST_DIE_RANK": "2",
+                       "TEST_EXPECT_SIZE": "3"},
+            timeout=240)
+        assert results[2][0] == -signal.SIGKILL
+        for i in (0, 1, 3):
+            rc, out = results[i]
+            assert rc == 0, f"proc {i}:\n{out}"
+            assert "ABORTED" not in out, out
+            assert "size=3 gen=1" in out, out
+
+    def test_topo_mismatch_rejected_at_bootstrap(self):
+        # The knob must agree job-wide: rank 1 selecting hier while rank
+        # 0 runs flat is a bootstrap error naming both choices, not a
+        # hang or a silent downgrade.
+        fps = ["hostA", "hostA"]
+        port = free_port()
+        procs = []
+        for i, fp in enumerate(fps):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_TPU_COORD_ADDR": f"127.0.0.1:{port}",
+                "HOROVOD_TPU_PROCESS_INDEX": str(i),
+                "HOROVOD_TPU_PROCESS_COUNT": "2",
+                "HOROVOD_TPU_SIZE": "2",
+                "HOROVOD_TPU_RANK": str(i),
+                "HOROVOD_TPU_CONTROL_TIMEOUT_S": "30",
+                "HOROVOD_TPU_HOST_FINGERPRINT": fp,
+                "HOROVOD_TPU_CONTROL_TOPO": "hier" if i else "flat",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            script = textwrap.dedent("""
+                import os, sys
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                import horovod_tpu as hvd
+                try:
+                    hvd.init()
+                except Exception as e:
+                    print(f"INIT_FAIL {e}", flush=True)
+                    sys.exit(7)
+                print("INIT_OK", flush=True)
+                hvd.shutdown()
+            """)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        rcs = [p.returncode for p in procs]
+        joined = "\n".join(outs)
+        assert any(rc != 0 for rc in rcs), joined
+        assert "HOROVOD_TPU_CONTROL_TOPO mismatch" in joined, joined

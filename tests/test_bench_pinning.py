@@ -152,6 +152,12 @@ class TestBenchSummary:
             },
         },
         "scaling_virtual_8dev": {"scaling_efficiency": 0.12},
+        "ctrl_sweep": {
+            "legs": {"128p": {"flat_tick_us": 900.0,
+                              "hier_tick_us": 300.0,
+                              "hier_tick_speedup": 3.0}},
+            "hier_tick_speedup_128p": 3.0,
+        },
         "scaling_tcp_2proc": {
             "scaling_efficiency": 0.33,
             "comm_fraction": 0.35,
@@ -167,9 +173,9 @@ class TestBenchSummary:
         },
     }
 
-    # The r07 artifact schema: trend lines parse these exact keys, so a
+    # The r08 artifact schema: trend lines parse these exact keys, so a
     # rename or drop is an interface break, not a refactor.
-    R07_KEYS = {
+    R08_KEYS = {
         "resnet_step_time_ms", "resnet_mfu",
         "transformer_step_time_ms", "transformer_mfu",
         "virtual_scaling_efficiency", "tcp_scaling_efficiency",
@@ -177,11 +183,12 @@ class TestBenchSummary:
         "shm_vs_uds_speedup_256k_plus", "crc_overhead_256k_plus",
         "observe_ab", "precision_auto_tcp_vs_best_static",
         "precision_auto_injit_vs_best_static", "precision_auto_injit",
+        "hier_tick_speedup_128p",
     }
 
     def test_headlines_extracted(self, tmp_path, bench_mod):
         import json
-        path = str(tmp_path / "BENCH_r07.json")
+        path = str(tmp_path / "BENCH_r08.json")
         assert bench_mod.write_bench_summary(self.REPORT, path) == path
         s = json.loads(open(path).read())
         assert s["resnet_step_time_ms"] == 123.4
@@ -194,19 +201,20 @@ class TestBenchSummary:
         assert s["precision_auto_injit_vs_best_static"] == 1.02
         assert s["precision_auto_injit"]["buckets_by_wire"] == {
             "bf16": 3, "fp32": 1}
+        assert s["hier_tick_speedup_128p"] == 3.0
 
-    def test_r07_schema_pinned(self, tmp_path, bench_mod):
+    def test_r08_schema_pinned(self, tmp_path, bench_mod):
         import json
-        path = str(tmp_path / "BENCH_r07.json")
+        path = str(tmp_path / "BENCH_r08.json")
         bench_mod.write_bench_summary(self.REPORT, path)
-        assert set(json.loads(open(path).read())) == self.R07_KEYS
+        assert set(json.loads(open(path).read())) == self.R08_KEYS
 
-    def test_default_artifact_name_is_r07(self, bench_mod, monkeypatch,
+    def test_default_artifact_name_is_r08(self, bench_mod, monkeypatch,
                                           tmp_path):
         monkeypatch.delenv("BENCH_SUMMARY_FILE", raising=False)
         monkeypatch.chdir(tmp_path)
-        assert bench_mod.write_bench_summary({}) == "BENCH_r07.json"
-        assert (tmp_path / "BENCH_r07.json").exists()
+        assert bench_mod.write_bench_summary({}) == "BENCH_r08.json"
+        assert (tmp_path / "BENCH_r08.json").exists()
 
     def test_missing_legs_become_none_not_errors(self, tmp_path, bench_mod):
         import json

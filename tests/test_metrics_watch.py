@@ -269,3 +269,95 @@ class TestBadInputs:
         assert rc == 1
         err = capsys.readouterr().err
         assert "no complete snapshots" in err
+
+
+class TestStaleRankGate:
+    """After an elastic shrink a retired rank's JSONL file freezes at the
+    old membership generation; the --once fleet view must not render its
+    per-rank series as if the rank were live (PR: hierarchical control
+    plane)."""
+
+    @staticmethod
+    def _line(rank, gen, ts=100, ticks=5):
+        return json.dumps({"rank": rank, "ts": ts,
+                           "counters": {"control.ticks": ticks},
+                           "gauges": {"membership.generation": gen},
+                           "histograms": {}})
+
+    def test_retired_rank_gets_stale_line_not_digest(self, tmp_path,
+                                                     capsys):
+        live = tmp_path / "m.0.jsonl"
+        dead = tmp_path / "m.3.jsonl"
+        live.write_text(self._line(0, gen=1) + "\n")
+        dead.write_text(self._line(3, gen=0) + "\n")
+        rc = metrics_watch.follow([str(live), str(dead)], once=True,
+                                  name_filter="", poll_s=0.01)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "STALE" in out and "generation 0" in out
+        # The stale file's series are skipped: only the live rank's full
+        # render carries counters.
+        assert out.count("control.ticks") == 1
+        assert "── rank 0 @" in out
+        assert "── rank 3 @" not in out
+
+    def test_same_generation_ranks_all_render(self, tmp_path, capsys):
+        a = tmp_path / "m.0.jsonl"
+        b = tmp_path / "m.1.jsonl"
+        a.write_text(self._line(0, gen=2) + "\n")
+        b.write_text(self._line(1, gen=2) + "\n")
+        rc = metrics_watch.follow([str(a), str(b)], once=True,
+                                  name_filter="", poll_s=0.01)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "STALE" not in out
+        assert out.count("control.ticks") == 2
+
+    def test_pre_elastic_files_unaffected(self, tmp_path, capsys):
+        # No membership.generation gauge at all (non-elastic job): every
+        # file reads as generation 0 and the gate never fires.
+        a = tmp_path / "m.0.jsonl"
+        b = tmp_path / "m.1.jsonl"
+        a.write_text(snap_line(0, 100, 7) + "\n")
+        b.write_text(snap_line(1, 100, 9) + "\n")
+        rc = metrics_watch.follow([str(a), str(b)], once=True,
+                                  name_filter="", poll_s=0.01)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "STALE" not in out
+        assert out.count("control.ticks") == 2
+
+
+class TestTopologyDigest:
+    """Control-topology digest line (PR: hierarchical control plane)."""
+
+    def _snap(self, depth, merged=640, ingress=2048):
+        return {"rank": 0, "ts": 100,
+                "counters": {"control.merged_frames": merged,
+                             "control.root_gather_bytes": ingress},
+                "gauges": {"control.agg_depth": depth},
+                "histograms": {}}
+
+    def test_hier_line(self):
+        lines = metrics_watch.render_topology_summary(self._snap(2), "")
+        text = "\n".join(lines)
+        assert "topo=hier" in text and "depth=2" in text
+        assert "merged_frames=640" in text
+        assert "root_gather=2.0KiB" in text
+
+    def test_flat_line(self):
+        lines = metrics_watch.render_topology_summary(
+            self._snap(1, merged=0, ingress=512), "")
+        text = "\n".join(lines)
+        assert "topo=flat" in text and "depth=1" in text
+        assert "merged_frames" not in text      # zero stays dark
+        assert "root_gather=512B" in text
+
+    def test_absent_without_agg_depth_gauge(self):
+        snap = {"counters": {"control.merged_frames": 3}, "gauges": {},
+                "histograms": {}}
+        assert metrics_watch.render_topology_summary(snap, "") == []
+
+    def test_digest_in_full_render(self):
+        out = metrics_watch.render(self._snap(2), None, "")
+        assert "-- control topology --" in out

@@ -51,8 +51,8 @@ class TestShippedTree:
         assert not findings, "\n".join(str(f) for f in findings)
         # The audited contract surface; update these alongside a
         # deliberate knob/symbol addition.
-        assert stats["knobs_total"] == 73
-        assert stats["symbols_total"] == 114
+        assert stats["knobs_total"] == 75
+        assert stats["symbols_total"] == 116
 
     def test_every_knob_has_a_read_site_count(self):
         _, stats = knobs.check(ROOT)
@@ -75,7 +75,7 @@ class TestShippedTree:
         report = json.loads(proc.stdout)
         assert report["ok"] is True
         assert report["findings"] == []
-        assert report["stats"]["symbols_total"] == 114
+        assert report["stats"]["symbols_total"] == 116
 
 
 # ---------------------------------------------------------------------------

@@ -153,6 +153,41 @@ def render_skew_summary(snap: dict, name_filter: str) -> list[str]:
     return lines
 
 
+def snapshot_generation(snap: dict) -> int:
+    """The membership generation a snapshot was taken under (0 for
+    pre-elastic jobs and snapshots that never exported the gauge)."""
+    try:
+        return int(snap.get("gauges", {}).get("membership.generation", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def render_topology_summary(snap: dict, name_filter: str) -> list:
+    """One-line control-topology digest (docs/concepts.md "Control
+    topology"): the negotiation tree depth (1 = flat star, 2 = per-host
+    sub-coordinators), member frames folded into aggregation containers,
+    and the root's inter-host gather ingress — present only on jobs
+    whose native plane exports ``control.agg_depth``."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    depth = gauges.get("control.agg_depth")
+    if depth is None:
+        return []
+    if name_filter and all(name_filter not in n for n in (
+            "control.agg_depth", "control.merged_frames",
+            "control.root_gather_bytes")):
+        return []
+    topo = {1: "flat", 2: "hier"}.get(int(depth), f"depth{int(depth)}")
+    text = f"topo={topo} depth={int(depth)}"
+    merged = counters.get("control.merged_frames", 0)
+    if merged:
+        text += f" merged_frames={merged:g}"
+    ingress = counters.get("control.root_gather_bytes", 0)
+    if ingress:
+        text += f" root_gather={human_bytes(ingress)}"
+    return ["  -- control topology --", f"  {'control':<52} {text}"]
+
+
 def render_elastic_summary(snap: dict, name_filter: str) -> list:
     """One-line elastic digest: membership generation, reconfiguration
     and coordinator-failover counts, coordinator epoch, and the last
@@ -513,6 +548,7 @@ def render(snap: dict, prev: dict | None, name_filter: str) -> str:
     lines.extend(render_integrity_summary(snap, name_filter))
     lines.extend(render_injit_summary(snap, name_filter))
     lines.extend(render_skew_summary(snap, name_filter))
+    lines.extend(render_topology_summary(snap, name_filter))
     lines.extend(render_elastic_summary(snap, name_filter))
     lines.extend(render_ckpt_summary(snap, name_filter))
     lines.extend(render_overlap_summary(snap, name_filter))
@@ -529,6 +565,7 @@ def follow(paths, once: bool, name_filter: str, poll_s: float) -> int:
 
     while True:
         printed = False
+        views = []   # --once: (path, newest snap, prev) per live file
         for path in paths:
             try:
                 # Binary mode: byte offsets stay exact under seek/tell.
@@ -559,15 +596,34 @@ def follow(paths, once: bool, name_filter: str, poll_s: float) -> int:
                 continue
             if once:
                 # Only the newest snapshot matters; the one before it
-                # (when present) supplies the rates.
+                # (when present) supplies the rates.  Rendering is
+                # deferred until every file is read: the fleet view must
+                # agree on the CURRENT membership generation first.
                 prev = fresh[-2] if len(fresh) > 1 else last[path]
-                print(render(fresh[-1], prev, name_filter))
+                views.append((path, fresh[-1], prev))
             else:
                 for snap in fresh:
                     print(render(snap, last[path], name_filter))
                     last[path] = snap
             printed = True
         if once:
+            # A rank retired by an elastic shrink stops writing, so its
+            # file's newest snapshot is frozen at the OLD generation — its
+            # per-rank series describe ranks that were since renumbered.
+            # Keying the digest off the fleet's current generation keeps
+            # stale files from masquerading as live ranks; they get one
+            # loud line instead of a full (wrong) digest.
+            cur_gen = max((snapshot_generation(s) for _, s, _ in views),
+                          default=0)
+            for path, snap, prev in views:
+                gen = snapshot_generation(snap)
+                if gen < cur_gen:
+                    print(f"── rank {snap.get('rank', '?')} ({path}) "
+                          f"STALE: last snapshot at membership generation "
+                          f"{gen} < fleet generation {cur_gen} — rank "
+                          "retired by a reconfigure; series skipped")
+                else:
+                    print(render(snap, prev, name_filter))
             if not printed:
                 print("metrics_watch: no complete snapshots in "
                       + ", ".join(paths) + " (is the emitter running with "
